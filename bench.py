@@ -1,0 +1,79 @@
+"""North-star benchmark: FedAvg local samples/sec/chip on CIFAR10-ResNet56.
+
+Config follows BASELINE.json: 128 simulated clients, CIFAR10-shaped data
+(synthetic — zero-egress environment), ResNet-56, batch 32, 1 local epoch.
+Sampled clients train back-to-back on the chip via vmapped lax.scan local
+SGD and a weighted-average aggregation — a full FedAvg round.
+
+``vs_baseline`` compares against a single-GPU PyTorch simulator reference of
+~1500 samples/sec (RTX2080Ti-class ResNet-56/CIFAR training throughput; the
+reference repo's hardware per BASELINE.md — it publishes no direct
+throughput number, so this is the stated assumption).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_SAMPLES_PER_SEC = 1500.0  # single-GPU torch simulator assumption
+
+
+def main():
+    import jax
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.models.resnet import resnet56
+
+    n_clients, per_client, batch = 128, 256, 32
+    clients_per_round = 8
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(n_clients * per_client, 32, 32, 3).astype(np.float32)
+    y = rng.randint(0, 10, size=len(x)).astype(np.int32)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), n_clients), batch)
+
+    cfg = FedConfig(
+        client_num_in_total=n_clients,
+        client_num_per_round=clients_per_round,
+        comm_round=1,
+        epochs=1,
+        batch_size=batch,
+        lr=0.1,
+    )
+    api = FedAvgAPI(resnet56(num_classes=10), fed, None, cfg)
+
+    # Warmup (compile)
+    api.train_one_round(0)
+    jax.block_until_ready(api.net.params)
+
+    rounds = 3
+    t0 = time.perf_counter()
+    for r in range(1, rounds + 1):
+        api.train_one_round(r)
+    jax.block_until_ready(api.net.params)
+    dt = time.perf_counter() - t0
+
+    samples_per_round = clients_per_round * per_client
+    sps = samples_per_round * rounds / dt
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_cifar10_resnet56_samples_per_sec_per_chip",
+                "value": round(sps, 2),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

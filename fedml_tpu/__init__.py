@@ -1,0 +1,24 @@
+"""tpu-fed: a TPU-native federated-learning framework built from scratch in JAX/XLA.
+
+Capability parity target: FedML v1 (arXiv:2007.13518); see SURVEY.md for the
+structural analysis. Reference anchors are cited in docstrings as
+``<path>:<line>`` relative to the reference tree.
+
+Design stance (TPU-first, not a port):
+
+- A *simulated* client is an index into a sharded array, not an OS process.
+  Local client SGD is a jit-compiled ``lax.scan`` train step, ``vmap``-ed over
+  the clients resident on one chip and ``shard_map``-ed over the ``clients``
+  mesh axis. Server aggregation is a ``lax.psum`` weighted average over ICI —
+  replacing the reference's MPI send/recv of pickled state_dicts
+  (fedml_core/distributed/communication/mpi/com_manager.py:13).
+- True cross-silo federation (separate trust domains over DCN) keeps a
+  message-passing layer: ``fedml_tpu.comm`` (Message envelope, observer
+  dispatch, loopback backend for tests, gRPC backend) — under construction;
+  see SURVEY.md §7 for the build order.
+- Everything on the compute path is functional and static-shaped: ragged
+  client datasets are padded to rectangular ``[clients, steps, batch, ...]``
+  layouts with masks so weighted averages stay exact.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,6 @@
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.algos.fedopt import FedOptAPI
+from fedml_tpu.algos.fedprox import FedProxAPI
+
+__all__ = ["FedConfig", "FedAvgAPI", "FedOptAPI", "FedProxAPI"]

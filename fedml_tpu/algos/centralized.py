@@ -1,0 +1,50 @@
+"""Centralized (non-federated) baseline trainer.
+
+Parity: fedml_api/centralized/centralized_trainer.py:9 — trains the pooled
+dataset conventionally; used as the accuracy reference for the federated ==
+centralized equivalence test (the reference's CI asserts 3-decimal equality,
+CI-script-fedavg.sh:40-45; our pytest asserts it numerically, see
+tests/test_equivalence.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from fedml_tpu.trainer.local import (
+    make_client_optimizer,
+    make_eval_fn,
+    make_local_train_fn,
+    model_fns,
+    softmax_ce,
+)
+
+
+class CentralizedTrainer:
+    def __init__(self, model, cfg, loss_fn=softmax_ce):
+        self.cfg = cfg
+        self.fns = model_fns(model)
+        optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
+        self.train_fn = jax.jit(
+            make_local_train_fn(self.fns.apply, optimizer, cfg.epochs, loss_fn)
+        )
+        self.eval_fn = jax.jit(make_eval_fn(self.fns.apply, loss_fn))
+        self.rng, init_rng = jax.random.split(jax.random.PRNGKey(cfg.seed))
+        self.net = None
+        self._init_rng = init_rng
+
+    def init_params(self, sample_x):
+        self.net = self.fns.init(self._init_rng, np.asarray(sample_x))
+        return self.net
+
+    def train(self, x, y, mask):
+        """One pass of ``cfg.epochs`` epochs over batched [S, B, ...] data."""
+        if self.net is None:
+            self.init_params(x[0])
+        self.rng, sub = jax.random.split(self.rng)
+        self.net, loss = self.train_fn(self.net, x, y, mask, sub)
+        return float(loss)
+
+    def evaluate(self, x, y, mask):
+        return {k: float(v) for k, v in self.eval_fn(self.net, x, y, mask).items()}

@@ -1,0 +1,31 @@
+"""Run configuration shared by all federated algorithms.
+
+Field names follow the reference's canonical argparse set
+(fedml_experiments/distributed/fedavg/main_fedavg.py:46-130) so configs map
+1:1 onto reference experiment flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class FedConfig:
+    client_num_in_total: int = 10
+    client_num_per_round: int = 10
+    comm_round: int = 10
+    epochs: int = 1  # local epochs per round
+    batch_size: int = 32
+    client_optimizer: str = "sgd"
+    lr: float = 0.03
+    wd: float = 0.0
+    frequency_of_the_test: int = 5
+    seed: int = 0
+    # FedOpt family (fedml_experiments/distributed/fedopt/main_fedopt.py:54,60)
+    server_optimizer: str = "sgd"
+    server_lr: float = 1.0
+    server_momentum: float = 0.9
+    # FedProx proximal term (absent from the reference's fedprox snapshot —
+    # SURVEY.md §2.3 — implemented properly here)
+    fedprox_mu: float = 0.1

@@ -1,0 +1,30 @@
+from fedml_tpu.core.tree import (
+    tree_add,
+    tree_sub,
+    tree_scale,
+    tree_dot,
+    tree_global_norm,
+    tree_vectorize,
+    tree_weighted_mean,
+    tree_select,
+    tree_zeros_like,
+    tree_cast,
+)
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.aggregate import weighted_average, pseudo_gradient
+
+__all__ = [
+    "tree_add",
+    "tree_sub",
+    "tree_scale",
+    "tree_dot",
+    "tree_global_norm",
+    "tree_vectorize",
+    "tree_weighted_mean",
+    "tree_select",
+    "tree_zeros_like",
+    "tree_cast",
+    "sample_clients",
+    "weighted_average",
+    "pseudo_gradient",
+]

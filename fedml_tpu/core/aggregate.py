@@ -1,0 +1,26 @@
+"""Server-side aggregation primitives.
+
+The reference's server holds a dict of client state_dicts and loops over keys
+(fedml_api/distributed/fedavg/FedAVGAggregator.py:59-88). Here the "server" is
+a functional reduction over a client-stacked pytree — on one chip a
+``tree_weighted_mean``, across a mesh a ``lax.psum`` of per-shard partial sums
+(see fedml_tpu/parallel/shard.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from fedml_tpu.core.tree import tree_sub, tree_weighted_mean
+
+
+def weighted_average(stacked_params, sample_counts):
+    """FedAvg: average client params weighted by true local sample counts
+    (reference weights by ``local_sample_number``, FedAVGAggregator.py:78-82)."""
+    return tree_weighted_mean(stacked_params, jnp.asarray(sample_counts))
+
+
+def pseudo_gradient(old_params, avg_params):
+    """Server pseudo-gradient ``old - avg`` used by the FedOpt family
+    (fedml_api/distributed/fedopt/FedOptAggregator.py:95-109)."""
+    return tree_sub(old_params, avg_params)

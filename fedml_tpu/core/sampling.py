@@ -1,0 +1,40 @@
+"""Seeded client sampling, reproducing the reference's semantics exactly.
+
+Reference: ``FedAVGAggregator.client_sampling``
+(fedml_api/distributed/fedavg/FedAVGAggregator.py:90-99) does
+``np.random.seed(round_idx)`` then ``np.random.choice(range(total), num,
+replace=False)``; with full participation it returns ``range(total)``.
+Matching this bit-for-bit keeps training curves comparable with published
+reference runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def sample_clients(
+    round_idx: int, client_num_in_total: int, client_num_per_round: int
+) -> np.ndarray:
+    if client_num_in_total == client_num_per_round:
+        return np.arange(client_num_in_total, dtype=np.int32)
+    num_clients = min(client_num_per_round, client_num_in_total)
+    # Legacy RandomState(seed) generates the same stream as np.random.seed(seed).
+    rng = np.random.RandomState(round_idx)
+    return rng.choice(client_num_in_total, num_clients, replace=False).astype(np.int32)
+
+
+def pad_to_multiple(indices: np.ndarray, multiple: int):
+    """Pad a sampled-client index list to a device-count multiple.
+
+    Padded slots repeat index 0 but carry weight 0 (see ``weight_mask``), so
+    the weighted average is unchanged while every shard stays rectangular.
+    Returns ``(padded_indices, weight_mask)``.
+    """
+    n = len(indices)
+    if multiple <= 1 or n % multiple == 0:
+        return indices, np.ones((n,), dtype=np.float32)
+    pad = multiple - (n % multiple)
+    padded = np.concatenate([indices, np.full((pad,), indices[0], dtype=indices.dtype)])
+    mask = np.concatenate([np.ones((n,), np.float32), np.zeros((pad,), np.float32)])
+    return padded, mask

@@ -1,0 +1,17 @@
+from fedml_tpu.data.partition import (
+    partition_dirichlet,
+    partition_homo,
+    partition_power_law,
+    record_data_stats,
+)
+from fedml_tpu.data.batching import FederatedArrays, build_federated_arrays, gather_clients
+
+__all__ = [
+    "partition_dirichlet",
+    "partition_homo",
+    "partition_power_law",
+    "record_data_stats",
+    "FederatedArrays",
+    "build_federated_arrays",
+    "gather_clients",
+]

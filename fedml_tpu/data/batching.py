@@ -1,0 +1,119 @@
+"""Rectangular client-batched array layout.
+
+TPU/XLA wants static shapes; federated datasets are ragged (non-IID clients
+have unequal sample counts — the reference handles this with per-client Python
+DataLoaders, fedml_api/data_preprocessing/cifar10/data_loader.py:221-233).
+Here every client's data is padded into one rectangular array
+
+    x: [num_clients, steps_per_epoch, batch, ...]
+    y: [num_clients, steps_per_epoch, batch]
+    mask: [num_clients, steps_per_epoch, batch]   (1.0 = real sample)
+    counts: [num_clients]                          (true local sample count)
+
+so local training is a ``lax.scan`` over ``steps`` and client parallelism is a
+``vmap``/``shard_map`` over the leading axis. Masks keep losses and the
+sample-count-weighted FedAvg average exact despite padding.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class FederatedArrays:
+    x: jax.Array  # [C, S, B, ...]
+    y: jax.Array  # [C, S, B] (int labels) or [C, S, B, ...] (dense targets)
+    mask: jax.Array  # [C, S, B] float32
+    counts: jax.Array  # [C] int32 true sample counts
+
+    @property
+    def num_clients(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.x.shape[1]
+
+    @property
+    def batch_size(self) -> int:
+        return self.x.shape[2]
+
+
+def build_federated_arrays(
+    x: np.ndarray,
+    y: np.ndarray,
+    client_indices: Dict[int, np.ndarray],
+    batch_size: int,
+    max_steps: Optional[int] = None,
+    dtype=None,
+) -> FederatedArrays:
+    """Pack per-client index lists over a global (x, y) store into the
+    rectangular layout. Padding replicates sample 0 of each client (masked
+    out, so it never contributes to loss or aggregation weights)."""
+    n_clients = len(client_indices)
+    counts = np.array([len(client_indices[c]) for c in range(n_clients)], np.int32)
+    steps = int(np.ceil(max(int(counts.max()), 1) / batch_size))
+    if max_steps is not None:
+        steps = min(steps, max_steps)
+    cap = steps * batch_size
+
+    xs = np.zeros((n_clients, cap) + x.shape[1:], dtype or x.dtype)
+    ys = np.zeros((n_clients, cap) + y.shape[1:], y.dtype)
+    mask = np.zeros((n_clients, cap), np.float32)
+    for c in range(n_clients):
+        idx = np.asarray(client_indices[c])[:cap]
+        k = len(idx)
+        if k == 0:
+            continue
+        xs[c, :k] = x[idx]
+        ys[c, :k] = y[idx]
+        mask[c, :k] = 1.0
+        if k < cap:  # pad with the client's own first sample (masked)
+            xs[c, k:] = x[idx[0]]
+            ys[c, k:] = y[idx[0]]
+    counts = np.minimum(counts, cap)
+
+    def split(a):
+        return a.reshape((n_clients, steps, batch_size) + a.shape[2:])
+
+    return FederatedArrays(
+        x=jnp.asarray(split(xs)),
+        y=jnp.asarray(split(ys)),
+        mask=jnp.asarray(split(mask)),
+        counts=jnp.asarray(counts),
+    )
+
+
+def gather_clients(fed: FederatedArrays, indices) -> FederatedArrays:
+    """Device-side gather of a sampled client subset (replaces the reference's
+    per-round ``update_local_dataset`` swap, standalone/fedavg/fedavg_api.py:57-66)."""
+    idx = jnp.asarray(indices)
+    return FederatedArrays(
+        x=jnp.take(fed.x, idx, axis=0),
+        y=jnp.take(fed.y, idx, axis=0),
+        mask=jnp.take(fed.mask, idx, axis=0),
+        counts=jnp.take(fed.counts, idx, axis=0),
+    )
+
+
+def batch_global(x: np.ndarray, y: np.ndarray, batch_size: int):
+    """Pad + reshape a flat (test) set into ``[steps, batch, ...]`` with a mask
+    — used for on-device global eval."""
+    n = len(x)
+    steps = int(np.ceil(n / batch_size))
+    cap = steps * batch_size
+    pad = cap - n
+    xs = np.concatenate([x, np.repeat(x[:1], pad, axis=0)]) if pad else x
+    ys = np.concatenate([y, np.repeat(y[:1], pad, axis=0)]) if pad else y
+    mask = np.concatenate([np.ones((n,), np.float32), np.zeros((pad,), np.float32)])
+    return (
+        jnp.asarray(xs.reshape((steps, batch_size) + x.shape[1:])),
+        jnp.asarray(ys.reshape((steps, batch_size) + y.shape[1:])),
+        jnp.asarray(mask.reshape(steps, batch_size)),
+    )

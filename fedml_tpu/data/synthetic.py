@@ -1,0 +1,77 @@
+"""Synthetic dataset generators (host-side numpy).
+
+Used by tests and as the zero-egress stand-in shape-generator for datasets
+whose real files are download-gated (SURVEY.md §2.7 — the reference ships
+``download_*.sh`` scripts; this environment has no network).
+
+``synthetic_alpha_beta`` reproduces the reference's synthetic(α,β) LR task
+(fedml_api/data_preprocessing/synthetic_1_1/ — the LEAF synthetic dataset of
+Li et al., FedProx): per-client model W_k ~ N(u_k, 1), u_k ~ N(0, α); inputs
+x ~ N(v_k, Σ) with v_k ~ N(B_k, 1), B_k ~ N(0, β); Σ diagonal, Σ_jj = j^-1.2.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def make_classification(
+    n_samples: int,
+    n_features: int = 16,
+    n_classes: int = 10,
+    seed: int = 0,
+    noise: float = 0.1,
+) -> Tuple[np.ndarray, np.ndarray]:
+    rng = np.random.RandomState(seed)
+    w = rng.randn(n_features, n_classes)
+    x = rng.randn(n_samples, n_features).astype(np.float32)
+    logits = x @ w + noise * rng.randn(n_samples, n_classes)
+    y = np.argmax(logits, axis=1).astype(np.int32)
+    return x, y
+
+
+def make_image_classification(
+    n_samples: int,
+    hwc: Tuple[int, int, int] = (28, 28, 1),
+    n_classes: int = 10,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-conditional Gaussian images (NHWC) — enough signal for smoke
+    tests to show learning."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, n_classes, size=n_samples).astype(np.int32)
+    protos = rng.randn(n_classes, *hwc).astype(np.float32)
+    x = protos[y] + 0.5 * rng.randn(n_samples, *hwc).astype(np.float32)
+    return x, y
+
+
+def synthetic_alpha_beta(
+    alpha: float = 1.0,
+    beta: float = 1.0,
+    n_clients: int = 30,
+    n_features: int = 60,
+    n_classes: int = 10,
+    seed: int = 0,
+    min_samples: int = 10,
+    mean_samples: int = 50,
+):
+    """Returns ``(x, y, client_index_map)`` with power-law client sizes."""
+    rng = np.random.RandomState(seed)
+    sizes = (rng.lognormal(np.log(mean_samples), 1.0, n_clients)).astype(int) + min_samples
+    sigma = np.diag(np.arange(1, n_features + 1, dtype=np.float64) ** -1.2)
+    xs, ys, idx_map, pos = [], [], {}, 0
+    for k in range(n_clients):
+        u_k = rng.normal(0, alpha)
+        b_k = rng.normal(0, beta)
+        w_k = rng.normal(u_k, 1.0, (n_features, n_classes))
+        bias_k = rng.normal(u_k, 1.0, (n_classes,))
+        v_k = rng.normal(b_k, 1.0, (n_features,))
+        x_k = rng.multivariate_normal(v_k, sigma, sizes[k]).astype(np.float32)
+        y_k = np.argmax(x_k @ w_k + bias_k, axis=1).astype(np.int32)
+        xs.append(x_k)
+        ys.append(y_k)
+        idx_map[k] = np.arange(pos, pos + sizes[k])
+        pos += sizes[k]
+    return np.concatenate(xs), np.concatenate(ys), idx_map

@@ -1,0 +1,9 @@
+"""Model zoo (flax.linen), capability parity with the reference's
+``fedml_api/model`` (SURVEY.md §2.6). Models are created through
+``create_model(name, ...)`` mirroring the reference's ``create_model`` switch
+(fedml_experiments/distributed/fedavg/main_fedavg.py:354-390)."""
+
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.models.registry import create_model, register_model
+
+__all__ = ["LogisticRegression", "create_model", "register_model"]

@@ -1,0 +1,58 @@
+"""FedAvg-era CNNs (reference: fedml_api/model/cv/cnn.py).
+
+- ``CNNOriginalFedAvg`` (cnn.py:5-70): McMahan'17 2-conv (32, 64 ch, 5x5) +
+  FC-512 net for MNIST/FEMNIST.
+- ``CNNDropOut`` (cnn.py:74-142): Reddi'20 "Adaptive Federated Optimization"
+  variant with 3x3 convs, max-pool, dropout 0.25/0.5, FC-128.
+
+NHWC layout (TPU-native; the reference is NCHW torch).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from fedml_tpu.models.registry import register_model
+
+
+class CNNOriginalFedAvg(nn.Module):
+    num_classes: int = 62
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(10 if self.only_digits else self.num_classes)(x)
+
+
+class CNNDropOut(nn.Module):
+    num_classes: int = 62
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(10 if self.only_digits else self.num_classes)(x)
+
+
+@register_model("cnn")
+def _cnn(num_classes: int = 62, only_digits: bool = False, dropout: bool = True, **_):
+    cls = CNNDropOut if dropout else CNNOriginalFedAvg
+    return cls(num_classes=num_classes, only_digits=only_digits)

@@ -1,0 +1,25 @@
+"""Logistic regression (reference: fedml_api/model/linear/lr.py:4-11).
+
+The reference applies a sigmoid on the output and pairs it with
+``nn.CrossEntropyLoss`` (a quirk we do not reproduce: here the model returns
+logits and the loss applies softmax, which is the numerically sane form)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from fedml_tpu.models.registry import register_model
+
+
+class LogisticRegression(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, name="linear")(x)
+
+
+@register_model("lr")
+def _lr(num_classes: int = 10, **_):
+    return LogisticRegression(num_classes=num_classes)

@@ -1,0 +1,32 @@
+"""Name → constructor registry for the model zoo.
+
+Mirrors the reference's ``create_model(args, model_name, output_dim)`` switch
+(fedml_experiments/distributed/fedavg/main_fedavg.py:354-390) as an extensible
+registry instead of an if/elif chain.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def create_model(name: str, **kwargs):
+    if name not in _REGISTRY:
+        # Import side-effect registration of the full zoo. Keep this list in
+        # sync with the modules that exist — import errors must propagate.
+        import fedml_tpu.models.cnn  # noqa: F401
+        import fedml_tpu.models.lr  # noqa: F401
+        import fedml_tpu.models.resnet  # noqa: F401
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)

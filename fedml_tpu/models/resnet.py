@@ -1,0 +1,179 @@
+"""ResNets for federated CV workloads.
+
+Parity targets:
+- CIFAR ResNet-56/110 with Bottleneck blocks [6,6,6]/[12,12,12]
+  (reference fedml_api/model/cv/resnet.py:113-246 — note the reference's
+  "resnet56" is the bottleneck variant, 16→64 widths; we mirror that).
+- ImageNet-style ResNet-18/34/50/101/152 with **GroupNorm** (reference
+  fedml_api/model/cv/resnet_gn.py:108-235, default 32 channels/group, used
+  for fed_cifar100 per Reddi'20).
+
+TPU-first choices: NHWC layout, GroupNorm default (BatchNorm running stats
+are a known FL pathology — the reference's robust aggregator special-cases
+them, fedml_core/robustness/robust_aggregation.py:27-29; a ``norm='bn'``
+variant is provided for strict parity and its batch_stats ride NetState).
+
+KNOWN LIMITATION of ``norm='bn'`` with ragged clients: padded duplicate
+samples inside a partially-masked batch enter the BatchNorm batch
+statistics (the mask guards losses and optimizer updates, not the forward
+normalization). With per-client sample counts that are multiples of the
+batch size this is exact; otherwise prefer GroupNorm (the default, and the
+setting the reference's published fed_cifar100 baseline uses).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.registry import register_model
+
+
+class Norm(nn.Module):
+    """GroupNorm (32 groups, clipped to channel count) or BatchNorm."""
+
+    kind: str = "gn"
+    groups: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.kind == "bn":
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        c = x.shape[-1]
+        return nn.GroupNorm(num_groups=min(self.groups, c))(x)
+
+
+class BottleneckBlock(nn.Module):
+    planes: int
+    strides: int = 1
+    norm: str = "gn"
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
+        y = Norm(self.norm)(y, train)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes, (3, 3), (self.strides, self.strides),
+                    padding="SAME", use_bias=False)(y)
+        y = Norm(self.norm)(y, train)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes * self.expansion, (1, 1), use_bias=False)(y)
+        y = Norm(self.norm)(y, train)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.planes * self.expansion, (1, 1),
+                (self.strides, self.strides), use_bias=False, name="downsample",
+            )(x)
+            residual = Norm(self.norm)(residual, train)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    strides: int = 1
+    norm: str = "gn"
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.planes, (3, 3), (self.strides, self.strides),
+                    padding="SAME", use_bias=False)(x)
+        y = Norm(self.norm)(y, train)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes, (3, 3), padding="SAME", use_bias=False)(y)
+        y = Norm(self.norm)(y, train)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.planes, (1, 1), (self.strides, self.strides),
+                use_bias=False, name="downsample",
+            )(x)
+            residual = Norm(self.norm)(residual, train)
+        return nn.relu(residual + y)
+
+
+class CifarResNet(nn.Module):
+    """CIFAR-style 3-stage ResNet (reference resnet.py:113-200)."""
+
+    layers: Sequence[int] = (6, 6, 6)  # 56 = 6*3*3 + 2
+    num_classes: int = 10
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        x = Norm(self.norm)(x, train)
+        x = nn.relu(x)
+        for stage, (planes, n_blocks) in enumerate(zip((16, 32, 64), self.layers)):
+            for i in range(n_blocks):
+                strides = 2 if (stage > 0 and i == 0) else 1
+                x = BottleneckBlock(planes, strides, self.norm)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class ResNetGN(nn.Module):
+    """ImageNet-style ResNet with GroupNorm (reference resnet_gn.py:108-235),
+    stem adapted for small inputs when ``small_input`` (fed_cifar100 runs
+    24x24 crops through the ImageNet stem in the reference; we keep that
+    possible but default to a 3x3 stem for 32x32)."""
+
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)  # resnet18
+    block: str = "basic"  # "basic" | "bottleneck"
+    num_classes: int = 100
+    norm: str = "gn"
+    small_input: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.small_input:
+            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False)(x)
+        else:
+            x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], use_bias=False)(x)
+        x = Norm(self.norm)(x, train)
+        x = nn.relu(x)
+        if not self.small_input:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        blk = BasicBlock if self.block == "basic" else BottleneckBlock
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            planes = 64 * (2 ** stage)
+            for i in range(n_blocks):
+                strides = 2 if (stage > 0 and i == 0) else 1
+                x = blk(planes, strides, self.norm)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("resnet56")
+def resnet56(num_classes: int = 10, norm: str = "gn", **_):
+    return CifarResNet(layers=(6, 6, 6), num_classes=num_classes, norm=norm)
+
+
+@register_model("resnet110")
+def resnet110(num_classes: int = 10, norm: str = "gn", **_):
+    return CifarResNet(layers=(12, 12, 12), num_classes=num_classes, norm=norm)
+
+
+@register_model("resnet20")
+def resnet20(num_classes: int = 10, norm: str = "gn", **_):
+    """Small CIFAR ResNet (2-2-2 bottleneck) — test/dryrun workhorse."""
+    return CifarResNet(layers=(2, 2, 2), num_classes=num_classes, norm=norm)
+
+
+@register_model("resnet18_gn")
+def resnet18_gn(num_classes: int = 100, **_):
+    return ResNetGN(stage_sizes=(2, 2, 2, 2), block="basic", num_classes=num_classes)
+
+
+@register_model("resnet34_gn")
+def resnet34_gn(num_classes: int = 100, **_):
+    return ResNetGN(stage_sizes=(3, 4, 6, 3), block="basic", num_classes=num_classes)
+
+
+@register_model("resnet50_gn")
+def resnet50_gn(num_classes: int = 100, **_):
+    return ResNetGN(stage_sizes=(3, 4, 6, 3), block="bottleneck", num_classes=num_classes)

@@ -1,0 +1,4 @@
+from fedml_tpu.parallel.mesh import client_mesh
+from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
+
+__all__ = ["client_mesh", "make_sharded_round", "make_vmap_round"]

@@ -1,0 +1,28 @@
+"""Device-mesh helpers.
+
+The FL simulator's primary parallel axis is ``clients`` — the TPU-native
+replacement for the reference's one-OS-process-per-client MPI layout
+(SURVEY.md §2.9). A second optional ``model`` axis is reserved for
+tensor-parallel large-model federation (splitnn/gkt-scale models).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+def client_mesh(num_devices: Optional[int] = None, axis_name: str = "clients") -> Mesh:
+    devices = jax.devices()
+    n = num_devices or len(devices)
+    mesh_devices = mesh_utils.create_device_mesh((n,), devices=devices[:n])
+    return Mesh(mesh_devices, (axis_name,))
+
+
+def mesh_2d(client_parallel: int, model_parallel: int,
+            axis_names: Sequence[str] = ("clients", "model")) -> Mesh:
+    devices = mesh_utils.create_device_mesh((client_parallel, model_parallel))
+    return Mesh(devices, tuple(axis_names))

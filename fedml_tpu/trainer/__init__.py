@@ -1,0 +1,19 @@
+from fedml_tpu.trainer.local import (
+    ModelFns,
+    NetState,
+    model_fns,
+    make_client_optimizer,
+    make_local_train_fn,
+    make_eval_fn,
+    softmax_ce,
+)
+
+__all__ = [
+    "ModelFns",
+    "NetState",
+    "model_fns",
+    "make_client_optimizer",
+    "make_local_train_fn",
+    "make_eval_fn",
+    "softmax_ce",
+]

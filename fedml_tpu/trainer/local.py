@@ -1,0 +1,205 @@
+"""Local (on-client) training as a jit-compiled ``lax.scan``.
+
+Replaces the reference's per-client Python epoch/batch loop
+(fedml_api/distributed/fedavg/MyModelTrainer.py:19-49 — HOT LOOP #3 in
+SURVEY.md §3.1). One ``local_train`` call runs ``epochs × steps`` SGD steps
+with static shapes; ``vmap`` over the leading client axis turns the
+reference's sequential client for-loop
+(fedml_api/standalone/fedavg/fedavg_api.py:58-66) into one batched XLA
+program whose matmuls keep the MXU busy across clients.
+
+Model state (BatchNorm running stats etc.) travels with the parameters in a
+``NetState`` pytree: the reference ships the full ``state_dict`` (params +
+BN buffers) over MPI and averages everything (FedAVGAggregator.py:74-82); we
+do the same by weighted-averaging the whole ``NetState``.
+
+The reference re-creates the client optimizer every round
+(MyModelTrainer.py:26-31) — we mirror that deliberately (``optimizer.init``
+inside ``local_train``), so Adam state does NOT persist across rounds, same
+as the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from fedml_tpu.core.tree import tree_select
+
+
+@struct.dataclass
+class NetState:
+    """Model parameters + non-trainable collections (batch_stats, ...)."""
+
+    params: Any
+    model_state: Any  # {} when the model has no mutable collections
+
+
+class ModelFns(NamedTuple):
+    """Functional model interface (the reference's ModelTrainer ABC,
+    fedml_core/trainer/model_trainer.py:4-38, reduced to pure functions)."""
+
+    init: Callable  # (rng, sample_x) -> NetState
+    apply: Callable  # (net, x, train, rng) -> (logits, new_model_state)
+
+
+def model_fns(module) -> ModelFns:
+    """Wrap a flax.linen module (taking a ``train`` kwarg) into ModelFns."""
+
+    def init(rng, sample_x) -> NetState:
+        variables = module.init({"params": rng}, sample_x, train=False)
+        params = variables["params"]
+        state = {k: v for k, v in variables.items() if k != "params"}
+        return NetState(params=params, model_state=state)
+
+    def apply(net: NetState, x, train=False, rng=None):
+        variables = {"params": net.params, **net.model_state}
+        rngs = {"dropout": rng} if (train and rng is not None) else None
+        mutable = list(net.model_state.keys()) if (train and net.model_state) else False
+        if mutable:
+            logits, new_state = module.apply(
+                variables, x, train=train, rngs=rngs, mutable=mutable
+            )
+            return logits, dict(new_state)
+        logits = module.apply(variables, x, train=train, rngs=rngs)
+        return logits, net.model_state
+
+    return ModelFns(init=init, apply=apply)
+
+
+def make_client_optimizer(name: str, lr: float, wd: float = 0.0):
+    """Client optimizers matching the reference's choices
+    (MyModelTrainer.py:26-31): plain SGD, or Adam with weight decay +
+    amsgrad. ``momentum`` added as a TPU-era convenience."""
+    if name == "sgd":
+        return optax.sgd(lr)
+    if name == "momentum":
+        return optax.sgd(lr, momentum=0.9)
+    if name == "adam":
+        # Coupled L2 (decay added to the gradient BEFORE the amsgrad
+        # preconditioner) — matches torch.optim.Adam(weight_decay=wd,
+        # amsgrad=True) as used by the reference, not AdamW.
+        return optax.chain(
+            optax.add_decayed_weights(wd),
+            optax.scale_by_amsgrad(),
+            optax.scale(-lr),
+        )
+    raise ValueError(f"unknown client optimizer {name!r}")
+
+
+def softmax_ce(logits, labels):
+    """Per-example softmax cross-entropy with integer labels."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def make_local_train_fn(
+    apply_fn,
+    optimizer,
+    local_epochs: int,
+    loss_fn=softmax_ce,
+    extra_grad_fn=None,
+    shuffle: bool = True,
+):
+    """Build ``local_train(net, x, y, mask, rng) -> (net', mean_loss)``.
+
+    ``x: [S, B, ...]``, ``y: [S, B]``, ``mask: [S, B]``. Masked samples
+    contribute zero loss; an entirely-masked batch leaves net and optimizer
+    state untouched (``tree_select`` gate), so padded steps are exact no-ops
+    rather than zero-gradient optimizer ticks.
+
+    ``extra_grad_fn(params, global_params) -> grads`` lets algorithms add
+    parameter-space gradient terms (FedProx's μ(w − w_global), fedprox).
+
+    ``shuffle`` reshuffles each client's sample-to-batch assignment every
+    epoch (the reference's DataLoader(shuffle=True) semantics) via an
+    on-device permutation of the flattened ``[S*B]`` sample axis.
+    """
+
+    def local_train(net: NetState, x, y, mask, rng):
+        opt_state = optimizer.init(net.params)
+        global_params = net.params  # anchor for proximal-style terms
+        n_steps, batch = x.shape[0], x.shape[1]
+
+        def step(carry, inputs):
+            net, opt_state, rng = carry
+            xb, yb, mb = inputs
+            rng, sub = jax.random.split(rng)
+
+            def masked_loss(p):
+                logits, new_state = apply_fn(
+                    NetState(p, net.model_state), xb, train=True, rng=sub
+                )
+                per = loss_fn(logits, yb)
+                loss = jnp.sum(per * mb) / jnp.maximum(jnp.sum(mb), 1.0)
+                return loss, new_state
+
+            (loss, new_state), grads = jax.value_and_grad(masked_loss, has_aux=True)(
+                net.params
+            )
+            if extra_grad_fn is not None:
+                extra = extra_grad_fn(net.params, global_params)
+                grads = jax.tree.map(jnp.add, grads, extra)
+            updates, new_opt = optimizer.update(grads, opt_state, net.params)
+            new_params = optax.apply_updates(net.params, updates)
+            nb = jnp.sum(mb)
+            nonempty = nb > 0
+            new_net = NetState(new_params, new_state)
+            net = tree_select(nonempty, new_net, net)
+            opt_state = tree_select(nonempty, new_opt, opt_state)
+            return (net, opt_state, rng), (loss, nb)
+
+        def epoch(carry, epoch_rng):
+            if shuffle:
+                perm = jax.random.permutation(epoch_rng, n_steps * batch)
+
+                def reshuffle(a):
+                    flat = a.reshape((n_steps * batch,) + a.shape[2:])
+                    return jnp.take(flat, perm, axis=0).reshape(a.shape)
+
+                ex, ey, em = reshuffle(x), reshuffle(y), reshuffle(mask)
+            else:
+                ex, ey, em = x, y, mask
+            carry, (losses, ns) = jax.lax.scan(step, carry, (ex, ey, em))
+            # Sample-weighted epoch loss: padded (all-masked) steps carry
+            # weight 0, so small clients are not diluted by padding steps.
+            return carry, jnp.sum(losses * ns) / jnp.maximum(jnp.sum(ns), 1.0)
+
+        rng, shuffle_rng = jax.random.split(rng)
+        (net, _, _), epoch_losses = jax.lax.scan(
+            epoch,
+            (net, opt_state, rng),
+            jax.random.split(shuffle_rng, local_epochs),
+        )
+        # Mean over local epochs — the reference logs the average of
+        # per-epoch means (MyModelTrainer.py:35-48).
+        return net, jnp.mean(epoch_losses)
+
+    return local_train
+
+
+def make_eval_fn(apply_fn, loss_fn=softmax_ce):
+    """Build ``evaluate(net, x, y, mask) -> {loss, accuracy, num}`` over a
+    batched ``[S, B, ...]`` set. On-device replacement for the reference's
+    host-side per-client test loop (FedAVGAggregator.py:110-161)."""
+
+    def evaluate(net: NetState, x, y, mask):
+        def step(_, inputs):
+            xb, yb, mb = inputs
+            logits, _ = apply_fn(net, xb, train=False)
+            per = loss_fn(logits, yb)
+            correct = (jnp.argmax(logits, -1) == yb).astype(jnp.float32)
+            return None, (jnp.sum(per * mb), jnp.sum(correct * mb), jnp.sum(mb))
+
+        _, (losses, corrects, ns) = jax.lax.scan(step, None, (x, y, mask))
+        n = jnp.maximum(jnp.sum(ns), 1.0)
+        return {
+            "loss": jnp.sum(losses) / n,
+            "accuracy": jnp.sum(corrects) / n,
+            "num": jnp.sum(ns),
+        }
+
+    return evaluate

@@ -1,0 +1,23 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+The container's sitecustomize registers the axon TPU plugin at interpreter
+startup, but backend *initialization* is lazy — so switching the platform to
+CPU here (before any jax op runs) still works. Multi-chip shardings are then
+validated on 8 virtual CPU devices, matching the driver's dryrun contract.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+assert jax.default_backend() == "cpu" and len(jax.devices()) >= 8, (
+    "tests require the 8-device virtual CPU mesh; got "
+    f"{jax.default_backend()} x{len(jax.devices())}"
+)

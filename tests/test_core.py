@@ -1,0 +1,57 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core import (
+    sample_clients,
+    tree_global_norm,
+    tree_vectorize,
+    tree_weighted_mean,
+)
+from fedml_tpu.core.sampling import pad_to_multiple
+
+
+def test_weighted_mean_matches_numpy():
+    trees = {"a": jnp.asarray(np.random.RandomState(0).randn(4, 3, 2)),
+             "b": jnp.asarray(np.random.RandomState(1).randn(4, 5))}
+    w = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    out = tree_weighted_mean(trees, w)
+    wn = np.asarray(w) / np.sum(np.asarray(w))
+    np.testing.assert_allclose(
+        np.asarray(out["a"]), np.einsum("c,cij->ij", wn, np.asarray(trees["a"])), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["b"]), np.einsum("c,cj->j", wn, np.asarray(trees["b"])), rtol=1e-6
+    )
+
+
+def test_weighted_mean_ignores_zero_weight():
+    stacked = {"w": jnp.stack([jnp.ones((3,)), 100 * jnp.ones((3,))])}
+    out = tree_weighted_mean(stacked, jnp.asarray([1.0, 0.0]))
+    np.testing.assert_allclose(np.asarray(out["w"]), np.ones(3), rtol=1e-6)
+
+
+def test_tree_norm_and_vectorize():
+    tree = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+    assert jnp.allclose(tree_global_norm(tree), 5.0)
+    assert tree_vectorize(tree).shape == (2,)
+
+
+def test_sampling_matches_reference_semantics():
+    # np.random.seed(round_idx) + choice(total, num, replace=False)
+    np.random.seed(7)
+    expected = np.random.choice(100, 10, replace=False)
+    got = sample_clients(7, 100, 10)
+    np.testing.assert_array_equal(got, expected)
+    # full participation returns range(total)
+    np.testing.assert_array_equal(sample_clients(3, 8, 8), np.arange(8))
+    # deterministic per round
+    np.testing.assert_array_equal(sample_clients(5, 50, 5), sample_clients(5, 50, 5))
+
+
+def test_pad_to_multiple():
+    idx = np.asarray([4, 7, 9], dtype=np.int32)
+    padded, mask = pad_to_multiple(idx, 4)
+    assert len(padded) == 4 and mask.tolist() == [1, 1, 1, 0]
+    same, mask2 = pad_to_multiple(np.arange(8), 4)
+    assert len(same) == 8 and mask2.sum() == 8

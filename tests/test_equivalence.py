@@ -1,0 +1,47 @@
+"""The reference's strongest CI property (CI-script-fedavg.sh:40-45): FedAvg
+with FULL participation, FULL batch, 1 local epoch, SGD must equal
+centralized full-batch gradient descent — here asserted on raw parameters to
+float tolerance instead of 3-decimal accuracy equality."""
+
+import jax
+import numpy as np
+
+from fedml_tpu.algos.centralized import CentralizedTrainer
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.data.batching import batch_global, build_federated_arrays
+from fedml_tpu.data.partition import partition_homo
+from fedml_tpu.data.synthetic import make_classification
+
+
+def test_full_participation_fullbatch_equals_centralized():
+    n, n_clients = 512, 8
+    x, y = make_classification(n, n_features=10, n_classes=4, seed=3)
+    parts = partition_homo(n, n_clients, seed=3)
+    per_client = n // n_clients
+    fed = build_federated_arrays(x, y, parts, batch_size=per_client)
+    assert fed.steps_per_epoch == 1  # full local batch
+
+    cfg = FedConfig(
+        client_num_in_total=n_clients, client_num_per_round=n_clients,
+        comm_round=5, epochs=1, batch_size=per_client,
+        client_optimizer="sgd", lr=0.5, frequency_of_the_test=100, seed=3,
+    )
+    fed_api = FedAvgAPI(LogisticRegressionFactory(), fed, None, cfg)
+
+    central = CentralizedTrainer(LogisticRegressionFactory(), cfg)
+    # pooled full-batch layout: one step containing all N samples
+    xc, yc, maskc = batch_global(x, y, batch_size=n)
+
+    fed_api.train()
+    for _ in range(cfg.comm_round):
+        central.train(xc, yc, maskc)
+
+    for a, b in zip(jax.tree.leaves(fed_api.net.params), jax.tree.leaves(central.net.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def LogisticRegressionFactory():
+    from fedml_tpu.models.lr import LogisticRegression
+
+    return LogisticRegression(num_classes=4)

@@ -1,0 +1,54 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.trainer.local import model_fns
+
+
+@pytest.mark.parametrize(
+    "name,kwargs,shape,classes",
+    [
+        ("lr", dict(num_classes=10), (2, 28, 28, 1), 10),
+        ("cnn", dict(num_classes=62, dropout=True), (2, 28, 28, 1), 62),
+        ("cnn", dict(num_classes=62, dropout=False), (2, 28, 28, 1), 62),
+        ("resnet20", dict(num_classes=10), (2, 32, 32, 3), 10),
+        ("resnet18_gn", dict(num_classes=100), (2, 32, 32, 3), 100),
+    ],
+)
+def test_model_forward_shapes(name, kwargs, shape, classes):
+    model = create_model(name, **kwargs)
+    fns = model_fns(model)
+    x = jnp.zeros(shape, jnp.float32)
+    net = fns.init(jax.random.PRNGKey(0), x)
+    logits, _ = fns.apply(net, x, train=False)
+    assert logits.shape == (shape[0], classes)
+    # train mode (dropout rng) also works
+    logits2, _ = fns.apply(net, x, train=True, rng=jax.random.PRNGKey(1))
+    assert np.isfinite(np.asarray(logits2)).all()
+
+
+def test_resnet56_param_scale():
+    """Reference resnet56 (bottleneck [6,6,6]) is ~0.59M params; the GN clone
+    should be the same order."""
+    model = create_model("resnet56", num_classes=10)
+    fns = model_fns(model)
+    net = fns.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(net.params))
+    assert 3e5 < n_params < 2e6
+
+
+def test_bn_variant_carries_batch_stats():
+    model = create_model("resnet20", num_classes=10, norm="bn")
+    fns = model_fns(model)
+    x = jnp.ones((2, 16, 16, 3))
+    net = fns.init(jax.random.PRNGKey(0), x)
+    assert "batch_stats" in net.model_state
+    _, new_state = fns.apply(net, x, train=True, rng=jax.random.PRNGKey(1))
+    # running stats must move in train mode
+    before = jax.tree.leaves(net.model_state)
+    after = jax.tree.leaves(new_state)
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b)) for a, b in zip(before, after)
+    )

@@ -1,0 +1,48 @@
+import numpy as np
+
+from fedml_tpu.data.partition import (
+    partition_dirichlet,
+    partition_homo,
+    partition_power_law,
+    record_data_stats,
+)
+
+
+def _assert_exact_cover(parts, n):
+    allidx = np.concatenate([parts[c] for c in parts])
+    assert len(allidx) == n
+    assert len(np.unique(allidx)) == n
+
+
+def test_homo_partition_covers_once():
+    parts = partition_homo(103, 7, seed=1)
+    _assert_exact_cover(parts, 103)
+    sizes = [len(parts[c]) for c in range(7)]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_dirichlet_partition_properties():
+    labels = np.random.RandomState(0).randint(0, 10, size=2000)
+    parts = partition_dirichlet(labels, 8, alpha=0.5, min_size=10, seed=0)
+    _assert_exact_cover(parts, 2000)
+    assert min(len(parts[c]) for c in range(8)) >= 10
+    # Lower alpha => more skewed label distributions
+    stats = record_data_stats(labels, parts)
+    assert all(len(stats[c]) >= 1 for c in stats)
+
+
+def test_dirichlet_skew_increases_with_small_alpha():
+    labels = np.random.RandomState(0).randint(0, 10, size=5000)
+    def skew(alpha):
+        parts = partition_dirichlet(labels, 10, alpha=alpha, min_size=1, seed=0)
+        stats = record_data_stats(labels, parts)
+        # mean number of distinct classes per client (fewer = more skew)
+        return np.mean([len(s) for s in stats.values()])
+    assert skew(0.1) < skew(100.0)
+
+
+def test_power_law_partition():
+    parts = partition_power_law(5000, 20, seed=0)
+    _assert_exact_cover(parts, 5000)
+    sizes = np.array([len(parts[c]) for c in range(20)])
+    assert sizes.max() > 3 * sizes.min()  # heavy tail
