@@ -1,6 +1,21 @@
 from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.centralized import CentralizedTrainer
+from fedml_tpu.algos.decentralized import DecentralizedAPI
 from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.algos.fednova import FedNovaAPI
 from fedml_tpu.algos.fedopt import FedOptAPI
 from fedml_tpu.algos.fedprox import FedProxAPI
+from fedml_tpu.algos.hierarchical import HierarchicalFedAvgAPI
+from fedml_tpu.algos.robust import FedAvgRobustAPI
 
-__all__ = ["FedConfig", "FedAvgAPI", "FedOptAPI", "FedProxAPI"]
+__all__ = [
+    "FedConfig",
+    "CentralizedTrainer",
+    "DecentralizedAPI",
+    "FedAvgAPI",
+    "FedNovaAPI",
+    "FedOptAPI",
+    "FedProxAPI",
+    "HierarchicalFedAvgAPI",
+    "FedAvgRobustAPI",
+]

@@ -29,3 +29,10 @@ class FedConfig:
     # FedProx proximal term (absent from the reference's fedprox snapshot —
     # SURVEY.md §2.3 — implemented properly here)
     fedprox_mu: float = 0.1
+    # Robust aggregation (fedml_api/distributed/fedavg_robust/main_fedavg_robust.py
+    # flags --norm_bound / --stddev)
+    robust_norm_bound: float = 5.0
+    robust_stddev: float = 0.0
+    # Hierarchical FL (fedml_experiments/standalone/hierarchical_fl/main.py
+    # flag --group_comm_round)
+    group_comm_round: int = 1

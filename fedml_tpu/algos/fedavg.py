@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.loop import FederatedLoop
 from fedml_tpu.core.sampling import pad_to_multiple, sample_clients
 from fedml_tpu.data.batching import FederatedArrays, gather_clients
 from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
@@ -31,7 +32,7 @@ from fedml_tpu.trainer.local import (
 )
 
 
-class FedAvgAPI:
+class FedAvgAPI(FederatedLoop):
     """Federated trainer. ``mesh=None`` → single-device vmap simulator;
     with a mesh, clients are sharded over ``mesh.axis_names[0]``."""
 
@@ -101,21 +102,5 @@ class FedAvgAPI:
         self.net = self._server_update(self.net, avg)
         return {"round": round_idx, "train_loss": float(loss)}
 
-    def evaluate(self) -> Dict[str, float]:
-        if self.test_global is None:
-            return {}
-        x, y, mask = self.test_global
-        m = self.eval_fn(self.net, x, y, mask)
-        return {k: float(v) for k, v in m.items()}
-
-    def train(self) -> List[Dict[str, float]]:
-        history = []
-        for round_idx in range(self.cfg.comm_round):
-            metrics = self.train_one_round(round_idx)
-            if (
-                round_idx % self.cfg.frequency_of_the_test == 0
-                or round_idx == self.cfg.comm_round - 1
-            ):
-                metrics.update(self.evaluate())
-            history.append(metrics)
-        return history
+    def _eval_net(self):
+        return self.net

@@ -1,0 +1,66 @@
+"""FedNova — normalized averaging (Wang et al., NeurIPS'20).
+
+Parity: fedml_api/standalone/fednova/ — the reference implements FedNova as
+a torch Optimizer subclass accumulating ``cum_grad`` and a normalizing
+vector (fednova.py:10-151), aggregated with ``tau_eff``-normalized averaging
+(fednova_trainer.py:97).
+
+TPU formulation (vanilla-SGD case, momentum=0, matching the reference's
+default ``gmf=0`` path): client i runs τ_i local steps, producing
+``d_i = (w_g − w_i)/τ_i``. The server applies
+
+    w⁺ = w_g − τ_eff · Σ p_i d_i,   p_i = n_i/N,  τ_eff = Σ p_i τ_i.
+
+Algebraically Σ p_i d_i = s · (w_g − avg_q) with q_i ∝ p_i/τ_i and
+s = Σ p_i/τ_i — so the existing weighted-average round (weights n_i/τ_i)
+is reused unchanged and the server step is one scalar-γ interpolation with
+γ = τ_eff · s. When all τ_i are equal, γ = 1 and FedNova reduces exactly to
+FedAvg (covered by a test).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.data.batching import gather_clients
+from fedml_tpu.trainer.local import NetState
+
+
+class FedNovaAPI(FedAvgAPI):
+    def _local_steps(self, counts) -> np.ndarray:
+        """τ_i = epochs × (non-empty scan steps for client i). Exact because
+        the trainer's shuffle keeps padding at the tail (trailing all-masked
+        steps are gated no-ops — see make_local_train_fn), so client i runs
+        exactly ceil(n_i/B) optimizer updates per epoch."""
+        b = self.cfg.batch_size
+        return np.maximum(np.ceil(np.asarray(counts) / b), 1.0) * self.cfg.epochs
+
+    def train_one_round(self, round_idx: int):
+        idx, wmask = self.sample_round(round_idx)
+        sub = gather_clients(self.train_fed, idx)
+        counts = np.asarray(sub.counts, np.float64) * np.asarray(wmask, np.float64)
+        tau = self._local_steps(sub.counts)
+        n_total = counts.sum()
+        p = counts / max(n_total, 1.0)
+        tau_eff = float((p * tau).sum())
+        s = float((p / tau).sum())
+        self._gamma = tau_eff * s
+
+        # Weighted-average round with q-weights ∝ p_i/τ_i.
+        q = counts / tau
+        self.rng, rnd_rng = jax.random.split(self.rng)
+        avg, loss = self.round_fn(
+            self.net, sub.x, sub.y, sub.mask, jnp.asarray(q, jnp.float32), rnd_rng
+        )
+        self.net = self._server_update(self.net, avg)
+        return {"round": round_idx, "train_loss": float(loss)}
+
+    def _server_update(self, old_net, avg_net):
+        g = self._gamma
+        new_params = jax.tree.map(
+            lambda w, a: w - g * (w - a), old_net.params, avg_net.params
+        )
+        return NetState(new_params, avg_net.model_state)

@@ -1,0 +1,41 @@
+"""Shared federated training-loop policy (round cadence + eval frequency).
+
+One implementation of the loop the reference re-implements in every
+``*API``/``*Trainer`` class (e.g. standalone fedavg_api.py:40-82): train a
+round, evaluate every ``frequency_of_the_test`` rounds and on the last
+round, collect history.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+class FederatedLoop:
+    """Mixin. Subclasses provide ``cfg``, ``train_one_round(round_idx)``,
+    ``eval_fn``, ``test_global``, and ``_eval_net()``."""
+
+    def _eval_net(self):
+        raise NotImplementedError
+
+    def train_one_round(self, round_idx: int) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def evaluate(self) -> Dict[str, float]:
+        if self.test_global is None:
+            return {}
+        x, y, mask = self.test_global
+        m = self.eval_fn(self._eval_net(), x, y, mask)
+        return {k: float(v) for k, v in m.items()}
+
+    def train(self) -> List[Dict[str, float]]:
+        history = []
+        for round_idx in range(self.cfg.comm_round):
+            metrics = self.train_one_round(round_idx)
+            if (
+                round_idx % self.cfg.frequency_of_the_test == 0
+                or round_idx == self.cfg.comm_round - 1
+            ):
+                metrics.update(self.evaluate())
+            history.append(metrics)
+        return history

@@ -1,0 +1,51 @@
+"""FedAvg with robust aggregation (backdoor defenses).
+
+Parity: fedml_api/distributed/fedavg_robust/FedAvgRobustAggregator.py —
+per-client norm-difference clipping before the weighted average (:179-185)
+and weak-DP Gaussian noise on the aggregate (:202-205), both built on
+fedml_core/robustness/robust_aggregation.py. Clipping applies to trainable
+params only; BatchNorm stats are excluded structurally (they live in
+``NetState.model_state``), mirroring the reference's ``is_weight_param``
+filter.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.core.robustness import add_gaussian_noise, norm_diff_clipping
+from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
+from fedml_tpu.trainer.local import NetState
+
+
+class FedAvgRobustAPI(FedAvgAPI):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        cfg = self.cfg
+
+        def clip(global_net, client_net):
+            clipped = norm_diff_clipping(
+                client_net.params, global_net.params, cfg.robust_norm_bound
+            )
+            return NetState(clipped, client_net.model_state)
+
+        if self.mesh is None:
+            round_fn = make_vmap_round(self.local_train, client_transform=clip)
+        else:
+            round_fn = make_sharded_round(
+                self.local_train, self.mesh, self.mesh.axis_names[0],
+                client_transform=clip,
+            )
+        self.round_fn = jax.jit(round_fn)
+        self._noise = jax.jit(
+            lambda p, r: add_gaussian_noise(p, r, cfg.robust_stddev)
+        )
+
+    def _server_update(self, old_net, avg_net):
+        if self.cfg.robust_stddev > 0:
+            self.rng, sub = jax.random.split(self.rng)
+            return NetState(
+                self._noise(avg_net.params, sub), avg_net.model_state
+            )
+        return avg_net

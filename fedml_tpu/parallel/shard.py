@@ -22,16 +22,24 @@ from jax import shard_map
 from fedml_tpu.core.tree import tree_weighted_mean
 
 
-def make_vmap_round(local_train):
+def make_vmap_round(local_train, client_transform=None):
     """``round_fn(params, x, y, mask, weights, rng) -> (avg_params, mean_loss)``
     with client-stacked inputs ``[C, S, B, ...]`` and float weights ``[C]``
-    (true sample counts, possibly zeroed for padded slots)."""
+    (true sample counts, possibly zeroed for padded slots).
+
+    ``client_transform(global_net, client_net) -> client_net`` is applied to
+    every trained client model before averaging (robust clipping etc.).
+    """
 
     def round_fn(params, x, y, mask, weights, rng):
-        rngs = _client_rngs(rng, x.shape[0], 0)
+        rngs = client_rngs(rng, x.shape[0], 0)
         client_params, losses = jax.vmap(
             local_train, in_axes=(None, 0, 0, 0, 0)
         )(params, x, y, mask, rngs)
+        if client_transform is not None:
+            client_params = jax.vmap(client_transform, in_axes=(None, 0))(
+                params, client_params
+            )
         avg = tree_weighted_mean(client_params, weights)
         w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
         return avg, jnp.sum(losses * w)
@@ -39,14 +47,14 @@ def make_vmap_round(local_train):
     return round_fn
 
 
-def _client_rngs(rng, n_local, offset):
+def client_rngs(rng, n_local, offset):
     """Per-client rng streams keyed by GLOBAL client slot, so the vmap and
     shard_map paths produce bitwise-identical randomness (shuffle order,
     dropout) for the same sampled round."""
     return jax.vmap(lambda i: jax.random.fold_in(rng, i))(offset + jnp.arange(n_local))
 
 
-def make_sharded_round(local_train, mesh, axis: str = "clients"):
+def make_sharded_round(local_train, mesh, axis: str = "clients", client_transform=None):
     """Sharded round: client axis split over ``mesh[axis]``; output replicated.
 
     Weighted average = psum of per-shard weighted partial sums / psum of
@@ -63,10 +71,14 @@ def make_sharded_round(local_train, mesh, axis: str = "clients"):
     def round_fn(params, x, y, mask, weights, rng):
         # Same global-slot-keyed streams as the vmap path.
         shard_idx = jax.lax.axis_index(axis)
-        rngs = _client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
+        rngs = client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
         client_params, losses = jax.vmap(
             local_train, in_axes=(None, 0, 0, 0, 0)
         )(params, x, y, mask, rngs)
+        if client_transform is not None:
+            client_params = jax.vmap(client_transform, in_axes=(None, 0))(
+                params, client_params
+            )
         w = weights.astype(jnp.float32)
         total = jax.lax.psum(jnp.sum(w), axis)
         wn = w / jnp.maximum(total, 1e-12)

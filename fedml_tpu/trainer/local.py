@@ -96,6 +96,17 @@ def softmax_ce(logits, labels):
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
 
 
+def seq_softmax_ce(logits, labels, pad_id: int = 0):
+    """Per-example next-token CE for sequence models: ``logits [B, T, V]``,
+    ``labels [B, T]``; mean over non-pad positions. Used by the Shakespeare /
+    StackOverflow LSTM tasks (the reference masks padding in its
+    language_utils)."""
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    tok_mask = (labels != pad_id).astype(per_tok.dtype)
+    denom = jnp.maximum(tok_mask.sum(axis=-1), 1.0)
+    return (per_tok * tok_mask).sum(axis=-1) / denom
+
+
 def make_local_train_fn(
     apply_fn,
     optimizer,
@@ -116,7 +127,12 @@ def make_local_train_fn(
 
     ``shuffle`` reshuffles each client's sample-to-batch assignment every
     epoch (the reference's DataLoader(shuffle=True) semantics) via an
-    on-device permutation of the flattened ``[S*B]`` sample axis.
+    on-device permutation of the flattened ``[S*B]`` sample axis. REAL
+    samples are permuted amongst themselves and padding stays at the tail
+    (argsort of random keys offset by the mask), so trailing steps remain
+    all-masked no-ops: the per-client optimizer-step count stays exactly
+    ``epochs x ceil(n_i/B)`` (FedNova's τ depends on this) and at most one
+    batch per epoch mixes real samples with padding.
     """
 
     def local_train(net: NetState, x, y, mask, rng):
@@ -154,7 +170,10 @@ def make_local_train_fn(
 
         def epoch(carry, epoch_rng):
             if shuffle:
-                perm = jax.random.permutation(epoch_rng, n_steps * batch)
+                flat_mask = mask.reshape(n_steps * batch)
+                keys = jax.random.uniform(epoch_rng, (n_steps * batch,))
+                # Padded slots get keys > 1 so argsort sends them to the tail.
+                perm = jnp.argsort(keys + (1.0 - flat_mask) * 2.0)
 
                 def reshuffle(a):
                     flat = a.reshape((n_steps * batch,) + a.shape[2:])
@@ -181,10 +200,14 @@ def make_local_train_fn(
     return local_train
 
 
-def make_eval_fn(apply_fn, loss_fn=softmax_ce):
+def make_eval_fn(apply_fn, loss_fn=softmax_ce, pad_id: int = 0):
     """Build ``evaluate(net, x, y, mask) -> {loss, accuracy, num}`` over a
     batched ``[S, B, ...]`` set. On-device replacement for the reference's
-    host-side per-client test loop (FedAVGAggregator.py:110-161)."""
+    host-side per-client test loop (FedAVGAggregator.py:110-161).
+
+    Sequence tasks ([B, T] labels): accuracy is averaged over non-pad
+    positions only, consistent with ``seq_softmax_ce``.
+    """
 
     def evaluate(net: NetState, x, y, mask):
         def step(_, inputs):
@@ -192,6 +215,13 @@ def make_eval_fn(apply_fn, loss_fn=softmax_ce):
             logits, _ = apply_fn(net, xb, train=False)
             per = loss_fn(logits, yb)
             correct = (jnp.argmax(logits, -1) == yb).astype(jnp.float32)
+            if correct.ndim > 1:  # sequence tasks: mean over non-pad tokens
+                tok_mask = (yb != pad_id).astype(jnp.float32)
+                tok_mask = tok_mask.reshape(correct.shape[0], -1)
+                correct = correct.reshape(correct.shape[0], -1)
+                correct = (correct * tok_mask).sum(-1) / jnp.maximum(
+                    tok_mask.sum(-1), 1.0
+                )
             return None, (jnp.sum(per * mb), jnp.sum(correct * mb), jnp.sum(mb))
 
         _, (losses, corrects, ns) = jax.lax.scan(step, None, (x, y, mask))
