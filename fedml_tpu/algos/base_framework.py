@@ -1,0 +1,132 @@
+"""Minimal message-passing FL template (didactic skeleton).
+
+Parity with the reference's ``base_framework``
+(fedml_api/distributed/base_framework/algorithm_api.py:16,
+central_worker.py:28-33): a central worker sums scalar "local results" from
+every client each round, then broadcasts the global result. New algorithms
+that need true multi-process federation start from this skeleton; simulated
+algorithms start from ``FederatedLoop`` instead.
+"""
+
+from __future__ import annotations
+
+from fedml_tpu.comm.loopback import LoopbackNetwork, run_workers
+from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.comm.message import Message
+
+MSG_TYPE_S2C_INIT = 1
+MSG_TYPE_C2S_RESULT = 2
+MSG_TYPE_S2C_GLOBAL = 3
+
+MSG_ARG_KEY_RESULT = "result"
+MSG_ARG_KEY_ROUND = "round"
+
+
+class BaseCentralWorker:
+    """Server state: collect one scalar per client, aggregate by sum
+    (central_worker.py:28-33)."""
+
+    def __init__(self, client_num: int):
+        self.client_num = client_num
+        self._results = {}
+
+    def add_client_local_result(self, index: int, result: float) -> None:
+        self._results[index] = result
+
+    def check_whether_all_receive(self) -> bool:
+        return len(self._results) == self.client_num
+
+    def aggregate(self) -> float:
+        total = float(sum(self._results.values()))
+        self._results.clear()
+        return total
+
+
+class BaseServerManager(ServerManager):
+    def __init__(self, args, worker: BaseCentralWorker, comm_round: int, size: int,
+                 backend: str = "LOOPBACK"):
+        super().__init__(args, rank=0, size=size, backend=backend)
+        self.worker = worker
+        self.comm_round = comm_round
+        self.round_idx = 0
+        self.global_results = []
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        for client_id in range(1, self.size):
+            msg = Message(MSG_TYPE_S2C_INIT, 0, client_id)
+            msg.add(MSG_ARG_KEY_ROUND, 0)
+            self.send_message(msg)
+        self.com_manager.handle_receive_message()
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_RESULT, self.handle_message_receive_result
+        )
+
+    def handle_message_receive_result(self, msg: Message) -> None:
+        self.worker.add_client_local_result(
+            msg.get_sender_id(), msg.get(MSG_ARG_KEY_RESULT)
+        )
+        if not self.worker.check_whether_all_receive():
+            return
+        global_result = self.worker.aggregate()
+        self.global_results.append(global_result)
+        self.round_idx += 1
+        done = self.round_idx >= self.comm_round
+        for client_id in range(1, self.size):
+            out = Message(MSG_TYPE_S2C_GLOBAL, 0, client_id)
+            out.add(MSG_ARG_KEY_RESULT, global_result)
+            out.add(MSG_ARG_KEY_ROUND, self.round_idx)
+            out.add("done", done)
+            self.send_message(out)
+        if done:
+            self.finish()
+
+
+class BaseClientManager(ClientManager):
+    def __init__(self, args, rank: int, size: int, local_fn,
+                 backend: str = "LOOPBACK"):
+        """``local_fn(round_idx, global_result) -> float`` is the client's
+        local computation."""
+        super().__init__(args, rank=rank, size=size, backend=backend)
+        self.local_fn = local_fn
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(MSG_TYPE_S2C_INIT, self.handle_init)
+        self.register_message_receive_handler(MSG_TYPE_S2C_GLOBAL, self.handle_global)
+
+    def _train_and_send(self, round_idx: int, global_result) -> None:
+        result = self.local_fn(round_idx, global_result)
+        msg = Message(MSG_TYPE_C2S_RESULT, self.rank, 0)
+        msg.add(MSG_ARG_KEY_RESULT, result)
+        self.send_message(msg)
+
+    def handle_init(self, msg: Message) -> None:
+        self._train_and_send(msg.get(MSG_ARG_KEY_ROUND), None)
+
+    def handle_global(self, msg: Message) -> None:
+        if msg.get("done"):
+            self.finish()
+            return
+        self._train_and_send(msg.get(MSG_ARG_KEY_ROUND), msg.get(MSG_ARG_KEY_RESULT))
+
+
+def FedML_Base_distributed(client_num: int, comm_round: int, local_fn):
+    """Run the template end-to-end on the loopback network; returns the
+    list of per-round aggregated results (algorithm_api.py:16 analogue)."""
+    network = LoopbackNetwork(client_num + 1)
+
+    class Args:
+        pass
+
+    args = Args()
+    args.network = network
+    worker = BaseCentralWorker(client_num)
+    server = BaseServerManager(args, worker, comm_round, client_num + 1)
+    clients = [
+        BaseClientManager(args, rank, client_num + 1, local_fn)
+        for rank in range(1, client_num + 1)
+    ]
+    run_workers([server.run] + [c.run for c in clients])
+    return server.global_results

@@ -36,6 +36,17 @@ from fedml_tpu.trainer.local import (
 )
 
 
+def _per_client(omega, p):
+    """Broadcast a per-client vector ``omega [n]`` against a client-stacked
+    leaf ``p [n, ...]`` (one reshape rule for every ω·tree operation)."""
+    return omega.reshape((-1,) + (1,) * (p.ndim - 1)).astype(p.dtype)
+
+
+def _debias_tree(stacked, omega):
+    """PushSum de-bias x_i = z_i / ω_i over a client-stacked pytree."""
+    return jax.tree.map(lambda p: p / _per_client(omega, p), stacked)
+
+
 class DecentralizedAPI(FederatedLoop):
     """Every client participates every round (decentralized has no server to
     sample); ``mode`` is ``"dsgd"`` (symmetric, row-stochastic) or
@@ -80,25 +91,16 @@ class DecentralizedAPI(FederatedLoop):
                 stacked,
             )
 
-        def debias(stacked, omega):
-            return jax.tree.map(
-                lambda p: p
-                / omega.reshape((-1,) + (1,) * (p.ndim - 1)).astype(p.dtype),
-                stacked,
-            )
-
         def round_fn(nets, omega, x, y, mask, rng):
             rngs = client_rngs(rng, n, 0)
             if self.mode == "pushsum":
                 # Train at the de-biased iterate x = z/ω; fold the update
                 # back into z-space (Δz = ω·Δx), then gossip z and ω with
                 # the column-stochastic matrix.
-                xs = debias(nets, omega)
+                xs = _debias_tree(nets, omega)
                 trained, losses = jax.vmap(local_train)(xs, x, y, mask, rngs)
                 z = jax.tree.map(
-                    lambda zl, xl, tl: zl
-                    + omega.reshape((-1,) + (1,) * (xl.ndim - 1)).astype(xl.dtype)
-                    * (tl - xl),
+                    lambda zl, xl, tl: zl + _per_client(omega, xl) * (tl - xl),
                     nets, xs, trained,
                 )
                 return mix(z), self.W @ omega, jnp.mean(losses)
@@ -120,11 +122,7 @@ class DecentralizedAPI(FederatedLoop):
         """PushSum estimate x_i = z_i / w_i; DSGD uses params directly."""
         if self.mode == "dsgd":
             return self.nets
-        return jax.tree.map(
-            lambda p: p
-            / self.push_weights.reshape((-1,) + (1,) * (p.ndim - 1)).astype(p.dtype),
-            self.nets,
-        )
+        return _debias_tree(self.nets, self.push_weights)
 
     def consensus_net(self):
         """Uniform average over clients — the quantity decentralized SGD

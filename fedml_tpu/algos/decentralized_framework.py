@@ -1,0 +1,130 @@
+"""Serverless (decentralized) message-passing template.
+
+Parity with the reference's ``decentralized_framework``
+(fedml_api/distributed/decentralized_framework/algorithm_api.py:15,
+decentralized_worker_manager.py:29-39, decentralized_worker.py:19): each
+worker pushes its local result to its topology out-neighbors, waits for all
+in-neighbors, mixes with the topology weights, and advances to the next
+round — no server rank.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fedml_tpu.comm.loopback import LoopbackNetwork, run_workers
+from fedml_tpu.comm.managers import ClientManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.core.topology import SymmetricTopologyManager
+
+MSG_TYPE_NEIGHBOR_RESULT = 11
+
+MSG_ARG_KEY_RESULT = "result"
+MSG_ARG_KEY_ROUND = "round"
+
+
+class DecentralizedWorker:
+    """Per-worker state: in-neighbor results for the current round; mixing
+    is the topology-weighted average (decentralized_worker.py:19-39)."""
+
+    def __init__(self, worker_index: int, topology):
+        self.worker_index = worker_index
+        self.topology = topology
+        self.in_neighbors = list(topology.get_in_neighbor_idx_list(worker_index))
+        self.weights = np.asarray(topology.get_in_neighbor_weights(worker_index))
+        self._buffer = {}
+
+    def add_result(self, sender: int, result: float) -> None:
+        self._buffer[sender] = result
+
+    def check_whether_all_receive(self) -> bool:
+        return all(n in self._buffer for n in self.in_neighbors)
+
+    def mix(self, own_result: float) -> float:
+        mixed = self.weights[self.worker_index] * own_result
+        for n in self.in_neighbors:
+            mixed += self.weights[n] * self._buffer[n]
+        self._buffer.clear()
+        return float(mixed)
+
+
+class DecentralizedWorkerManager(ClientManager):
+    def __init__(self, args, worker: DecentralizedWorker, rank: int, size: int,
+                 comm_round: int, local_fn, backend: str = "LOOPBACK"):
+        super().__init__(args, rank=rank, size=size, backend=backend)
+        self.worker = worker
+        self.comm_round = comm_round
+        self.local_fn = local_fn
+        self.round_idx = 0
+        self.history = []
+        self.current = None
+        # Out-of-order rounds: a fast neighbor may send round r+1 before we
+        # finish r; park those until we advance.
+        self._future = []
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.start_round()
+        self.com_manager.handle_receive_message()
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MSG_TYPE_NEIGHBOR_RESULT, self.handle_msg_from_neighbor
+        )
+
+    def start_round(self) -> None:
+        self.current = self.local_fn(self.round_idx, self.current)
+        for neighbor in self.worker.topology.get_out_neighbor_idx_list(self.rank):
+            msg = Message(MSG_TYPE_NEIGHBOR_RESULT, self.rank, int(neighbor))
+            msg.add(MSG_ARG_KEY_RESULT, self.current)
+            msg.add(MSG_ARG_KEY_ROUND, self.round_idx)
+            self.send_message(msg)
+        self._check_advance()
+
+    def handle_msg_from_neighbor(self, msg: Message) -> None:
+        if msg.get(MSG_ARG_KEY_ROUND) != self.round_idx:
+            self._future.append(msg)
+            return
+        self.worker.add_result(msg.get_sender_id(), msg.get(MSG_ARG_KEY_RESULT))
+        self._check_advance()
+
+    def _check_advance(self) -> None:
+        while self.worker.check_whether_all_receive():
+            self.current = self.worker.mix(self.current)
+            self.history.append(self.current)
+            self.round_idx += 1
+            if self.round_idx >= self.comm_round:
+                self.finish()
+                return
+            self.current = self.local_fn(self.round_idx, self.current)
+            for neighbor in self.worker.topology.get_out_neighbor_idx_list(self.rank):
+                out = Message(MSG_TYPE_NEIGHBOR_RESULT, self.rank, int(neighbor))
+                out.add(MSG_ARG_KEY_RESULT, self.current)
+                out.add(MSG_ARG_KEY_ROUND, self.round_idx)
+                self.send_message(out)
+            pending, self._future = self._future, []
+            for m in pending:
+                self.handle_msg_from_neighbor(m)
+
+
+def FedML_Decentralized_Demo_distributed(worker_num: int, comm_round: int, local_fn,
+                                         neighbor_num: int = 2):
+    """Build a ring(+random) symmetric topology and run the gossip template
+    (algorithm_api.py:15 analogue). Returns each worker's mixing history."""
+    topology = SymmetricTopologyManager(worker_num, neighbor_num, seed=0)
+    network = LoopbackNetwork(worker_num)
+
+    class Args:
+        pass
+
+    args = Args()
+    args.network = network
+    managers = [
+        DecentralizedWorkerManager(
+            args, DecentralizedWorker(rank, topology), rank, worker_num,
+            comm_round, local_fn,
+        )
+        for rank in range(worker_num)
+    ]
+    run_workers([m.run for m in managers])
+    return [m.history for m in managers]

@@ -44,7 +44,12 @@ class FedAvgAPI(FederatedLoop):
         cfg: FedConfig,
         mesh=None,
         loss_fn=softmax_ce,
+        pad_id: int = 0,
     ):
+        """``pad_id`` marks padding positions in sequence-task labels
+        (excluded from eval accuracy); it must match the pad id baked into a
+        sequence ``loss_fn`` (e.g. ``partial(seq_softmax_ce, pad_id=...)``).
+        Irrelevant for flat classification tasks."""
         self.cfg = cfg
         self.mesh = mesh
         self.train_fed = train_fed
@@ -60,16 +65,19 @@ class FedAvgAPI(FederatedLoop):
         optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
         self.local_train = self._build_local_train(optimizer, loss_fn)
 
+        transform = self._client_transform()
         if mesh is None:
             self.n_shards = 1
-            round_fn = make_vmap_round(self.local_train)
+            round_fn = make_vmap_round(self.local_train, client_transform=transform)
         else:
             # Pad the sampled set to the CLIENT axis size only (a 2-D mesh's
             # model axis does not multiply the client shards).
             self.n_shards = int(mesh.shape[mesh.axis_names[0]])
-            round_fn = make_sharded_round(self.local_train, mesh, mesh.axis_names[0])
+            round_fn = make_sharded_round(
+                self.local_train, mesh, mesh.axis_names[0], client_transform=transform
+            )
         self.round_fn = jax.jit(round_fn)
-        self.eval_fn = jax.jit(make_eval_fn(self.fns.apply, loss_fn))
+        self.eval_fn = jax.jit(make_eval_fn(self.fns.apply, loss_fn, pad_id=pad_id))
 
         rng = jax.random.PRNGKey(cfg.seed)
         self.rng, init_rng = jax.random.split(rng)
@@ -83,6 +91,11 @@ class FedAvgAPI(FederatedLoop):
     def _server_update(self, old_net, avg_net):
         """FedAvg: the new global model is the client average."""
         return avg_net
+
+    def _client_transform(self):
+        """Optional ``(global_net, client_net) -> client_net`` applied to
+        each trained client before averaging (robust clipping etc.)."""
+        return None
 
     # ----------------------------------------------------------------------
     def sample_round(self, round_idx: int):
@@ -98,7 +111,9 @@ class FedAvgAPI(FederatedLoop):
         sub = gather_clients(self.train_fed, idx)
         weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
         self.rng, rnd_rng = jax.random.split(self.rng)
-        avg, loss = self.round_fn(self.net, sub.x, sub.y, sub.mask, weights, rnd_rng)
+        avg, loss = self.round_fn(
+            self.net, sub.x, sub.y, sub.mask, weights, weights, rnd_rng
+        )
         self.net = self._server_update(self.net, avg)
         return {"round": round_idx, "train_loss": float(loss)}
 

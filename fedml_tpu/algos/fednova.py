@@ -49,11 +49,13 @@ class FedNovaAPI(FedAvgAPI):
         s = float((p / tau).sum())
         self._gamma = tau_eff * s
 
-        # Weighted-average round with q-weights ∝ p_i/τ_i.
+        # Weighted-average round with q-weights ∝ p_i/τ_i; the reported loss
+        # stays sample-weighted (comparable with every other algorithm).
         q = counts / tau
         self.rng, rnd_rng = jax.random.split(self.rng)
         avg, loss = self.round_fn(
-            self.net, sub.x, sub.y, sub.mask, jnp.asarray(q, jnp.float32), rnd_rng
+            self.net, sub.x, sub.y, sub.mask,
+            jnp.asarray(q, jnp.float32), jnp.asarray(counts, jnp.float32), rnd_rng,
         )
         self.net = self._server_update(self.net, avg)
         return {"round": round_idx, "train_loss": float(loss)}

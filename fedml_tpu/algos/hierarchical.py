@@ -59,7 +59,7 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
             for _ in range(self.cfg.group_comm_round):
                 self.rng, rnd_rng = jax.random.split(self.rng)
                 net_g, loss = self.round_fn(
-                    net_g, sub.x, sub.y, sub.mask, weights, rnd_rng
+                    net_g, sub.x, sub.y, sub.mask, weights, weights, rnd_rng
                 )
             group_nets.append(net_g)
             group_weights.append(float(np.asarray(weights).sum()))

@@ -15,13 +15,18 @@ import jax
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.core.robustness import add_gaussian_noise, norm_diff_clipping
-from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
 from fedml_tpu.trainer.local import NetState
 
 
 class FedAvgRobustAPI(FedAvgAPI):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
+        cfg = self.cfg
+        self._noise = jax.jit(
+            lambda p, r: add_gaussian_noise(p, r, cfg.robust_stddev)
+        )
+
+    def _client_transform(self):
         cfg = self.cfg
 
         def clip(global_net, client_net):
@@ -30,17 +35,7 @@ class FedAvgRobustAPI(FedAvgAPI):
             )
             return NetState(clipped, client_net.model_state)
 
-        if self.mesh is None:
-            round_fn = make_vmap_round(self.local_train, client_transform=clip)
-        else:
-            round_fn = make_sharded_round(
-                self.local_train, self.mesh, self.mesh.axis_names[0],
-                client_transform=clip,
-            )
-        self.round_fn = jax.jit(round_fn)
-        self._noise = jax.jit(
-            lambda p, r: add_gaussian_noise(p, r, cfg.robust_stddev)
-        )
+        return clip
 
     def _server_update(self, old_net, avg_net):
         if self.cfg.robust_stddev > 0:

@@ -1,0 +1,30 @@
+"""Host-side communication layer (the reference's ``fedml_core/distributed``).
+
+The TPU framework aggregates *simulated* clients with on-device collectives
+(fedml_tpu.parallel); this package exists for true cross-silo / cross-device
+federation, where clients are separate OS processes or hosts. It mirrors the
+reference's architecture — a ``Message`` envelope, a pluggable
+``BaseCommunicationManager``, observer dispatch, and ``ClientManager`` /
+``ServerManager`` process bases (fedml_core/distributed/communication/
+base_com_manager.py:7, client/client_manager.py:14) — with two backends:
+
+- ``loopback`` — in-memory threaded router for tests and single-host
+  multi-worker simulation (the fake backend the reference lacks, SURVEY §4.6)
+- ``tcp`` — native C++ length-prefixed socket transport over DCN, the
+  cross-silo role the reference fills with gRPC (grpc_comm_manager.py:23)
+"""
+
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.loopback import LoopbackNetwork, LoopbackCommManager
+from fedml_tpu.comm.managers import ClientManager, ServerManager
+
+__all__ = [
+    "Message",
+    "BaseCommunicationManager",
+    "Observer",
+    "LoopbackNetwork",
+    "LoopbackCommManager",
+    "ClientManager",
+    "ServerManager",
+]

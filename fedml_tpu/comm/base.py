@@ -1,0 +1,38 @@
+"""Backend-independent communication abstractions.
+
+Parity with the reference's ``BaseCommunicationManager``
+(fedml_core/distributed/communication/base_com_manager.py:7-27) and
+``Observer`` (observer.py:4-7): a backend exposes send / observer
+registration / a blocking receive loop; observers get
+``receive_message(msg_type, msg)`` callbacks.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from fedml_tpu.comm.message import Message
+
+
+class Observer(ABC):
+    @abstractmethod
+    def receive_message(self, msg_type, msg: Message) -> None: ...
+
+
+class BaseCommunicationManager(ABC):
+    @abstractmethod
+    def send_message(self, msg: Message) -> None: ...
+
+    @abstractmethod
+    def add_observer(self, observer: Observer) -> None: ...
+
+    @abstractmethod
+    def remove_observer(self, observer: Observer) -> None: ...
+
+    @abstractmethod
+    def handle_receive_message(self) -> None:
+        """Blocking receive loop: deliver incoming messages to observers
+        until :meth:`stop_receive_message` is called."""
+
+    @abstractmethod
+    def stop_receive_message(self) -> None: ...

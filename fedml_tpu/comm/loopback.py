@@ -1,0 +1,97 @@
+"""In-memory loopback backend.
+
+The fake/test backend the reference never had (SURVEY.md §4.6 — its nearest
+substitute is running real ``mpirun`` on one machine). A
+``LoopbackNetwork`` owns one queue per rank; managers send by enqueueing
+directly to the receiver's queue and receive by blocking on their own —
+event-driven, unlike the reference's MPI manager which polls its receive
+queue every 0.3 s (mpi/com_manager.py:78). Messages are delivered by
+reference (no serialization) which also makes this the fastest possible
+single-host multi-worker transport; use ``Message.to_json`` round-trip in
+tests to exercise the wire format.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.message import Message
+
+_STOP = object()
+
+
+class LoopbackNetwork:
+    """Shared router: one inbox per rank. Thread-safe."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._inboxes: List[queue.Queue] = [queue.Queue() for _ in range(size)]
+
+    def post(self, receiver_id: int, msg: Message) -> None:
+        self._inboxes[receiver_id].put(msg)
+
+    def inbox(self, rank: int) -> queue.Queue:
+        return self._inboxes[rank]
+
+
+class LoopbackCommManager(BaseCommunicationManager):
+    def __init__(self, network: LoopbackNetwork, rank: int):
+        self.network = network
+        self.rank = rank
+        self.size = network.size
+        self._observers: List[Observer] = []
+        self._running = False
+
+    def send_message(self, msg: Message) -> None:
+        self.network.post(int(msg.get_receiver_id()), msg)
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        inbox = self.network.inbox(self.rank)
+        while self._running:
+            msg = inbox.get()
+            if msg is _STOP:
+                break
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self.network.post(self.rank, _STOP)
+
+
+def run_workers(worker_fns) -> None:
+    """Run one callable per rank on daemon threads and join them all.
+    Single-host analogue of ``mpirun -np N`` (run_fedavg_distributed_pytorch
+    .sh:21); exceptions in any worker are re-raised in the caller."""
+    errors: Dict[int, BaseException] = {}
+
+    def wrap(i, fn):
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — surfaced to caller
+                errors[i] = e
+
+        return run
+
+    threads = [
+        threading.Thread(target=wrap(i, fn), daemon=True)
+        for i, fn in enumerate(worker_fns)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        rank, err = sorted(errors.items())[0]
+        raise RuntimeError(f"worker rank {rank} failed") from err

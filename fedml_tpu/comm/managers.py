@@ -1,0 +1,69 @@
+"""Client/Server process managers.
+
+Parity with ``fedml_core/distributed/client/client_manager.py:14-79`` and
+``server/server_manager.py:15-74``: a manager owns a comm backend, registers
+itself as observer, dispatches incoming messages through a handler dict
+keyed by message type, and runs a blocking receive loop until ``finish()``.
+
+Backend selection is a string, as in the reference (client_manager.py:20-36):
+``LOOPBACK`` (in-memory; needs a shared ``LoopbackNetwork`` in
+``args.network``) or ``TCP`` (native C++ socket transport).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.loopback import LoopbackCommManager
+from fedml_tpu.comm.message import Message
+
+
+def _build_backend(args, rank: int, size: int, backend: str) -> BaseCommunicationManager:
+    if backend == "LOOPBACK":
+        return LoopbackCommManager(args.network, rank)
+    if backend == "TCP":
+        from fedml_tpu.comm.tcp import TcpCommManager
+
+        return TcpCommManager(args.host_table, rank)
+    raise ValueError(f"unknown comm backend {backend!r}")
+
+
+class _Manager(Observer):
+    def __init__(self, args, rank: int = 0, size: int = 0, backend: str = "LOOPBACK"):
+        self.args = args
+        self.rank = rank
+        self.size = size
+        self.backend = backend
+        self.com_manager = _build_backend(args, rank, size, backend)
+        self.com_manager.add_observer(self)
+        self.message_handler_dict: Dict[object, Callable[[Message], None]] = {}
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.com_manager.handle_receive_message()
+
+    def register_message_receive_handlers(self) -> None:
+        """Subclasses register via :meth:`register_message_receive_handler`."""
+
+    def register_message_receive_handler(self, msg_type, handler) -> None:
+        self.message_handler_dict[msg_type] = handler
+
+    def receive_message(self, msg_type, msg: Message) -> None:
+        self.message_handler_dict[msg_type](msg)
+
+    def send_message(self, message: Message) -> None:
+        self.com_manager.send_message(message)
+
+    def finish(self) -> None:
+        """Stop the receive loop. The reference calls MPI Abort here
+        (client_manager.py:72-75); loopback/tcp shut down cleanly."""
+        self.com_manager.stop_receive_message()
+
+
+class ClientManager(_Manager):
+    pass
+
+
+class ServerManager(_Manager):
+    pass

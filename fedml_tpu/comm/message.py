@@ -1,0 +1,127 @@
+"""Typed key-value message envelope.
+
+Capability parity with the reference's ``Message``
+(fedml_core/distributed/communication/message.py:5-74): named constants for
+the routing keys, arbitrary payload params, and a JSON wire format for
+text-based backends. Array payloads are converted to nested lists on
+``to_json`` — the reference's ``is_mobile`` wire format
+(fedml_api/distributed/fedavg/utils.py:7-16) — and restored as numpy arrays
+on decode; binary backends (loopback, tcp) ship payloads natively.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+
+class Message:
+    MSG_ARG_KEY_OPERATION = "operation"
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    MSG_ARG_KEY_TRAIN_CORRECT = "train_correct"
+    MSG_ARG_KEY_TRAIN_ERROR = "train_error"
+    MSG_ARG_KEY_TRAIN_NUM = "train_num_sample"
+    MSG_ARG_KEY_TEST_CORRECT = "test_correct"
+    MSG_ARG_KEY_TEST_ERROR = "test_error"
+    MSG_ARG_KEY_TEST_NUM = "test_num_sample"
+
+    MSG_OPERATION_SEND = "send"
+    MSG_OPERATION_RECEIVE = "receive"
+    MSG_OPERATION_BROADCAST = "broadcast"
+    MSG_OPERATION_REDUCE = "reduce"
+
+    def __init__(self, type: Any = 0, sender_id: int = 0, receiver_id: int = 0):
+        self.type = type
+        self.sender_id = sender_id
+        self.receiver_id = receiver_id
+        self.msg_params: Dict[str, Any] = {
+            Message.MSG_ARG_KEY_TYPE: type,
+            Message.MSG_ARG_KEY_SENDER: sender_id,
+            Message.MSG_ARG_KEY_RECEIVER: receiver_id,
+        }
+
+    def init(self, msg_params: Dict[str, Any]) -> None:
+        self.msg_params = dict(msg_params)
+        self.type = self.msg_params.get(Message.MSG_ARG_KEY_TYPE)
+        self.sender_id = self.msg_params.get(Message.MSG_ARG_KEY_SENDER, 0)
+        self.receiver_id = self.msg_params.get(Message.MSG_ARG_KEY_RECEIVER, 0)
+
+    def init_from_json_string(self, json_string: str) -> None:
+        self.init(json.loads(json_string))
+
+    def get_sender_id(self) -> int:
+        return self.sender_id
+
+    def get_receiver_id(self) -> int:
+        return self.receiver_id
+
+    def add_params(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    def add(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.msg_params.get(key, default)
+
+    def get_params(self) -> Dict[str, Any]:
+        return self.msg_params
+
+    def get_type(self) -> Any:
+        return self.msg_params[Message.MSG_ARG_KEY_TYPE]
+
+    def to_string(self) -> Dict[str, Any]:
+        return self.msg_params
+
+    def to_json(self) -> str:
+        """JSON wire format; arrays/pytrees become nested lists (the
+        reference's mobile transform, fedavg/utils.py:7-16)."""
+        return json.dumps(_jsonify(self.msg_params))
+
+    @classmethod
+    def from_json(cls, json_string: str) -> "Message":
+        msg = cls()
+        msg.init(_unjsonify(json.loads(json_string)))
+        return msg
+
+    def __repr__(self) -> str:
+        return (
+            f"Message(type={self.type!r}, sender={self.sender_id}, "
+            f"receiver={self.receiver_id}, keys={sorted(self.msg_params)})"
+        )
+
+
+def _jsonify(obj):
+    """Arrays → {'__nd__': shape, 'data': flat list}; pytrees recursed."""
+    if isinstance(obj, np.ndarray):
+        return {"__nd__": list(obj.shape), "dtype": str(obj.dtype),
+                "data": obj.ravel().tolist()}
+    if hasattr(obj, "dtype") and hasattr(obj, "shape"):  # jax arrays
+        return _jsonify(np.asarray(obj))
+    if isinstance(obj, dict):
+        return {k: _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    return obj
+
+
+def _unjsonify(obj):
+    if isinstance(obj, dict):
+        if "__nd__" in obj:
+            return np.asarray(obj["data"], dtype=obj["dtype"]).reshape(obj["__nd__"])
+        return {k: _unjsonify(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unjsonify(v) for v in obj]
+    return obj

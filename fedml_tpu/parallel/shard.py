@@ -23,15 +23,19 @@ from fedml_tpu.core.tree import tree_weighted_mean
 
 
 def make_vmap_round(local_train, client_transform=None):
-    """``round_fn(params, x, y, mask, weights, rng) -> (avg_params, mean_loss)``
-    with client-stacked inputs ``[C, S, B, ...]`` and float weights ``[C]``
-    (true sample counts, possibly zeroed for padded slots).
+    """``round_fn(params, x, y, mask, weights, loss_weights, rng) ->
+    (avg_params, mean_loss)`` with client-stacked inputs ``[C, S, B, ...]``.
+
+    ``weights [C]`` weight the model average; ``loss_weights [C]`` weight the
+    reported train loss (true sample counts — algorithms like FedNova
+    aggregate with n_i/τ_i weights but still report sample-weighted loss).
+    Padded client slots carry weight 0 in both.
 
     ``client_transform(global_net, client_net) -> client_net`` is applied to
     every trained client model before averaging (robust clipping etc.).
     """
 
-    def round_fn(params, x, y, mask, weights, rng):
+    def round_fn(params, x, y, mask, weights, loss_weights, rng):
         rngs = client_rngs(rng, x.shape[0], 0)
         client_params, losses = jax.vmap(
             local_train, in_axes=(None, 0, 0, 0, 0)
@@ -41,8 +45,8 @@ def make_vmap_round(local_train, client_transform=None):
                 params, client_params
             )
         avg = tree_weighted_mean(client_params, weights)
-        w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
-        return avg, jnp.sum(losses * w)
+        lw = loss_weights / jnp.maximum(jnp.sum(loss_weights), 1e-12)
+        return avg, jnp.sum(losses * lw)
 
     return round_fn
 
@@ -64,11 +68,11 @@ def make_sharded_round(local_train, mesh, axis: str = "clients", client_transfor
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P()),
+        in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
-    def round_fn(params, x, y, mask, weights, rng):
+    def round_fn(params, x, y, mask, weights, loss_weights, rng):
         # Same global-slot-keyed streams as the vmap path.
         shard_idx = jax.lax.axis_index(axis)
         rngs = client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
@@ -88,7 +92,9 @@ def make_sharded_round(local_train, mesh, axis: str = "clients", client_transfor
             ).astype(p.dtype),
             client_params,
         )
-        loss = jax.lax.psum(jnp.sum(losses * wn), axis)
+        lw = loss_weights.astype(jnp.float32)
+        lw = lw / jnp.maximum(jax.lax.psum(jnp.sum(lw), axis), 1e-12)
+        loss = jax.lax.psum(jnp.sum(losses * lw), axis)
         return avg, loss
 
     return round_fn
