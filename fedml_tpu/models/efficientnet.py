@@ -1,0 +1,143 @@
+"""EfficientNet B0–B7 (Tan & Le 2019) in flax.
+
+Parity target: reference fedml_api/model/cv/efficientnet.py:36-305 +
+efficientnet_utils.py (MBConv blocks with squeeze-excite and drop-connect,
+compound width/depth scaling per variant, swish activation).
+
+TPU-first: NHWC, depthwise convs as grouped contractions, GroupNorm default
+(reference uses BatchNorm; ``norm='bn'`` gives strict parity), stochastic
+depth (drop-connect) via per-sample bernoulli mask under the 'dropout' rng.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.registry import register_model
+from fedml_tpu.models.resnet import Norm
+
+# (width_mult, depth_mult, resolution, dropout) per variant
+# (reference efficientnet_utils.py params dict).
+_PARAMS = {
+    "b0": (1.0, 1.0, 224, 0.2), "b1": (1.0, 1.1, 240, 0.2),
+    "b2": (1.1, 1.2, 260, 0.3), "b3": (1.2, 1.4, 300, 0.3),
+    "b4": (1.4, 1.8, 380, 0.4), "b5": (1.6, 2.2, 456, 0.4),
+    "b6": (1.8, 2.6, 528, 0.5), "b7": (2.0, 3.1, 600, 0.5),
+}
+
+# Base B0 stage plan: (expand, channels, repeats, kernel, stride)
+# (reference efficientnet.py blocks_args / efficientnet_utils decode).
+_BASE_PLAN: Sequence[Tuple[int, int, int, int, int]] = (
+    (1, 16, 1, 3, 1), (6, 24, 2, 3, 2), (6, 40, 2, 5, 2), (6, 80, 3, 3, 2),
+    (6, 112, 3, 5, 1), (6, 192, 4, 5, 2), (6, 320, 1, 3, 1),
+)
+
+
+def round_filters(filters: int, width_mult: float, divisor: int = 8) -> int:
+    """Channel rounding to multiples of 8 (reference efficientnet_utils
+    round_filters) — also MXU-lane friendly."""
+    filters *= width_mult
+    new = max(divisor, int(filters + divisor / 2) // divisor * divisor)
+    if new < 0.9 * filters:
+        new += divisor
+    return int(new)
+
+
+def round_repeats(repeats: int, depth_mult: float) -> int:
+    return int(math.ceil(depth_mult * repeats))
+
+
+class MBConv(nn.Module):
+    """Mobile inverted bottleneck + SE + drop-connect
+    (reference MBConvBlock efficientnet.py:36-135)."""
+
+    expand: int
+    out_ch: int
+    kernel: int
+    strides: int
+    se_ratio: float = 0.25
+    drop_rate: float = 0.0
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        residual = x
+        y = x
+        mid = in_ch * self.expand
+        if self.expand != 1:
+            y = nn.Conv(mid, (1, 1), use_bias=False)(y)
+            y = Norm(self.norm)(y, train)
+            y = nn.swish(y)
+        y = nn.Conv(mid, (self.kernel, self.kernel),
+                    (self.strides, self.strides), padding="SAME",
+                    feature_group_count=mid, use_bias=False)(y)
+        y = Norm(self.norm)(y, train)
+        y = nn.swish(y)
+        # Squeeze-excite on pre-expansion channel count.
+        se_ch = max(1, int(in_ch * self.se_ratio))
+        s = jnp.mean(y, axis=(1, 2))
+        s = nn.swish(nn.Dense(se_ch)(s))
+        s = nn.sigmoid(nn.Dense(mid)(s))
+        y = y * s[:, None, None, :]
+        y = nn.Conv(self.out_ch, (1, 1), use_bias=False)(y)
+        y = Norm(self.norm)(y, train)
+        if self.strides == 1 and in_ch == self.out_ch:
+            if train and self.drop_rate > 0.0:
+                keep = 1.0 - self.drop_rate
+                rng = self.make_rng("dropout")
+                mask = jax.random.bernoulli(
+                    rng, keep, (y.shape[0], 1, 1, 1)).astype(y.dtype)
+                y = y * mask / keep
+            y = y + residual
+        return y
+
+
+class EfficientNet(nn.Module):
+    """Reference EfficientNet efficientnet.py:138-305 with compound scaling."""
+
+    variant: str = "b0"
+    num_classes: int = 10
+    norm: str = "gn"
+    small_input: bool = True
+    drop_connect_rate: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w_mult, d_mult, _res, dropout = _PARAMS[self.variant]
+        stem_strides = 1 if self.small_input else 2
+        x = nn.Conv(round_filters(32, w_mult), (3, 3),
+                    (stem_strides, stem_strides), padding="SAME",
+                    use_bias=False)(x)
+        x = Norm(self.norm)(x, train)
+        x = nn.swish(x)
+        total_blocks = sum(round_repeats(r, d_mult) for _, _, r, _, _ in _BASE_PLAN)
+        idx = 0
+        for expand, ch, repeats, kernel, stride in _BASE_PLAN:
+            out_ch = round_filters(ch, w_mult)
+            for i in range(round_repeats(repeats, d_mult)):
+                x = MBConv(
+                    expand, out_ch, kernel, stride if i == 0 else 1,
+                    drop_rate=self.drop_connect_rate * idx / total_blocks,
+                    norm=self.norm,
+                )(x, train)
+                idx += 1
+        x = nn.Conv(round_filters(1280, w_mult), (1, 1), use_bias=False)(x)
+        x = Norm(self.norm)(x, train)
+        x = nn.swish(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("efficientnet")
+def efficientnet(num_classes: int = 10, variant: str = "b0", norm: str = "gn",
+                 small_input: bool = True, drop_connect_rate: float = 0.2, **_):
+    return EfficientNet(variant=variant, num_classes=num_classes, norm=norm,
+                        small_input=small_input,
+                        drop_connect_rate=drop_connect_rate)

@@ -1,0 +1,87 @@
+"""MNIST GAN (MLP generator + discriminator) for FedGAN.
+
+Parity target: reference fedml_api/model/cv/mnist_gan.py:6-65 —
+Generator 100→128→256→512→1024→784 with LeakyReLU(0.2)+BatchNorm1d and tanh
+output reshaped to [B,1,28,28]; Discriminator 784→512→256→1 with
+LeakyReLU(0.2); MNIST_gan wrapper holding both nets (the FedGAN aggregator
+averages the two state_dicts jointly, fedgan/FedGANAggregator.py:73-81).
+
+TPU-first deviations:
+- NHWC: generator emits [B,28,28,1].
+- The discriminator returns **logits** (no terminal sigmoid, reference
+  mnist_gan.py:46) — pair with ``optax.sigmoid_binary_cross_entropy`` for
+  numerically stable training; callers wanting probabilities apply
+  ``jax.nn.sigmoid`` themselves.
+- LayerNorm instead of BatchNorm1d by default: per-client generator batch
+  stats are an FL pathology (same rationale as resnet.py) and LayerNorm is
+  the standard JAX GAN choice. ``norm='bn'`` restores strict parity.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.registry import register_model
+
+
+def _norm1d(kind: str, x, train: bool):
+    if kind == "bn":
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+    return nn.LayerNorm()(x)
+
+
+class Generator(nn.Module):
+    input_size: int = 100
+    out_pixels: int = 784
+    norm: str = "ln"
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        x = nn.leaky_relu(nn.Dense(128)(z), 0.2)
+        for width in (256, 512, 1024):
+            x = nn.Dense(width)(x)
+            x = _norm1d(self.norm, x, train)
+            x = nn.leaky_relu(x, 0.2)
+        x = jnp.tanh(nn.Dense(self.out_pixels)(x))
+        side = int(self.out_pixels ** 0.5)
+        return x.reshape(z.shape[0], side, side, 1)
+
+
+class Discriminator(nn.Module):
+    input_size: int = 784
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape(x.shape[0], -1)
+        x = nn.leaky_relu(nn.Dense(512)(x), 0.2)
+        x = nn.leaky_relu(nn.Dense(256)(x), 0.2)
+        return nn.Dense(1)(x)  # logits
+
+
+class MNISTGan(nn.Module):
+    """Two-net wrapper (reference MNIST_gan :55-65). Calling it runs the
+    full G→D pass so one ``init`` yields the joint params pytree with
+    ``netg``/``netd`` submodule keys — the unit FedGAN aggregates."""
+
+    latent_dim: int = 100
+    norm: str = "ln"
+
+    def setup(self):
+        self.netg = Generator(input_size=self.latent_dim, norm=self.norm)
+        self.netd = Discriminator()
+
+    def __call__(self, z, train: bool = False):
+        fake = self.netg(z, train)
+        return self.netd(fake, train)
+
+    def generate(self, z, train: bool = False):
+        return self.netg(z, train)
+
+    def discriminate(self, x, train: bool = False):
+        return self.netd(x, train)
+
+
+@register_model("mnist_gan")
+def mnist_gan(latent_dim: int = 100, norm: str = "ln", **_):
+    return MNISTGan(latent_dim=latent_dim, norm=norm)

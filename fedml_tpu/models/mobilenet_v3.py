@@ -1,0 +1,139 @@
+"""MobileNetV3 LARGE/SMALL (Howard et al. 2019) in flax.
+
+Parity target: reference fedml_api/model/cv/mobilenet_v3.py:35-257
+(h-swish/h-sigmoid activations, squeeze-excite blocks, per-stage
+(kernel, expand, out, nonlinearity, SE, stride) plans for LARGE and SMALL).
+
+TPU-first: NHWC, GroupNorm default (``norm='bn'`` for parity), depthwise
+convs via ``feature_group_count`` so XLA lowers them onto the MXU as
+grouped contractions.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.registry import register_model
+from fedml_tpu.models.resnet import Norm
+
+
+def h_sigmoid(x):
+    """relu6(x + 3) / 6 (reference mobilenet_v3.py:35-41)."""
+    return nn.relu6(x + 3.0) / 6.0
+
+
+def h_swish(x):
+    """x * h_sigmoid(x) (reference mobilenet_v3.py:44-50)."""
+    return x * h_sigmoid(x)
+
+
+class SqueezeExcite(nn.Module):
+    """SE block with divide-4 bottleneck (reference SqueezeBlock :64-81)."""
+
+    divide: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(c // self.divide)(s))
+        s = h_sigmoid(nn.Dense(c)(s))
+        return x * s[:, None, None, :]
+
+
+class MobileBlock(nn.Module):
+    """Inverted residual: expand 1x1 -> depthwise kxk -> (SE) -> project 1x1
+    (reference MobileBlock :84-135)."""
+
+    kernel: int
+    expand: int
+    out_ch: int
+    strides: int
+    use_se: bool
+    act: str  # "RE" relu | "HS" h-swish
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        nonlin = nn.relu if self.act == "RE" else h_swish
+        residual = x
+        y = nn.Conv(self.expand, (1, 1), use_bias=False)(x)
+        y = Norm(self.norm)(y, train)
+        y = nonlin(y)
+        y = nn.Conv(
+            self.expand, (self.kernel, self.kernel),
+            (self.strides, self.strides), padding="SAME",
+            feature_group_count=self.expand, use_bias=False,
+        )(y)
+        y = Norm(self.norm)(y, train)
+        if self.use_se:
+            y = SqueezeExcite()(y)
+        y = nonlin(y)
+        y = nn.Conv(self.out_ch, (1, 1), use_bias=False)(y)
+        y = Norm(self.norm)(y, train)
+        if self.strides == 1 and residual.shape[-1] == self.out_ch:
+            y = y + residual
+        return y
+
+
+# (kernel, expand, out, act, SE, stride) — reference mobilenet_v3.py:150-189.
+_LARGE: Sequence[Tuple] = (
+    (3, 16, 16, "RE", False, 1), (3, 64, 24, "RE", False, 2),
+    (3, 72, 24, "RE", False, 1), (5, 72, 40, "RE", True, 2),
+    (5, 120, 40, "RE", True, 1), (5, 120, 40, "RE", True, 1),
+    (3, 240, 80, "HS", False, 2), (3, 200, 80, "HS", False, 1),
+    (3, 184, 80, "HS", False, 1), (3, 184, 80, "HS", False, 1),
+    (3, 480, 112, "HS", True, 1), (3, 672, 112, "HS", True, 1),
+    (5, 672, 160, "HS", True, 1), (5, 672, 160, "HS", True, 2),
+    (5, 960, 160, "HS", True, 1),
+)
+_SMALL: Sequence[Tuple] = (
+    (3, 16, 16, "RE", True, 2), (3, 72, 24, "RE", False, 2),
+    (3, 88, 24, "RE", False, 1), (5, 96, 40, "RE", True, 2),
+    (5, 240, 40, "RE", True, 1), (5, 240, 40, "RE", True, 1),
+    (5, 120, 48, "HS", True, 1), (5, 144, 48, "HS", True, 1),
+    (5, 288, 96, "HS", True, 2), (5, 576, 96, "HS", True, 1),
+    (5, 576, 96, "HS", True, 1),
+)
+
+
+class MobileNetV3(nn.Module):
+    """Reference MobileNetV3 :137-257. ``small_input`` keeps stride-1 stem
+    for 32x32 federated CIFAR inputs."""
+
+    model_mode: str = "LARGE"
+    num_classes: int = 10
+    norm: str = "gn"
+    dropout_rate: float = 0.2
+    small_input: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        plan = _LARGE if self.model_mode.upper() == "LARGE" else _SMALL
+        last_expand = 960 if self.model_mode.upper() == "LARGE" else 576
+        stem_strides = 1 if self.small_input else 2
+        x = nn.Conv(16, (3, 3), (stem_strides, stem_strides),
+                    padding="SAME", use_bias=False)(x)
+        x = Norm(self.norm)(x, train)
+        x = h_swish(x)
+        for k, e, o, act, se, s in plan:
+            x = MobileBlock(k, e, o, s, se, act, self.norm)(x, train)
+        x = nn.Conv(last_expand, (1, 1), use_bias=False)(x)
+        x = Norm(self.norm)(x, train)
+        x = h_swish(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = h_swish(nn.Dense(1280)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("mobilenet_v3")
+def mobilenet_v3(num_classes: int = 10, model_mode: str = "LARGE",
+                 norm: str = "gn", small_input: bool = True,
+                 dropout_rate: float = 0.2, **_):
+    return MobileNetV3(model_mode=model_mode, num_classes=num_classes,
+                       norm=norm, small_input=small_input,
+                       dropout_rate=dropout_rate)

@@ -25,10 +25,14 @@ def create_model(name: str, **kwargs):
         # Import side-effect registration of the full zoo. Keep this list in
         # sync with the modules that exist — import errors must propagate.
         import fedml_tpu.models.cnn  # noqa: F401
+        import fedml_tpu.models.efficientnet  # noqa: F401
+        import fedml_tpu.models.gan  # noqa: F401
         import fedml_tpu.models.lr  # noqa: F401
         import fedml_tpu.models.mobilenet  # noqa: F401
+        import fedml_tpu.models.mobilenet_v3  # noqa: F401
         import fedml_tpu.models.resnet  # noqa: F401
         import fedml_tpu.models.rnn  # noqa: F401
+        import fedml_tpu.models.vgg  # noqa: F401
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
     return _REGISTRY[name](**kwargs)

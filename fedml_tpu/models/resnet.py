@@ -42,7 +42,14 @@ class Norm(nn.Module):
         if self.kind == "bn":
             return nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
         c = x.shape[-1]
-        return nn.GroupNorm(num_groups=min(self.groups, c))(x)
+        # num_groups must divide the channel count: largest divisor of c
+        # that is <= self.groups (reference group_normalization.py defaults
+        # to 32 ch/group on power-of-two widths; MobileNetV3/EfficientNet
+        # widths like 72/88/200 need the divisor search).
+        g = min(self.groups, c)
+        while c % g:
+            g -= 1
+        return nn.GroupNorm(num_groups=g)(x)
 
 
 class BottleneckBlock(nn.Module):
@@ -177,3 +184,13 @@ def resnet34_gn(num_classes: int = 100, **_):
 @register_model("resnet50_gn")
 def resnet50_gn(num_classes: int = 100, **_):
     return ResNetGN(stage_sizes=(3, 4, 6, 3), block="bottleneck", num_classes=num_classes)
+
+
+@register_model("resnet101_gn")
+def resnet101_gn(num_classes: int = 100, **_):
+    return ResNetGN(stage_sizes=(3, 4, 23, 3), block="bottleneck", num_classes=num_classes)
+
+
+@register_model("resnet152_gn")
+def resnet152_gn(num_classes: int = 100, **_):
+    return ResNetGN(stage_sizes=(3, 8, 36, 3), block="bottleneck", num_classes=num_classes)

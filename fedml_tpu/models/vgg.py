@@ -1,0 +1,72 @@
+"""VGG 11/13/16/19 (± normalization) for federated CV workloads.
+
+Parity target: reference fedml_api/model/cv/vgg.py:13-158 (torchvision-style
+VGG with per-depth conv configs and optional BatchNorm).
+
+TPU-first deviations (documented, deliberate):
+- NHWC layout, GroupNorm default (``norm='bn'`` available for strict parity;
+  see fedml_tpu/models/resnet.py for the FL-BatchNorm rationale).
+- The reference flattens a 7x7 adaptive pool into a 512*7*7 -> 4096 dense
+  stack (vgg.py:24-33) — 102M params that exist only for 224x224 ImageNet
+  inputs. Here we global-average-pool then Dense(4096)x2, which keeps the
+  classifier capacity structure while staying shape-polymorphic over input
+  resolution (CIFAR 32x32 federated workloads reach the pool at 1x1 anyway).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.registry import register_model
+from fedml_tpu.models.resnet import Norm
+
+# Per-depth conv plans, 'M' = 2x2 max-pool (reference vgg.py:69-79 cfgs A/B/D/E).
+_CFGS = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    13: (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512, 512,
+         "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]]
+    num_classes: int = 10
+    norm: str = ""  # "" (plain, = reference vgg1x), "gn", or "bn" (vgg1x_bn)
+    classifier_width: int = 4096
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(v), (3, 3), padding="SAME")(x)
+                if self.norm:
+                    x = Norm(self.norm)(x, train)
+                x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        for _ in range(2):
+            x = nn.Dense(self.classifier_width)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def _make(depth: int, norm: str):
+    def ctor(num_classes: int = 10, classifier_width: int = 4096, **_):
+        return VGG(cfg=_CFGS[depth], num_classes=num_classes, norm=norm,
+                   classifier_width=classifier_width)
+    return ctor
+
+
+for _d in (11, 13, 16, 19):
+    register_model(f"vgg{_d}")(_make(_d, ""))
+    register_model(f"vgg{_d}_bn")(_make(_d, "bn"))
+    register_model(f"vgg{_d}_gn")(_make(_d, "gn"))

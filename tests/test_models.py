@@ -15,6 +15,10 @@ from fedml_tpu.trainer.local import model_fns
         ("cnn", dict(num_classes=62, dropout=False), (2, 28, 28, 1), 62),
         ("resnet20", dict(num_classes=10), (2, 32, 32, 3), 10),
         ("resnet18_gn", dict(num_classes=100), (2, 32, 32, 3), 100),
+        ("vgg11", dict(num_classes=10, classifier_width=64), (2, 32, 32, 3), 10),
+        ("vgg11_gn", dict(num_classes=10, classifier_width=64), (2, 32, 32, 3), 10),
+        ("mobilenet_v3", dict(num_classes=10, model_mode="SMALL"), (2, 32, 32, 3), 10),
+        ("efficientnet", dict(num_classes=10, variant="b0"), (2, 32, 32, 3), 10),
     ],
 )
 def test_model_forward_shapes(name, kwargs, shape, classes):
@@ -37,6 +41,21 @@ def test_resnet56_param_scale():
     net = fns.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(net.params))
     assert 3e5 < n_params < 2e6
+
+
+def test_mnist_gan_shapes():
+    """Generator [B,100]→[B,28,28,1] tanh range; discriminator → [B,1] logits
+    (reference model/cv/mnist_gan.py:6-65)."""
+    model = create_model("mnist_gan")
+    z = jnp.zeros((4, 100), jnp.float32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, z, train=False)
+    fake = model.apply(variables, z, train=False, method=model.generate)
+    assert fake.shape == (4, 28, 28, 1)
+    assert np.abs(np.asarray(fake)).max() <= 1.0
+    logits = model.apply(variables, fake, train=False, method=model.discriminate)
+    assert logits.shape == (4, 1)
+    # joint params pytree contains both nets (FedGAN aggregates them jointly)
+    assert {"netg", "netd"} <= set(variables["params"].keys())
 
 
 def test_bn_variant_carries_batch_stats():
