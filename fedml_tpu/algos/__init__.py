@@ -2,6 +2,7 @@ from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.centralized import CentralizedTrainer
 from fedml_tpu.algos.decentralized import DecentralizedAPI
 from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.algos.fedgan import FedGanAPI
 from fedml_tpu.algos.fednova import FedNovaAPI
 from fedml_tpu.algos.fedopt import FedOptAPI
 from fedml_tpu.algos.fedprox import FedProxAPI
@@ -13,6 +14,7 @@ __all__ = [
     "CentralizedTrainer",
     "DecentralizedAPI",
     "FedAvgAPI",
+    "FedGanAPI",
     "FedNovaAPI",
     "FedOptAPI",
     "FedProxAPI",

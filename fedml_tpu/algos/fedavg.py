@@ -20,8 +20,7 @@ import numpy as np
 
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.loop import FederatedLoop
-from fedml_tpu.core.sampling import pad_to_multiple, sample_clients
-from fedml_tpu.data.batching import FederatedArrays, gather_clients
+from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
 from fedml_tpu.trainer.local import (
     make_client_optimizer,
@@ -98,22 +97,10 @@ class FedAvgAPI(FederatedLoop):
         return None
 
     # ----------------------------------------------------------------------
-    def sample_round(self, round_idx: int):
-        """Reference-seeded sampling + padding to the shard-count multiple."""
-        idx = sample_clients(
-            round_idx, self.cfg.client_num_in_total, self.cfg.client_num_per_round
-        )
-        idx, wmask = pad_to_multiple(idx, self.n_shards)
-        return idx, wmask
+    # sample_round/run_round come from FederatedLoop (shared scaffold).
 
     def train_one_round(self, round_idx: int) -> Dict[str, float]:
-        idx, wmask = self.sample_round(round_idx)
-        sub = gather_clients(self.train_fed, idx)
-        weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
-        self.rng, rnd_rng = jax.random.split(self.rng)
-        avg, loss = self.round_fn(
-            self.net, sub.x, sub.y, sub.mask, weights, weights, rnd_rng
-        )
+        avg, loss = self.run_round(round_idx)
         self.net = self._server_update(self.net, avg)
         return {"round": round_idx, "train_loss": float(loss)}
 

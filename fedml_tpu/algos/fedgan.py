@@ -1,0 +1,200 @@
+"""FedGAN — federated averaging over a generator+discriminator pair.
+
+Parity targets:
+- Local GAN training (reference fedml_api/distributed/fedgan/
+  MyModelTrainer.py:32-71): per batch, one Adam discriminator step on
+  BCE(real,1)+BCE(fake,0), then one Adam generator step on BCE(D(G(z)),1);
+  optimizers recreated each round.
+- Joint aggregation of both nets (reference FedGANAggregator.py:58-88, the
+  doubly-nested weighted average over ``{'netg':…, 'netd':…}``): here the two
+  nets live in ONE params pytree so the standard weighted tree-mean of the
+  FedAvg round machinery already aggregates them jointly.
+
+TPU-first: the per-net optimizer split is ``optax.masked`` over the
+``netg``/``netd`` subtrees (no Python-level parameter groups); the whole
+local loop is a ``lax.scan`` vmapped over clients like every other
+algorithm. The discriminator emits logits and losses use
+``sigmoid_binary_cross_entropy`` (see fedml_tpu/models/gan.py docstring).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.algos.loop import FederatedLoop
+from fedml_tpu.core.tree import tree_select
+from fedml_tpu.trainer.local import NetState
+
+
+def _apply(module, net: NetState, method, *args, train: bool):
+    """module.apply with mutable-collection plumbing (BN variant support)."""
+    variables = {"params": net.params, **net.model_state}
+    if train and net.model_state:
+        out, new_state = module.apply(
+            variables, *args, train=train, method=method,
+            mutable=list(net.model_state.keys()),
+        )
+        return out, dict(new_state)
+    out = module.apply(variables, *args, train=train, method=method)
+    return out, net.model_state
+
+
+def make_gan_local_train(module, lr: float, local_epochs: int,
+                         latent_dim: int = 100):
+    """Build ``local_train(net, x, y, mask, rng) -> (net', mean_loss)`` with
+    the round-fn signature shared by all algorithms (``y`` is unused — GANs
+    are unsupervised; ``mask [S,B]`` gates padded samples out of both
+    losses). Reported loss is d_loss + g_loss, mean over steps."""
+
+    def bce(logits, target):  # target ∈ {0., 1.}
+        return optax.sigmoid_binary_cross_entropy(
+            logits[:, 0], jnp.full(logits.shape[:1], target))
+
+    # NOTE: optax.masked is wrong here — it passes masked-out leaves' raw
+    # gradients through as updates (gradient ascent on the frozen net!);
+    # multi_transform + set_to_zero freezes them properly.
+    opt_d = optax.multi_transform(
+        {"train": optax.adam(lr), "freeze": optax.set_to_zero()},
+        {"netg": "freeze", "netd": "train"},
+    )
+    opt_g = optax.multi_transform(
+        {"train": optax.adam(lr), "freeze": optax.set_to_zero()},
+        {"netg": "train", "netd": "freeze"},
+    )
+
+    def local_train(net: NetState, x, y, mask, rng):
+        del y
+        d_state = opt_d.init(net.params)
+        g_state = opt_g.init(net.params)
+
+        def step(carry, inputs):
+            net, d_state, g_state, rng = carry
+            xb, mb = inputs
+            rng, zd, zg = jax.random.split(rng, 3)
+            nb = jnp.maximum(jnp.sum(mb), 1.0)
+
+            def d_loss_fn(p):
+                n = NetState(p, net.model_state)
+                real_logits, state1 = _apply(
+                    module, n, module.discriminate, xb, train=True)
+                noise = jax.random.normal(zd, (xb.shape[0], latent_dim))
+                fake, state2 = _apply(
+                    module, NetState(p, state1), module.generate, noise,
+                    train=True)
+                # The netg gradients would be frozen by opt_d anyway;
+                # stop_gradient skips the generator backward pass entirely.
+                fake = jax.lax.stop_gradient(fake)
+                fake_logits, state3 = _apply(
+                    module, NetState(p, state2), module.discriminate, fake,
+                    train=True)
+                per = bce(real_logits, 1.0) + bce(fake_logits, 0.0)
+                return jnp.sum(per * mb) / nb, state3
+
+            (d_loss, state_d), d_grads = jax.value_and_grad(
+                d_loss_fn, has_aux=True)(net.params)
+            d_updates, new_d_state = opt_d.update(d_grads, d_state, net.params)
+            p_after_d = optax.apply_updates(net.params, d_updates)
+
+            def g_loss_fn(p):
+                n = NetState(p, state_d)
+                noise = jax.random.normal(zg, (xb.shape[0], latent_dim))
+                fake, state1 = _apply(module, n, module.generate, noise,
+                                      train=True)
+                fake_logits, state2 = _apply(
+                    module, NetState(p, state1), module.discriminate, fake,
+                    train=True)
+                per = bce(fake_logits, 1.0)
+                return jnp.sum(per * mb) / nb, state2
+
+            (g_loss, new_model_state), g_grads = jax.value_and_grad(
+                g_loss_fn, has_aux=True)(p_after_d)
+            g_updates, new_g_state = opt_g.update(g_grads, g_state, p_after_d)
+            new_params = optax.apply_updates(p_after_d, g_updates)
+
+            nonempty = jnp.sum(mb) > 0
+            new_net = NetState(new_params, new_model_state)
+            net = tree_select(nonempty, new_net, net)
+            d_state = tree_select(nonempty, new_d_state, d_state)
+            g_state = tree_select(nonempty, new_g_state, g_state)
+            return (net, d_state, g_state, rng), (d_loss + g_loss, jnp.sum(mb))
+
+        n_steps, batch = x.shape[0], x.shape[1]
+
+        def epoch(carry, epoch_rng):
+            # Per-epoch reshuffle, same padding-to-tail scheme as
+            # make_local_train_fn (DataLoader(shuffle=True) semantics).
+            flat_mask = mask.reshape(n_steps * batch)
+            keys = jax.random.uniform(epoch_rng, (n_steps * batch,))
+            perm = jnp.argsort(keys + (1.0 - flat_mask) * 2.0)
+
+            def reshuffle(a):
+                flat = a.reshape((n_steps * batch,) + a.shape[2:])
+                return jnp.take(flat, perm, axis=0).reshape(a.shape)
+
+            carry, (losses, ns) = jax.lax.scan(
+                step, carry, (reshuffle(x), reshuffle(mask)))
+            return carry, jnp.sum(losses * ns) / jnp.maximum(jnp.sum(ns), 1.0)
+
+        rng, shuffle_rng = jax.random.split(rng)
+        (net, _, _, _), epoch_losses = jax.lax.scan(
+            epoch, (net, d_state, g_state, rng),
+            jax.random.split(shuffle_rng, local_epochs))
+        return net, jnp.mean(epoch_losses)
+
+    return local_train
+
+
+class FedGanAPI(FederatedLoop):
+    """Federated GAN trainer (reference FedGanAPI.py + FedGANAggregator.py).
+
+    Unlike the classifier APIs the model is initialized from latent noise
+    (``[B, latent_dim]``), so this does not subclass FedAvgAPI — it reuses
+    the shared round scaffold (FederatedLoop.run_round: vmap/shard_map +
+    weighted tree-mean) with a GAN-specific local step. ``train_fed.y`` is
+    ignored; GANs have no accuracy eval (the reference logs only losses)."""
+
+    def __init__(self, model, train_fed, cfg, mesh=None, latent_dim: int = 100):
+        from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
+
+        self.module = model
+        self.cfg = cfg
+        self.mesh = mesh
+        self.train_fed = train_fed
+        self.test_global = None
+        self.latent_dim = latent_dim
+
+        local_train = make_gan_local_train(model, cfg.lr, cfg.epochs, latent_dim)
+        if mesh is None:
+            self.n_shards = 1
+            round_fn = make_vmap_round(local_train)
+        else:
+            self.n_shards = int(mesh.shape[mesh.axis_names[0]])
+            round_fn = make_sharded_round(local_train, mesh, mesh.axis_names[0])
+        self.round_fn = jax.jit(round_fn)
+
+        rng = jax.random.PRNGKey(cfg.seed)
+        self.rng, init_rng = jax.random.split(rng)
+        z = jnp.zeros((int(train_fed.x.shape[2]), latent_dim), jnp.float32)
+        variables = model.init({"params": init_rng}, z, train=False)
+        params = variables["params"]
+        state = {k: v for k, v in variables.items() if k != "params"}
+        self.net = NetState(params=params, model_state=state)
+
+    def train_one_round(self, round_idx: int):
+        avg, loss = self.run_round(round_idx)
+        self.net = avg
+        return {"round": round_idx, "train_loss": float(loss)}
+
+    def evaluate(self):
+        return {}
+
+    def generate(self, n: int, rng=None):
+        """Sample n images from the current global generator."""
+        if rng is None:
+            self.rng, rng = jax.random.split(self.rng)
+        z = jax.random.normal(rng, (n, self.latent_dim))
+        imgs, _ = _apply(self.module, self.net, self.module.generate, z,
+                         train=False)
+        return imgs

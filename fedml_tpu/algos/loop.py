@@ -10,16 +10,46 @@ from __future__ import annotations
 
 from typing import Dict, List
 
+import jax
+import jax.numpy as jnp
+
 
 class FederatedLoop:
     """Mixin. Subclasses provide ``cfg``, ``train_one_round(round_idx)``,
-    ``eval_fn``, ``test_global``, and ``_eval_net()``."""
+    ``eval_fn``, ``test_global``, and ``_eval_net()``. Subclasses that also
+    provide ``n_shards``, ``train_fed``, ``net``, ``rng`` and ``round_fn``
+    get the shared round scaffold (``sample_round``/``run_round``) for free."""
 
     def _eval_net(self):
         raise NotImplementedError
 
     def train_one_round(self, round_idx: int) -> Dict[str, float]:
         raise NotImplementedError
+
+    def sample_round(self, round_idx: int):
+        """Reference-seeded sampling + padding to the shard-count multiple
+        (FedAVGAggregator.client_sampling, FedAVGAggregator.py:90-99)."""
+        from fedml_tpu.core.sampling import pad_to_multiple, sample_clients
+
+        idx = sample_clients(
+            round_idx, self.cfg.client_num_in_total, self.cfg.client_num_per_round
+        )
+        idx, wmask = pad_to_multiple(idx, self.n_shards)
+        return idx, wmask
+
+    def run_round(self, round_idx: int):
+        """One sampled round through ``round_fn``: gather client shards,
+        sample-count weights (padded slots weight 0), fresh round rng.
+        Returns ``(avg_net, mean_loss)`` without touching ``self.net``."""
+        from fedml_tpu.data.batching import gather_clients
+
+        idx, wmask = self.sample_round(round_idx)
+        sub = gather_clients(self.train_fed, idx)
+        weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
+        self.rng, rnd_rng = jax.random.split(self.rng)
+        return self.round_fn(
+            self.net, sub.x, sub.y, sub.mask, weights, weights, rnd_rng
+        )
 
     def evaluate(self) -> Dict[str, float]:
         if self.test_global is None:

@@ -112,8 +112,11 @@ class MobileNetV3(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        plan = _LARGE if self.model_mode.upper() == "LARGE" else _SMALL
-        last_expand = 960 if self.model_mode.upper() == "LARGE" else 576
+        mode = self.model_mode.upper()
+        if mode not in ("LARGE", "SMALL"):
+            raise ValueError(f"model_mode must be LARGE or SMALL, got {mode!r}")
+        plan = _LARGE if mode == "LARGE" else _SMALL
+        last_expand = 960 if mode == "LARGE" else 576
         stem_strides = 1 if self.small_input else 2
         x = nn.Conv(16, (3, 3), (stem_strides, stem_strides),
                     padding="SAME", use_bias=False)(x)

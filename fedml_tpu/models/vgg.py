@@ -59,10 +59,13 @@ class VGG(nn.Module):
         return nn.Dense(self.num_classes)(x)
 
 
-def _make(depth: int, norm: str):
-    def ctor(num_classes: int = 10, classifier_width: int = 4096, **_):
-        return VGG(cfg=_CFGS[depth], num_classes=num_classes, norm=norm,
-                   classifier_width=classifier_width)
+def _make(depth: int, default_norm: str):
+    def ctor(num_classes: int = 10, classifier_width: int = 4096,
+             norm: str = None, dropout_rate: float = 0.5, **_):
+        return VGG(cfg=_CFGS[depth], num_classes=num_classes,
+                   norm=default_norm if norm is None else norm,
+                   classifier_width=classifier_width,
+                   dropout_rate=dropout_rate)
     return ctor
 
 
