@@ -155,9 +155,11 @@ class FedGanAPI(FederatedLoop):
     weighted tree-mean) with a GAN-specific local step. ``train_fed.y`` is
     ignored; GANs have no accuracy eval (the reference logs only losses)."""
 
-    def __init__(self, model, train_fed, cfg, mesh=None, latent_dim: int = 100):
+    def __init__(self, model, train_fed, cfg, mesh=None, latent_dim: int = None):
         from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
 
+        if latent_dim is None:
+            latent_dim = getattr(model, "latent_dim", 100)
         self.module = model
         self.cfg = cfg
         self.mesh = mesh

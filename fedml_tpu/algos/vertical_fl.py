@@ -1,0 +1,146 @@
+"""Classical vertical (feature-partitioned) federated learning.
+
+Parity target: reference fedml_api/standalone/classical_vertical_fl/ +
+fedml_api/distributed/classical_vertical_fl/ —
+- the guest holds the labels and a feature slice; each host holds only a
+  feature slice (vfl.py:1-40, party_models.py:12);
+- per batch, every party runs its local extractor + linear head and sends
+  its logit contribution to the guest (host_trainer.py:43);
+- the guest sums the contributions, computes the sigmoid-BCE loss and the
+  **common gradient** dL/dlogit, and returns it; every party backprops the
+  common gradient through its own nets and steps SGD(momentum 0.9, wd 0.01)
+  (guest_trainer._compute_common_gradient_and_loss party_models.py:57,
+  _bp_classifier guest_trainer.py:113).
+
+TPU-native: each party's forward is a separate ``jax.vjp`` — the pulled-back
+cotangent IS the common gradient of the wire protocol, so simulation math
+equals the distributed protocol exactly. All parties' updates happen in one
+jit per batch; cross-silo deployment moves the logit/cotangent arrays onto
+fedml_tpu.comm messages without touching the math.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.models.vfl import VFLDenseModel, VFLLocalModel
+
+
+class VflParty:
+    """One party's stacked (local extractor → dense head) pair."""
+
+    def __init__(self, feature_dim: int, rep_dim: int, use_bias: bool, rng):
+        self.local = VFLLocalModel(output_dim=rep_dim)
+        self.dense = VFLDenseModel(output_dim=1, use_bias=use_bias)
+        r1, r2 = jax.random.split(rng)
+        x = jnp.zeros((1, feature_dim), jnp.float32)
+        self.params = {
+            "local": self.local.init(r1, x)["params"],
+            "dense": self.dense.init(
+                r2, jnp.zeros((1, rep_dim), jnp.float32))["params"],
+        }
+
+    def forward(self, params, x):
+        rep = self.local.apply({"params": params["local"]}, x)
+        return self.dense.apply({"params": params["dense"]}, rep)
+
+
+class VflAPI:
+    """Two-or-more-party VFL with a logistic top (reference
+    VerticalMultiplePartyLogisticRegressionFederatedLearning, vfl.py:1).
+
+    ``x_parties``: list of per-party feature matrices ``[N, d_p]`` with the
+    guest first; ``y``: binary labels ``[N]`` held by the guest only."""
+
+    def __init__(self, feature_dims: Sequence[int], rep_dim: int = 32,
+                 lr: float = 0.01, seed: int = 0):
+        rng = jax.random.PRNGKey(seed)
+        rngs = jax.random.split(rng, len(feature_dims))
+        # Guest keeps the bias; hosts don't (party_models.py builds guest
+        # DenseModel with bias and host without, so the sum has one bias).
+        self.parties: List[VflParty] = [
+            VflParty(d, rep_dim, use_bias=(i == 0), rng=rngs[i])
+            for i, d in enumerate(feature_dims)
+        ]
+        # Reference SGD(momentum=0.9, weight_decay=0.01)
+        # (vfl_models_standalone.py:13).
+        self.opt = optax.chain(
+            optax.add_decayed_weights(0.01), optax.sgd(lr, momentum=0.9))
+        self.opt_states = [self.opt.init(p.params) for p in self.parties]
+        self._step = jax.jit(self._build_step())
+        self._predict = jax.jit(self._build_predict())
+
+    def _build_step(self):
+        parties, opt = self.parties, self.opt
+
+        def step(params_list, opt_list, xs, y):
+            # Party-local forwards, each with its own VJP (the protocol's
+            # send-logit / receive-common-gradient pair).
+            logits, vjps = [], []
+            for party, p, x in zip(parties, params_list, xs):
+                out, vjp = jax.vjp(lambda pp, px=x, pt=party: pt.forward(pp, px), p)
+                logits.append(out)
+                vjps.append(vjp)
+            total = sum(logits)[:, 0]
+            # Guest: loss + common gradient.
+            loss = jnp.mean(optax.sigmoid_binary_cross_entropy(total, y))
+            common_grad = ((jax.nn.sigmoid(total) - y) /
+                           y.shape[0])[:, None]  # dL/dlogit
+            new_params, new_opts = [], []
+            for p, vjp, st in zip(params_list, vjps, opt_list):
+                (grads,) = vjp(common_grad)
+                updates, st2 = opt.update(grads, st, p)
+                new_params.append(optax.apply_updates(p, updates))
+                new_opts.append(st2)
+            return new_params, new_opts, loss
+
+        return step
+
+    def _build_predict(self):
+        parties = self.parties
+
+        def predict(params_list, xs):
+            total = sum(
+                party.forward(p, x)
+                for party, p, x in zip(parties, params_list, xs))[:, 0]
+            return jax.nn.sigmoid(total)
+
+        return predict
+
+    def fit(self, x_parties: Sequence[np.ndarray], y: np.ndarray,
+            epochs: int = 5, batch_size: int = 64) -> List[float]:
+        """Mirrors vfl.py fit(): epoch × batch loop over aligned samples."""
+        n = len(y)
+        params = [p.params for p in self.parties]
+        opts = self.opt_states
+        losses = []
+        # Residual partial batch included (reference vfl_fixture.py:41-45
+        # computes N//bs + 1 batches when N % bs != 0). The short batch is
+        # one extra jit trace, reused every epoch.
+        steps = max(1, (n + batch_size - 1) // batch_size)
+        for _ in range(epochs):
+            for s in range(steps):
+                sl = slice(s * batch_size, min(n, (s + 1) * batch_size))
+                xs = [jnp.asarray(x[sl]) for x in x_parties]
+                params, opts, loss = self._step(
+                    params, opts, xs, jnp.asarray(y[sl], jnp.float32))
+                losses.append(float(loss))
+        for p, new in zip(self.parties, params):
+            p.params = new
+        self.opt_states = opts
+        return losses
+
+    def predict(self, x_parties: Sequence[np.ndarray]) -> np.ndarray:
+        params = [p.params for p in self.parties]
+        xs = [jnp.asarray(x) for x in x_parties]
+        return np.asarray(self._predict(params, xs))
+
+    def evaluate(self, x_parties, y) -> Dict[str, float]:
+        prob = self.predict(x_parties)
+        acc = float(np.mean((prob > 0.5).astype(np.int32) == y))
+        return {"accuracy": acc}

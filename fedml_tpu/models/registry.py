@@ -31,7 +31,9 @@ def create_model(name: str, **kwargs):
         import fedml_tpu.models.mobilenet  # noqa: F401
         import fedml_tpu.models.mobilenet_v3  # noqa: F401
         import fedml_tpu.models.resnet  # noqa: F401
+        import fedml_tpu.models.resnet_split  # noqa: F401
         import fedml_tpu.models.rnn  # noqa: F401
+        import fedml_tpu.models.vfl  # noqa: F401
         import fedml_tpu.models.vgg  # noqa: F401
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")

@@ -1,0 +1,94 @@
+"""Split ResNet-56 pair for FedGKT / split learning.
+
+Parity targets (reference fedml_api/model/cv/resnet56_gkt/):
+- Client stump (resnet_client.py:112-247): conv1(3→16,3x3,s1)+norm+relu
+  emits the **extracted features** [B,32,32,16]; then layer1 (16-planes
+  blocks) → global avgpool → fc gives the client's own logits. Returns
+  ``(logits, features)`` — features go to the server, logits feed the KL
+  distillation loss. Variants ``resnet5_56`` (BasicBlock [1]) and
+  ``resnet8_56`` (Bottleneck [2]) mirror :206,:230 (the reference's layers
+  lists have extra entries its forward never uses — only layer1 runs).
+- Server tail (resnet_server.py:113-199): takes the 16-channel features,
+  runs layer1(16)/layer2(32,s2)/layer3(64,s2) → avgpool → fc.
+  ``resnet56_server`` = Bottleneck [6,6,6] (:200); ``resnet110_server`` =
+  Bottleneck [12,12,12].
+
+TPU-first: NHWC, GroupNorm default (``norm='bn'`` for parity), shared
+block implementations from fedml_tpu.models.resnet.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.registry import register_model
+from fedml_tpu.models.resnet import BasicBlock, BottleneckBlock, Norm
+
+
+class ResNetClientStump(nn.Module):
+    """Bottom-of-the-split client net: features + local logits."""
+
+    n_blocks: int = 1
+    block: str = "basic"
+    num_classes: int = 10
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        x = Norm(self.norm)(x, train)
+        x = nn.relu(x)
+        features = x  # B x 32 x 32 x 16 — crosses the split boundary
+        blk = BasicBlock if self.block == "basic" else BottleneckBlock
+        for _ in range(self.n_blocks):
+            x = blk(16, 1, self.norm)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(self.num_classes)(x)
+        return logits, features
+
+
+class ResNetServerTail(nn.Module):
+    """Top-of-the-split server net: features → logits."""
+
+    layers: Sequence[int] = (6, 6, 6)
+    block: str = "bottleneck"
+    num_classes: int = 10
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, feats, train: bool = False):
+        x = feats
+        blk = BasicBlock if self.block == "basic" else BottleneckBlock
+        for stage, (planes, n_blocks) in enumerate(zip((16, 32, 64), self.layers)):
+            for i in range(n_blocks):
+                strides = 2 if (stage > 0 and i == 0) else 1
+                x = blk(planes, strides, self.norm)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("resnet5_56")
+def resnet5_56(num_classes: int = 10, norm: str = "gn", **_):
+    return ResNetClientStump(n_blocks=1, block="basic",
+                             num_classes=num_classes, norm=norm)
+
+
+@register_model("resnet8_56")
+def resnet8_56(num_classes: int = 10, norm: str = "gn", **_):
+    return ResNetClientStump(n_blocks=2, block="bottleneck",
+                             num_classes=num_classes, norm=norm)
+
+
+@register_model("resnet56_server")
+def resnet56_server(num_classes: int = 10, norm: str = "gn", **_):
+    return ResNetServerTail(layers=(6, 6, 6), block="bottleneck",
+                            num_classes=num_classes, norm=norm)
+
+
+@register_model("resnet110_server")
+def resnet110_server(num_classes: int = 10, norm: str = "gn", **_):
+    return ResNetServerTail(layers=(12, 12, 12), block="bottleneck",
+                            num_classes=num_classes, norm=norm)

@@ -1,0 +1,53 @@
+"""Vertical-FL party models.
+
+Parity targets (reference fedml_api/model/finance/):
+- ``LocalModel`` (vfl_models_standalone.py:36-70): Dense → LeakyReLU
+  feature extractor each party runs on its own feature slice.
+- ``DenseModel`` (vfl_models_standalone.py:6-33): a single Linear producing
+  the party's logit contribution (guest: with bias; hosts: without —
+  party_models.py builds them that way so the summed logit has one bias).
+- ``VFLFeatureExtractor`` / ``VFLClassifier`` (vfl_classifier.py,
+  vfl_feature_extractor.py) follow the same two shapes.
+
+The reference gives each model a hand-rolled ``backward(x, grads)`` doing
+manual VJP + SGD (momentum 0.9, wd 0.01). Here the models are plain flax
+modules; the protocol-level VJP lives in fedml_tpu.algos.vertical_fl via
+``jax.vjp`` — same math, no hand-written backward.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from fedml_tpu.models.registry import register_model
+
+
+class VFLLocalModel(nn.Module):
+    """Per-party feature extractor: Dense → LeakyReLU."""
+
+    output_dim: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.leaky_relu(nn.Dense(self.output_dim)(x), 0.01)
+
+
+class VFLDenseModel(nn.Module):
+    """Party logit head: one Linear (guest keeps the bias)."""
+
+    output_dim: int = 1
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dense(self.output_dim, use_bias=self.use_bias)(x)
+
+
+@register_model("vfl_local")
+def vfl_local(output_dim: int = 32, **_):
+    return VFLLocalModel(output_dim=output_dim)
+
+
+@register_model("vfl_dense")
+def vfl_dense(output_dim: int = 1, use_bias: bool = True, **_):
+    return VFLDenseModel(output_dim=output_dim, use_bias=use_bias)
