@@ -4,12 +4,14 @@ from fedml_tpu.algos.decentralized import DecentralizedAPI
 from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.algos.fedgan import FedGanAPI
 from fedml_tpu.algos.fedgkt import FedGKTAPI
+from fedml_tpu.algos.fednas import FedNASAPI
 from fedml_tpu.algos.fednova import FedNovaAPI
 from fedml_tpu.algos.fedopt import FedOptAPI
 from fedml_tpu.algos.fedprox import FedProxAPI
 from fedml_tpu.algos.hierarchical import HierarchicalFedAvgAPI
 from fedml_tpu.algos.robust import FedAvgRobustAPI
 from fedml_tpu.algos.split_nn import SplitNNAPI
+from fedml_tpu.algos.turboaggregate import TurboAggregateAPI
 from fedml_tpu.algos.vertical_fl import VflAPI
 
 __all__ = [
@@ -19,8 +21,10 @@ __all__ = [
     "FedAvgAPI",
     "FedGanAPI",
     "FedGKTAPI",
+    "FedNASAPI",
     "FedNovaAPI",
     "SplitNNAPI",
+    "TurboAggregateAPI",
     "VflAPI",
     "FedOptAPI",
     "FedProxAPI",

@@ -25,7 +25,7 @@ import optax
 
 from fedml_tpu.algos.loop import FederatedLoop
 from fedml_tpu.core.tree import tree_select
-from fedml_tpu.trainer.local import NetState
+from fedml_tpu.trainer.local import NetState, make_epoch_shuffle
 
 
 def _apply(module, net: NetState, method, *args, train: bool):
@@ -120,19 +120,8 @@ def make_gan_local_train(module, lr: float, local_epochs: int,
             g_state = tree_select(nonempty, new_g_state, g_state)
             return (net, d_state, g_state, rng), (d_loss + g_loss, jnp.sum(mb))
 
-        n_steps, batch = x.shape[0], x.shape[1]
-
         def epoch(carry, epoch_rng):
-            # Per-epoch reshuffle, same padding-to-tail scheme as
-            # make_local_train_fn (DataLoader(shuffle=True) semantics).
-            flat_mask = mask.reshape(n_steps * batch)
-            keys = jax.random.uniform(epoch_rng, (n_steps * batch,))
-            perm = jnp.argsort(keys + (1.0 - flat_mask) * 2.0)
-
-            def reshuffle(a):
-                flat = a.reshape((n_steps * batch,) + a.shape[2:])
-                return jnp.take(flat, perm, axis=0).reshape(a.shape)
-
+            reshuffle = make_epoch_shuffle(mask, epoch_rng)
             carry, (losses, ns) = jax.lax.scan(
                 step, carry, (reshuffle(x), reshuffle(mask)))
             return carry, jnp.sum(losses * ns) / jnp.maximum(jnp.sum(ns), 1.0)

@@ -29,8 +29,14 @@ import numpy as np
 import optax
 
 from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.core.tree import tree_select
 from fedml_tpu.data.batching import FederatedArrays
-from fedml_tpu.trainer.local import NetState, model_fns, softmax_ce
+from fedml_tpu.trainer.local import (
+    NetState,
+    make_epoch_shuffle,
+    model_fns,
+    softmax_ce,
+)
 
 
 def kl_loss(student_logits, teacher_logits, temperature: float = 1.0):
@@ -118,31 +124,22 @@ class FedGKTAPI:
                     loss_fn, has_aux=True)(net.params)
                 updates, new_opt = opt.update(grads, opt_state, net.params)
                 nonempty = jnp.sum(mb) > 0
-                sel = lambda a, b: jax.tree.map(
-                    lambda u, v: jnp.where(nonempty, u, v), a, b)
-                net = sel(NetState(optax.apply_updates(net.params, updates),
-                                   state), net)
-                opt_state = sel(new_opt, opt_state)
-                return (net, opt_state, rng), loss
-
-            S, B = xc.shape[0], xc.shape[1]
+                net = tree_select(
+                    nonempty,
+                    NetState(optax.apply_updates(net.params, updates), state),
+                    net)
+                opt_state = tree_select(nonempty, new_opt, opt_state)
+                return (net, opt_state, rng), (loss, jnp.sum(mb))
 
             def epoch(carry, epoch_rng):
-                # Per-epoch reshuffle with padding kept at the tail — same
-                # scheme as make_local_train_fn (DataLoader(shuffle=True)).
-                flat_mask = mc.reshape(S * B)
-                keys = jax.random.uniform(epoch_rng, (S * B,))
-                perm = jnp.argsort(keys + (1.0 - flat_mask) * 2.0)
-
-                def reshuffle(a):
-                    flat = a.reshape((S * B,) + a.shape[2:])
-                    return jnp.take(flat, perm, axis=0).reshape(a.shape)
-
-                carry, losses = jax.lax.scan(
+                reshuffle = make_epoch_shuffle(mc, epoch_rng)
+                carry, (losses, ns) = jax.lax.scan(
                     step, carry,
                     (reshuffle(xc), reshuffle(yc), reshuffle(mc),
                      reshuffle(teacher)))
-                return carry, jnp.mean(losses)
+                # Sample-weighted: padded all-masked steps carry weight 0.
+                return carry, jnp.sum(losses * ns) / jnp.maximum(
+                    jnp.sum(ns), 1.0)
 
             rng, shuffle_rng = jax.random.split(rng)
             (net, _, _), losses = jax.lax.scan(
@@ -195,16 +192,17 @@ class FedGKTAPI:
                     loss_fn, has_aux=True)(net.params)
                 updates, new_opt = opt.update(grads, opt_state, net.params)
                 nonempty = jnp.sum(mb) > 0
-                sel = lambda a, b: jax.tree.map(
-                    lambda u, v: jnp.where(nonempty, u, v), a, b)
-                net = sel(NetState(optax.apply_updates(net.params, updates),
-                                   state), net)
-                opt_state = sel(new_opt, opt_state)
-                return (net, opt_state, rng), loss
+                net = tree_select(
+                    nonempty,
+                    NetState(optax.apply_updates(net.params, updates), state),
+                    net)
+                opt_state = tree_select(nonempty, new_opt, opt_state)
+                return (net, opt_state, rng), (loss, jnp.sum(mb))
 
             def epoch(carry, _):
-                carry, losses = jax.lax.scan(step, carry, (f, cl, yy, mm))
-                return carry, jnp.mean(losses)
+                carry, (losses, ns) = jax.lax.scan(step, carry, (f, cl, yy, mm))
+                return carry, jnp.sum(losses * ns) / jnp.maximum(
+                    jnp.sum(ns), 1.0)
 
             (server_net, opt_state, _), losses = jax.lax.scan(
                 epoch, (server_net, opt_state, rng), None, length=epochs)

@@ -1,0 +1,190 @@
+"""FedNAS — federated neural architecture search over the DARTS space.
+
+Parity target: reference fedml_api/distributed/fednas/ —
+- clients run local bilevel search: architecture step on a held-out local
+  valid split, then weight step on the train split
+  (FedNASTrainer.local_search:82, darts/architect.py);
+- the server averages BOTH model weights and architecture alphas, weighted
+  by sample counts (FedNASAggregator.__aggregate_weight:71,
+  __aggregate_alpha:95);
+- after search, the genotype is derived from the averaged alphas
+  (FedNASAggregator.record_model_global_architecture:173).
+
+TPU-native: weights vs alphas is a partition of ONE flax params pytree
+(alphas live at the network root as ``alphas_normal``/``alphas_reduce``),
+so the bilevel update is two masked SGD steps inside the same jit-compiled
+``lax.scan``; clients are vmapped; aggregation is the standard weighted
+tree-mean (which covers w and α jointly, exactly the reference's two loops).
+The 2nd-order arch gradient ∇α L_val(w − ξ∇w L_train(w,α), α) is an exact
+``jax.grad`` through the unrolled inner step — no finite-difference
+Hessian-vector approximation (architect.py:229) needed under XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.loop import FederatedLoop
+from fedml_tpu.core.tree import tree_weighted_mean
+from fedml_tpu.data.batching import FederatedArrays, gather_clients
+from fedml_tpu.trainer.local import NetState, make_eval_fn, model_fns, softmax_ce
+
+ALPHA_KEYS = ("alphas_normal", "alphas_reduce")
+
+
+def _split_mask(params):
+    """Bool pytrees selecting (arch alphas, weights)."""
+    flat = {k: (k in ALPHA_KEYS) for k in params}
+    return flat, {k: not v for k, v in flat.items()}
+
+
+def _masked(tree, mask):
+    """Zero out leaves whose top-level key is masked False."""
+    return jax.tree.map(
+        lambda m, sub: jax.tree.map(
+            (lambda a: a) if m else (lambda a: jnp.zeros_like(a)), sub),
+        mask, tree, is_leaf=lambda n: isinstance(n, bool))
+
+
+class FedNASAPI(FederatedLoop):
+    """Federated DARTS search (reference FedNASAPI.py:16).
+
+    Each client's packed batches are split in half: the first ``S//2``
+    steps are the train split, the rest the valid split (the reference
+    splits each client's local data into train/valid queues,
+    FedNASTrainer.py:22-30)."""
+
+    def __init__(self, model, train_fed: FederatedArrays, test_global,
+                 cfg: FedConfig, arch_lr: float = 3e-4, xi: float = 0.0,
+                 unrolled: bool = False):
+        """``xi``/``unrolled``: 2nd-order arch step w − ξ∇L_train lookahead
+        (architect.py unrolled mode); ``unrolled=False`` is the reference's
+        ``--arch_search_method`` default 1st-order (MiLeNAS-style)."""
+        self.cfg = cfg
+        self.train_fed = train_fed
+        self.test_global = test_global
+        self.fns = model_fns(model)
+        if int(train_fed.x.shape[1]) < 2:
+            raise ValueError(
+                "FedNAS needs >= 2 packed steps per client (the local data "
+                "is split into train/valid halves, FedNASTrainer.py:22-30); "
+                "use a smaller batch_size so each client packs >= 2 batches")
+        self.arch_lr = arch_lr
+        self.xi = xi if unrolled else 0.0
+        self.unrolled = unrolled
+        self.n_shards = 1
+
+        rng = jax.random.PRNGKey(cfg.seed)
+        self.rng, init_rng = jax.random.split(rng)
+        sample_x = np.asarray(train_fed.x[0, 0])
+        self.net = self.fns.init(init_rng, sample_x)
+        self.round_fn = jax.jit(self._build_round())
+        self.eval_fn = jax.jit(make_eval_fn(self.fns.apply))
+
+    # ------------------------------------------------------------------
+    def _build_round(self):
+        apply_fn = self.fns.apply
+        lr_w, lr_a, xi = self.cfg.lr, self.arch_lr, self.xi
+        epochs = self.cfg.epochs
+        unrolled = self.unrolled
+
+        def ce_loss(p, state, xb, yb, mb, rng):
+            logits, new_state = apply_fn(
+                NetState(p, state), xb, train=True, rng=rng)
+            per = softmax_ce(logits, yb)
+            return (jnp.sum(per * mb) / jnp.maximum(jnp.sum(mb), 1.0),
+                    new_state)
+
+        def local_search(net, x, y, mask, rng):
+            # Floor split: with odd S the final batch is used by neither
+            # half (deliberate — equal-sized train/valid splits, like the
+            # reference's 50/50 queue split).
+            S = x.shape[0]
+            half = S // 2
+            amask, wmask = _split_mask(net.params)
+
+            def step(carry, inputs):
+                net, rng = carry
+                (xt, yt, mt), (xv, yv, mv) = inputs
+                rng, r1, r2, r3 = jax.random.split(rng, 4)
+
+                # --- architecture step on the valid half ---------------
+                def val_loss_wrt_alpha(p):
+                    if unrolled:
+                        # exact 2nd-order: lookahead w' = w − ξ∇w L_train
+                        gw, _ = jax.grad(ce_loss, has_aux=True)(
+                            p, net.model_state, xt, yt, mt, r1)
+                        p = jax.tree.map(
+                            lambda a, g: a - xi * g, p, _masked(gw, wmask))
+                    loss, state = ce_loss(p, net.model_state, xv, yv, mv, r2)
+                    return loss, state
+
+                ga, _ = jax.grad(val_loss_wrt_alpha, has_aux=True)(net.params)
+                params = jax.tree.map(
+                    lambda a, g: a - lr_a * g, net.params, _masked(ga, amask))
+
+                # --- weight step on the train half ---------------------
+                (loss, new_state), gw = jax.value_and_grad(
+                    ce_loss, has_aux=True)(
+                        params, net.model_state, xt, yt, mt, r3)
+                params = jax.tree.map(
+                    lambda a, g: a - lr_w * g, params, _masked(gw, wmask))
+
+                nonempty = jnp.sum(mt) > 0
+                new_net = NetState(params, new_state)
+                net = jax.tree.map(
+                    lambda a, b: jnp.where(nonempty, a, b), new_net, net)
+                return (net, rng), loss
+
+            def epoch(carry, _):
+                carry, losses = jax.lax.scan(
+                    step, carry,
+                    ((x[:half], y[:half], mask[:half]),
+                     (x[half:2 * half], y[half:2 * half], mask[half:2 * half])))
+                return carry, jnp.mean(losses)
+
+            (net, _), losses = jax.lax.scan(
+                epoch, (net, rng), None, length=epochs)
+            return net, jnp.mean(losses)
+
+        def round_fn(net, x, y, mask, weights, rng):
+            rngs = jax.vmap(
+                lambda i: jax.random.fold_in(rng, i))(jnp.arange(x.shape[0]))
+            client_nets, losses = jax.vmap(
+                local_search, in_axes=(None, 0, 0, 0, 0))(net, x, y, mask, rngs)
+            avg = tree_weighted_mean(client_nets, weights)
+            lw = weights / jnp.maximum(jnp.sum(weights), 1e-12)
+            return avg, jnp.sum(losses * lw)
+
+        return round_fn
+
+    # ------------------------------------------------------------------
+    def train_one_round(self, round_idx: int) -> Dict[str, float]:
+        idx, wmask = self.sample_round(round_idx)
+        sub = gather_clients(self.train_fed, idx)
+        weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
+        self.rng, rnd = jax.random.split(self.rng)
+        self.net, loss = self.round_fn(
+            self.net, sub.x, sub.y, sub.mask, weights, rnd)
+        return {"round": round_idx, "search_loss": float(loss)}
+
+    def _eval_net(self):
+        return self.net
+
+    def genotype(self):
+        """Derive the searched architecture from the averaged alphas
+        (reference record_model_global_architecture, FedNASAggregator.py:173)."""
+        from fedml_tpu.models.darts import derive_genotype
+
+        steps = {14: 4, 9: 3, 5: 2, 2: 1}[
+            int(self.net.params["alphas_normal"].shape[0])]
+        return derive_genotype(
+            self.net.params["alphas_normal"],
+            self.net.params["alphas_reduce"], steps=steps,
+            multiplier=min(4, steps))

@@ -32,6 +32,7 @@ import numpy as np
 import optax
 
 from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.core.tree import tree_select
 from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.trainer.local import NetState, model_fns, softmax_ce
 
@@ -107,16 +108,15 @@ class SplitNNAPI:
             ub, opt_b2 = opt.update(gb, opt_b, bottom.params)
             ut, opt_t2 = opt.update(gt, opt_t, top.params)
             nonempty = jnp.sum(mb) > 0
-
-            def sel(new, old):
-                return jax.tree.map(
-                    lambda a, b: jnp.where(nonempty, a, b), new, old)
-
-            bottom = sel(NetState(optax.apply_updates(bottom.params, ub),
-                                  b_state), bottom)
-            top = sel(NetState(optax.apply_updates(top.params, ut), t_state), top)
-            opt_b = sel(opt_b2, opt_b)
-            opt_t = sel(opt_t2, opt_t)
+            bottom = tree_select(
+                nonempty,
+                NetState(optax.apply_updates(bottom.params, ub), b_state),
+                bottom)
+            top = tree_select(
+                nonempty,
+                NetState(optax.apply_updates(top.params, ut), t_state), top)
+            opt_b = tree_select(nonempty, opt_b2, opt_b)
+            opt_t = tree_select(nonempty, opt_t2, opt_t)
             return (bottom, opt_b, top, opt_t), (loss, jnp.sum(mb))
 
         def one_client(carry, inputs):

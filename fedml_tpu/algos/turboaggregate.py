@@ -1,0 +1,114 @@
+"""Turbo-Aggregate — secure aggregation with dropout-tolerant clients.
+
+Parity target: reference fedml_api/standalone/turboaggregate/ (and the
+distributed mirror) —
+- the MPC library (mpc_function.py) → fedml_tpu.core.mpc;
+- ``TA_Client.set_dropout`` (TA_client.py:25): clients may drop out of a
+  round and the aggregate must still be recoverable;
+- ``TurboAggregateTrainer`` (TA_trainer.py:11): clients organized into
+  groups (``TA_topology_vanilla:87`` builds the multi-group ring), model
+  updates masked so no single party (server included) sees a raw update.
+
+Protocol here (additive-masking secure aggregation, the Turbo-Aggregate
+core): every surviving client quantizes its weighted model delta into the
+prime field and splits it into additive shares, one per group; each group
+sums the shares it holds (partial sums reveal nothing); the server adds the
+group sums and dequantizes. Sum of all shares ≡ sum of secrets (mod p), so
+the recovered aggregate equals plain FedAvg up to 1/scale quantization.
+Dropouts are handled at share-distribution time: a dropped client
+contributes nothing and its weight leaves the normalization (the reference
+drops them from the ring the same way).
+
+Local training rides the shared vmapped ``lax.scan`` trainer; only the
+aggregation is host-side MPC — the protocol is between trust domains, not a
+TPU kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.core import mpc
+from fedml_tpu.data.batching import gather_clients
+
+
+class TurboAggregateAPI(FedAvgAPI):
+    """FedAvg with MPC aggregation. ``n_groups`` = Turbo-Aggregate ring
+    groups; ``scale`` = fixed-point quantization (2^16 ≈ 1.5e-5 absolute
+    error per aggregate — well under SGD noise)."""
+
+    def __init__(self, *args, n_groups: int = 2, scale: int = 2 ** 16,
+                 prime: int = mpc.DEFAULT_PRIME, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.mesh is not None:
+            raise ValueError(
+                "TurboAggregate aggregates on the host (MPC protocol); "
+                "use mesh=None")
+        self.n_groups = n_groups
+        self.scale = scale
+        self.prime = prime
+        self.dropout_mask: Optional[np.ndarray] = None
+        # Client-parallel local training WITHOUT the fused average: we need
+        # the per-client models for the MPC protocol.
+        self._local_batch = jax.jit(
+            jax.vmap(self.local_train, in_axes=(None, 0, 0, 0, 0)))
+        from jax.flatten_util import ravel_pytree
+
+        self._ravel = ravel_pytree
+
+    def set_dropout(self, dropped: Optional[Sequence[int]]):
+        """Mark clients (by position in the sampled round) as dropped
+        (reference TA_client.py:25)."""
+        self.dropout_mask = (np.asarray(dropped, np.int64)
+                             if dropped is not None else None)
+
+    def train_one_round(self, round_idx: int) -> Dict[str, float]:
+        idx, wmask = self.sample_round(round_idx)
+        sub = gather_clients(self.train_fed, idx)
+        weights = np.asarray(sub.counts, np.float64) * np.asarray(wmask)
+        if self.dropout_mask is not None:
+            weights[self.dropout_mask] = 0.0
+        self.rng, rnd = jax.random.split(self.rng)
+        rngs = jax.vmap(
+            lambda i: jax.random.fold_in(rnd, i))(jnp.arange(sub.x.shape[0]))
+        client_nets, losses = self._local_batch(
+            self.net, sub.x, sub.y, sub.mask, rngs)
+
+        # --- secure aggregation over the field ---------------------------
+        wsum = weights.sum()
+        if wsum == 0.0:
+            # Every sampled client dropped: the round is a no-op (plain
+            # FedAvg semantics keep the previous global model).
+            return {"round": round_idx, "train_loss": float("nan")}
+        wn = weights / wsum
+        flat0, unravel = self._ravel(self.net)
+        group_sums = np.zeros((self.n_groups, flat0.shape[0]), np.int64)
+        # Masks must come from secret randomness: derive the share rng from
+        # the session PRNG chain, never from public round state.
+        self.rng, mask_rng = jax.random.split(self.rng)
+        share_rng = np.random.RandomState(
+            np.asarray(jax.random.key_data(mask_rng)).ravel()[-1] % (2 ** 31))
+        for c in range(len(weights)):
+            if wn[c] == 0.0:
+                continue  # dropped or padded client: contributes nothing
+            flat_c, _ = self._ravel(
+                jax.tree.map(lambda a: a[c], client_nets))
+            q = mpc.quantize(np.asarray(flat_c, np.float64) * wn[c],
+                             self.scale, self.prime)
+            shares = mpc.additive_shares(q, self.n_groups, self.prime,
+                                         share_rng)
+            group_sums = np.mod(group_sums + shares, self.prime)
+        total = np.zeros(flat0.shape[0], np.int64)
+        for g in range(self.n_groups):
+            total = np.mod(total + group_sums[g], self.prime)
+        avg_flat = mpc.dequantize(total, self.scale, self.prime)
+        self.net = unravel(jnp.asarray(avg_flat, jnp.float32))
+
+        lw = weights / max(weights.sum(), 1e-12)
+        loss = float(np.sum(np.asarray(losses, np.float64) * lw))
+        return {"round": round_idx, "train_loss": loss}

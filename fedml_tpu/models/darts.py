@@ -1,0 +1,270 @@
+"""DARTS differentiable-NAS search space for FedNAS.
+
+Parity target: reference fedml_api/model/cv/darts/ —
+- operations set (operations.py): none / skip / avg_pool_3x3 / max_pool_3x3 /
+  sep_conv_3x3 / sep_conv_5x5 / dil_conv_3x3 / dil_conv_5x5,
+- MixedOp + Cell with 4 intermediate nodes, concat of the last
+  ``multiplier`` states (model_search.py),
+- architecture parameters alphas_normal/alphas_reduce of shape
+  [n_edges, n_ops], n_edges = Σ(2+i) (model_search.py _initialize_alphas),
+- genotype derivation: per node keep the top-2 incoming edges ranked by the
+  strongest non-``none`` op weight (model_search.py genotype()).
+
+TPU-first: alphas are ordinary flax params (``alphas_normal``/
+``alphas_reduce`` at the network root), so the FedNAS bilevel update is a
+params-pytree partition, not a separate parameter group object; all ops are
+static-shaped NHWC modules; GroupNorm replaces BatchNorm (FL pathology —
+see fedml_tpu/models/resnet.py) and the 2nd-order arch gradient is an exact
+``jax.grad`` through one unrolled SGD step (fedml_tpu/algos/fednas.py),
+replacing the reference's finite-difference Hessian-vector product
+(darts/architect.py:229).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.registry import register_model
+from fedml_tpu.models.resnet import Norm
+
+PRIMITIVES = (
+    "none",
+    "max_pool_3x3",
+    "avg_pool_3x3",
+    "skip_connect",
+    "sep_conv_3x3",
+    "sep_conv_5x5",
+    "dil_conv_3x3",
+    "dil_conv_5x5",
+)
+
+
+class ReLUConvNorm(nn.Module):
+    c_out: int
+    kernel: int = 1
+    strides: int = 1
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(x)
+        x = nn.Conv(self.c_out, (self.kernel, self.kernel),
+                    (self.strides, self.strides), padding="SAME",
+                    use_bias=False)(x)
+        return Norm(self.norm)(x, train)
+
+
+class SepConv(nn.Module):
+    """Depthwise-separable conv ×2 (reference operations.py SepConv)."""
+
+    c_out: int
+    kernel: int
+    strides: int
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i, s in enumerate((self.strides, 1)):
+            c_in = x.shape[-1]
+            x = nn.relu(x)
+            x = nn.Conv(c_in, (self.kernel, self.kernel), (s, s),
+                        padding="SAME", feature_group_count=c_in,
+                        use_bias=False)(x)
+            x = nn.Conv(self.c_out, (1, 1), use_bias=False)(x)
+            x = Norm(self.norm)(x, train)
+        return x
+
+
+class DilConv(nn.Module):
+    """Dilated depthwise-separable conv (reference operations.py DilConv)."""
+
+    c_out: int
+    kernel: int
+    strides: int
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c_in = x.shape[-1]
+        x = nn.relu(x)
+        x = nn.Conv(c_in, (self.kernel, self.kernel),
+                    (self.strides, self.strides), padding="SAME",
+                    kernel_dilation=(2, 2), feature_group_count=c_in,
+                    use_bias=False)(x)
+        x = nn.Conv(self.c_out, (1, 1), use_bias=False)(x)
+        return Norm(self.norm)(x, train)
+
+
+class FactorizedReduce(nn.Module):
+    """Stride-2 channel-preserving reduce for skip on reduction edges."""
+
+    c_out: int
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(x)
+        a = nn.Conv(self.c_out // 2, (1, 1), (2, 2), use_bias=False)(x)
+        b = nn.Conv(self.c_out - self.c_out // 2, (1, 1), (2, 2),
+                    use_bias=False)(x[:, 1:, 1:, :])
+        x = jnp.concatenate([a, b], axis=-1)
+        return Norm(self.norm)(x, train)
+
+
+class MixedOp(nn.Module):
+    """Softmax-weighted sum over all candidate ops on one edge."""
+
+    c_out: int
+    strides: int
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, w, train: bool = False):
+        outs = []
+        for prim in PRIMITIVES:
+            s = self.strides
+            if prim == "none":
+                o = jnp.zeros(x.shape[:1] + (x.shape[1] // s, x.shape[2] // s,
+                                             self.c_out), x.dtype)
+            elif prim == "max_pool_3x3":
+                o = nn.max_pool(x, (3, 3), strides=(s, s), padding="SAME")
+            elif prim == "avg_pool_3x3":
+                o = nn.avg_pool(x, (3, 3), strides=(s, s), padding="SAME")
+            elif prim == "skip_connect":
+                o = x if s == 1 else FactorizedReduce(self.c_out,
+                                                      self.norm)(x, train)
+            elif prim.startswith("sep_conv"):
+                k = int(prim[-1])
+                o = SepConv(self.c_out, k, s, self.norm)(x, train)
+            else:  # dil_conv
+                k = int(prim[-1])
+                o = DilConv(self.c_out, k, s, self.norm)(x, train)
+            outs.append(o)
+        return sum(w[i] * outs[i] for i in range(len(PRIMITIVES)))
+
+
+class SearchCell(nn.Module):
+    """DARTS cell: ``steps`` intermediate nodes, dense edges from all
+    predecessors, output = concat of last ``multiplier`` nodes."""
+
+    c: int
+    steps: int = 4
+    multiplier: int = 4
+    reduction: bool = False
+    reduction_prev: bool = False
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, s0, s1, weights, train: bool = False):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.c, self.norm)(s0, train)
+        else:
+            s0 = ReLUConvNorm(self.c, 1, 1, self.norm)(s0, train)
+        s1 = ReLUConvNorm(self.c, 1, 1, self.norm)(s1, train)
+        states = [s0, s1]
+        offset = 0
+        for _ in range(self.steps):
+            acc = None
+            for j, h in enumerate(states):
+                strides = 2 if self.reduction and j < 2 else 1
+                o = MixedOp(self.c, strides, self.norm)(
+                    h, weights[offset + j], train)
+                acc = o if acc is None else acc + o
+            offset += len(states)
+            states.append(acc)
+        return jnp.concatenate(states[-self.multiplier:], axis=-1)
+
+
+def n_edges(steps: int) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+class DartsNetwork(nn.Module):
+    """Searchable network (reference model_search.py Network)."""
+
+    c: int = 16
+    layers: int = 8
+    steps: int = 4
+    multiplier: int = 4
+    stem_multiplier: int = 3
+    num_classes: int = 10
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        E, K = n_edges(self.steps), len(PRIMITIVES)
+        alphas_normal = self.param(
+            "alphas_normal", nn.initializers.normal(1e-3), (E, K))
+        alphas_reduce = self.param(
+            "alphas_reduce", nn.initializers.normal(1e-3), (E, K))
+        w_normal = nn.softmax(alphas_normal, axis=-1)
+        w_reduce = nn.softmax(alphas_reduce, axis=-1)
+
+        c_curr = self.stem_multiplier * self.c
+        s = nn.Conv(c_curr, (3, 3), padding="SAME", use_bias=False)(x)
+        s0 = s1 = Norm(self.norm)(s, train)
+
+        c_curr = self.c
+        reduction_prev = False
+        reductions = {self.layers // 3, 2 * self.layers // 3} - {0}
+        for layer in range(self.layers):
+            reduction = layer in reductions
+            if reduction:
+                c_curr *= 2
+            cell_out = SearchCell(
+                c_curr, self.steps, self.multiplier, reduction,
+                reduction_prev, self.norm,
+            )(s0, s1, w_reduce if reduction else w_normal, train)
+            s0, s1 = s1, cell_out
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(out)
+
+
+class Genotype(NamedTuple):
+    normal: Sequence[Tuple[str, int]]
+    normal_concat: Sequence[int]
+    reduce: Sequence[Tuple[str, int]]
+    reduce_concat: Sequence[int]
+
+
+def derive_genotype(alphas_normal, alphas_reduce, steps: int = 4,
+                    multiplier: int = 4) -> Genotype:
+    """Reference model_search.py genotype(): per node, keep the two
+    incoming edges with the strongest non-none op; record (op, src)."""
+    import numpy as np
+
+    def parse(alphas):
+        w = np.asarray(jnp.asarray(alphas))
+        w = np.exp(w - w.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        gene, offset = [], 0
+        none_idx = PRIMITIVES.index("none")
+        for i in range(steps):
+            n_in = 2 + i
+            rows = w[offset:offset + n_in]
+            scored = []
+            for j in range(n_in):
+                ops = np.delete(rows[j], none_idx)
+                names = [p for p in PRIMITIVES if p != "none"]
+                best = int(np.argmax(ops))
+                scored.append((float(ops[best]), names[best], j))
+            scored.sort(reverse=True)
+            for score, name, j in scored[:2]:
+                gene.append((name, j))
+            offset += n_in
+        return gene
+
+    concat = list(range(2 + steps - multiplier, steps + 2))
+    return Genotype(parse(alphas_normal), concat, parse(alphas_reduce), concat)
+
+
+@register_model("darts")
+def darts(num_classes: int = 10, c: int = 16, layers: int = 8,
+          steps: int = 4, multiplier: int = 4, norm: str = "gn", **_):
+    return DartsNetwork(c=c, layers=layers, steps=steps,
+                        multiplier=multiplier, num_classes=num_classes,
+                        norm=norm)

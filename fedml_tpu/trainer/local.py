@@ -107,6 +107,28 @@ def seq_softmax_ce(logits, labels, pad_id: int = 0):
     return (per_tok * tok_mask).sum(axis=-1) / denom
 
 
+def make_epoch_shuffle(mask, epoch_rng):
+    """Per-epoch reshuffle closure over ``[S, B, ...]`` packed arrays
+    (DataLoader(shuffle=True) semantics). REAL samples are permuted amongst
+    themselves and padding stays at the tail (argsort of random keys offset
+    by the mask), so trailing steps remain all-masked no-ops: the per-client
+    optimizer-step count stays exactly ``epochs x ceil(n_i/B)`` (FedNova's τ
+    depends on this) and at most one batch per epoch mixes real samples
+    with padding. Returns ``reshuffle(a)`` applicable to every per-sample
+    array of the pack (x, y, mask, teacher logits, ...)."""
+    n_steps, batch = mask.shape[0], mask.shape[1]
+    flat_mask = mask.reshape(n_steps * batch)
+    keys = jax.random.uniform(epoch_rng, (n_steps * batch,))
+    # Padded slots get keys > 1 so argsort sends them to the tail.
+    perm = jnp.argsort(keys + (1.0 - flat_mask) * 2.0)
+
+    def reshuffle(a):
+        flat = a.reshape((n_steps * batch,) + a.shape[2:])
+        return jnp.take(flat, perm, axis=0).reshape(a.shape)
+
+    return reshuffle
+
+
 def make_local_train_fn(
     apply_fn,
     optimizer,
@@ -170,15 +192,7 @@ def make_local_train_fn(
 
         def epoch(carry, epoch_rng):
             if shuffle:
-                flat_mask = mask.reshape(n_steps * batch)
-                keys = jax.random.uniform(epoch_rng, (n_steps * batch,))
-                # Padded slots get keys > 1 so argsort sends them to the tail.
-                perm = jnp.argsort(keys + (1.0 - flat_mask) * 2.0)
-
-                def reshuffle(a):
-                    flat = a.reshape((n_steps * batch,) + a.shape[2:])
-                    return jnp.take(flat, perm, axis=0).reshape(a.shape)
-
+                reshuffle = make_epoch_shuffle(mask, epoch_rng)
                 ex, ey, em = reshuffle(x), reshuffle(y), reshuffle(mask)
             else:
                 ex, ey, em = x, y, mask

@@ -1,0 +1,178 @@
+"""MPC primitives, TurboAggregate secure aggregation, DARTS/FedNAS."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.algos.fednas import FedNASAPI
+from fedml_tpu.algos.turboaggregate import TurboAggregateAPI
+from fedml_tpu.core import mpc
+from fedml_tpu.data.batching import batch_global, build_federated_arrays
+from fedml_tpu.data.partition import partition_homo
+from fedml_tpu.data.synthetic import make_classification
+from fedml_tpu.models.darts import DartsNetwork, derive_genotype, n_edges, PRIMITIVES
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.trainer.local import model_fns
+
+P = mpc.DEFAULT_PRIME
+
+
+# ---------------------------------------------------------------- MPC ----
+def test_modular_inverse():
+    a = np.array([2, 3, 12345], np.int64)
+    inv = mpc.modular_inv(a, P)
+    np.testing.assert_array_equal(np.mod(a * inv, P), 1)
+
+
+def test_bgw_roundtrip_any_t_plus_1_shares():
+    rng = np.random.RandomState(0)
+    secret = rng.randint(0, P, size=(4, 6)).astype(np.int64)
+    N, T = 7, 2
+    shares = mpc.bgw_encode(secret, N, T, P, rng)
+    # any T+1 distinct shares reconstruct
+    idx = [1, 4, 6]
+    rec = mpc.bgw_decode(shares[idx], idx, P)
+    np.testing.assert_array_equal(rec, secret)
+
+
+def test_lcc_roundtrip():
+    rng = np.random.RandomState(1)
+    K, T, N = 2, 1, 6
+    X = rng.randint(0, P, size=(4, 5)).astype(np.int64)
+    shares = mpc.lcc_encode(X, N, K, T, P, rng)
+    idx = [0, 2, 5]  # K+T = 3 evaluations
+    rec = mpc.lcc_decode(shares[idx], idx, N, K, T, P)
+    np.testing.assert_array_equal(rec.reshape(4, 5), X)
+
+
+def test_lcc_no_int64_overflow_at_field_edge():
+    """Regression: values near p with >= 3 interpolation points used to
+    overflow the unreduced int64 matmul in lcc_decode."""
+    rng = np.random.RandomState(3)
+    K, T, N = 3, 1, 6  # K+T = 4 accumulated products per output
+    X = np.full((6, 4), P - 1, np.int64)
+    shares = mpc.lcc_encode(X, N, K, T, P, rng)
+    rec = mpc.lcc_decode(shares[[0, 1, 3, 5]], [0, 1, 3, 5], N, K, T, P)
+    np.testing.assert_array_equal(rec.reshape(6, 4), X)
+
+
+def test_additive_shares_sum_to_secret():
+    rng = np.random.RandomState(2)
+    x = rng.randint(0, P, size=(3, 4)).astype(np.int64)
+    sh = mpc.additive_shares(x, 5, P, rng)
+    np.testing.assert_array_equal(np.mod(sh.sum(axis=0), P), x)
+    # single share is uniform-ish, not the secret
+    assert not np.array_equal(sh[0], x)
+
+
+def test_key_agreement_symmetric():
+    sk_a, sk_b = 123457, 987651
+    pk_a, pk_b = mpc.pk_gen(sk_a), mpc.pk_gen(sk_b)
+    assert mpc.key_agreement(sk_a, pk_b) == mpc.key_agreement(sk_b, pk_a)
+
+
+def test_quantize_roundtrip():
+    x = np.array([-1.5, 0.0, 0.25, 3.125], np.float64)
+    q = mpc.quantize(x)
+    np.testing.assert_allclose(mpc.dequantize(q), x, atol=2e-5)
+
+
+# ----------------------------------------------------- TurboAggregate ----
+def _fed_setup(n=400, n_clients=8, batch=16):
+    x_all, y_all = make_classification(n + 100, n_features=10, n_classes=4, seed=0)
+    x, y = x_all[:n], y_all[:n]
+    fed = build_federated_arrays(x, y, partition_homo(n, n_clients), batch)
+    test = batch_global(x_all[n:], y_all[n:], 50)
+    return fed, test
+
+
+def test_turboaggregate_matches_fedavg():
+    """MPC-aggregated round == plain FedAvg round up to quantization."""
+    fed, test = _fed_setup()
+    cfg = FedConfig(client_num_in_total=8, client_num_per_round=4,
+                    comm_round=1, epochs=1, batch_size=16, lr=0.1)
+    a = FedAvgAPI(LogisticRegression(num_classes=4), fed, test, cfg)
+    b = TurboAggregateAPI(LogisticRegression(num_classes=4), fed, test, cfg,
+                          n_groups=3)
+    a.train_one_round(0)
+    b.train_one_round(0)
+    for x, y in zip(jax.tree.leaves(a.net.params), jax.tree.leaves(b.net.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-3)
+
+
+def test_turboaggregate_dropout_excludes_client():
+    fed, test = _fed_setup()
+    cfg = FedConfig(client_num_in_total=8, client_num_per_round=4,
+                    comm_round=1, epochs=1, batch_size=16, lr=0.1)
+    api = TurboAggregateAPI(LogisticRegression(num_classes=4), fed, test, cfg)
+    api.set_dropout([0])
+    m = api.train_one_round(0)
+    assert np.isfinite(m["train_loss"])
+    leaves = jax.tree.leaves(api.net.params)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+
+
+# ------------------------------------------------------- DARTS/FedNAS ----
+def _tiny_darts(num_classes=4):
+    return DartsNetwork(c=4, layers=2, steps=2, multiplier=2,
+                        num_classes=num_classes)
+
+
+def test_darts_forward_and_alphas():
+    model = _tiny_darts()
+    fns = model_fns(model)
+    x = jnp.zeros((2, 8, 8, 3), jnp.float32)
+    net = fns.init(jax.random.PRNGKey(0), x)
+    assert net.params["alphas_normal"].shape == (n_edges(2), len(PRIMITIVES))
+    logits, _ = fns.apply(net, x, train=False)
+    assert logits.shape == (2, 4)
+
+
+def test_derive_genotype_shape():
+    rng = np.random.RandomState(0)
+    E, K = n_edges(2), len(PRIMITIVES)
+    g = derive_genotype(rng.randn(E, K), rng.randn(E, K), steps=2,
+                        multiplier=2)
+    assert len(g.normal) == 4 and len(g.reduce) == 4  # 2 edges per node
+    for name, src in g.normal:
+        assert name in PRIMITIVES and name != "none"
+
+
+def test_fednas_search_moves_alphas_and_weights():
+    rng = np.random.RandomState(0)
+    n, side, k = 128, 8, 4
+    y = rng.randint(0, k, size=n).astype(np.int32)
+    x = rng.randn(n, side, side, 3).astype(np.float32) * 0.1
+    for i in range(n):
+        x[i, :4, :4, :] += (y[i] % 2) * 1.0
+        x[i, 4:, 4:, :] += (y[i] // 2) * 1.0
+    fed = build_federated_arrays(x, y, partition_homo(n, 4), 8)
+    test = batch_global(x[:32], y[:32], 16)
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=2,
+                    comm_round=2, epochs=1, batch_size=8, lr=0.05)
+    api = FedNASAPI(_tiny_darts(), fed, test, cfg, arch_lr=3e-3)
+    a0 = np.asarray(api.net.params["alphas_normal"]).copy()
+    hist = api.train()
+    assert all(np.isfinite(h["search_loss"]) for h in hist)
+    a1 = np.asarray(api.net.params["alphas_normal"])
+    assert not np.allclose(a0, a1)  # architecture actually searched
+    g = api.genotype()
+    assert len(g.normal) == 4
+    acc = api.evaluate()["accuracy"]
+    assert 0.0 <= acc <= 1.0
+
+
+def test_fednas_unrolled_second_order_runs():
+    rng = np.random.RandomState(0)
+    n = 64
+    y = rng.randint(0, 4, size=n).astype(np.int32)
+    x = rng.randn(n, 8, 8, 3).astype(np.float32)
+    fed = build_federated_arrays(x, y, partition_homo(n, 2), 8)
+    cfg = FedConfig(client_num_in_total=2, client_num_per_round=2,
+                    comm_round=1, epochs=1, batch_size=8, lr=0.05)
+    api = FedNASAPI(_tiny_darts(), fed, None, cfg, arch_lr=3e-3,
+                    xi=0.05, unrolled=True)
+    m = api.train_one_round(0)
+    assert np.isfinite(m["search_loss"])
