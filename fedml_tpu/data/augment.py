@@ -1,0 +1,61 @@
+"""On-device data augmentation (jax, batched, jit/vmap-safe).
+
+The reference augments on the host per-sample through torchvision transforms
+(cifar10/data_loader.py:58-76: RandomCrop(32, padding=4),
+RandomHorizontalFlip, Normalize, Cutout(16)). On TPU that would serialize the
+input pipeline; here augmentation is a pure jax function on whole batches
+applied inside the jitted training step — static shapes, fused by XLA, and
+free per-client randomness under vmap via rng folding.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def random_crop(rng, x: jnp.ndarray, padding: int = 4) -> jnp.ndarray:
+    """Pad+random-crop a NHWC batch; one offset per sample
+    (dynamic_slice over the padded image keeps shapes static)."""
+    n, h, w, c = x.shape
+    xp = jnp.pad(x, ((0, 0), (padding, padding), (padding, padding), (0, 0)), mode="constant")
+    k1, k2 = jax.random.split(rng)
+    oy = jax.random.randint(k1, (n,), 0, 2 * padding + 1)
+    ox = jax.random.randint(k2, (n,), 0, 2 * padding + 1)
+
+    def crop_one(img, y0, x0):
+        return jax.lax.dynamic_slice(img, (y0, x0, 0), (h, w, c))
+
+    return jax.vmap(crop_one)(xp, oy, ox)
+
+
+def random_flip(rng, x: jnp.ndarray) -> jnp.ndarray:
+    """Horizontal flip with p=0.5 per sample."""
+    flip = jax.random.bernoulli(rng, 0.5, (x.shape[0],))
+    return jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+
+
+def cutout(rng, x: jnp.ndarray, length: int = 16) -> jnp.ndarray:
+    """Zero a random length×length square per sample (DeVries & Taylor;
+    the reference's Cutout class, cifar10/data_loader.py:20-44 — centers may
+    fall near edges, so the mask is clipped, matching np.clip there)."""
+    n, h, w, _ = x.shape
+    k1, k2 = jax.random.split(rng)
+    cy = jax.random.randint(k1, (n, 1, 1), 0, h)
+    cx = jax.random.randint(k2, (n, 1, 1), 0, w)
+    ys = jnp.arange(h)[None, :, None]
+    xs = jnp.arange(w)[None, None, :]
+    mask = (jnp.abs(ys - cy) < length // 2) & (jnp.abs(xs - cx) < length // 2)
+    return x * (~mask[..., None]).astype(x.dtype)
+
+
+def cifar_train_augment(rng, x: jnp.ndarray, use_cutout: bool = True) -> jnp.ndarray:
+    """The composed CIFAR policy (crop → flip → cutout). Input is already
+    normalized; cutout zeros → the channel mean post-normalisation, same as
+    the reference (it also cuts after ToTensor/Normalize)."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    x = random_crop(k1, x)
+    x = random_flip(k2, x)
+    if use_cutout:
+        x = cutout(k3, x)
+    return x

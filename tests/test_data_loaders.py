@@ -1,0 +1,208 @@
+"""Data-layer contract tests: every loader returns the 8-tuple dataclass with
+consistent counts, and real file formats (LEAF json, TFF h5, CIFAR pickles)
+round-trip through the readers."""
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data import text
+from fedml_tpu.data.loaders import (
+    FederatedDataset,
+    StreamingDataLoader,
+    load_data,
+    load_lending_club,
+    load_poisoned_dataset,
+    load_two_party_nus_wide,
+    load_three_party_nus_wide,
+    to_federated_arrays,
+    vertical_split,
+)
+from fedml_tpu.data.loaders.edge_case import make_backdoor_dataset, make_targeted_test_set
+
+
+def check_contract(fed: FederatedDataset):
+    t = fed.as_tuple()
+    assert len(t) == 9
+    assert fed.client_num == len(fed.train_data_local_dict)
+    assert fed.train_data_num == sum(fed.train_data_local_num_dict.values())
+    n = sum(len(bx) for bx, _ in fed.train_data_global)
+    assert n == fed.train_data_num
+    for cid, batches in fed.train_data_local_dict.items():
+        assert sum(len(bx) for bx, _ in batches) == fed.train_data_local_num_dict[cid]
+    assert fed.class_num >= 1
+
+
+ALL_SYNTH = [
+    "mnist",
+    "shakespeare",
+    "femnist",
+    "fed_cifar100",
+    "fed_shakespeare",
+    "stackoverflow_lr",
+    "stackoverflow_nwp",
+    "cifar10",
+    "cifar100",
+    "cinic10",
+    "imagenet",
+    "gld23k",
+    "synthetic_1_1",
+]
+
+
+@pytest.mark.parametrize("name", ALL_SYNTH)
+def test_load_data_synthetic_fallback(name):
+    fed = load_data(name, client_num_in_total=6, batch_size=8, partition_alpha=0.5)
+    check_contract(fed)
+
+
+def test_leaf_json_roundtrip(tmp_path):
+    users = [f"u{i}" for i in range(4)]
+    for split in ("train", "test"):
+        d = tmp_path / split
+        d.mkdir()
+        payload = {
+            "users": users,
+            "user_data": {
+                u: {
+                    "x": np.random.RandomState(i).rand(5, 784).tolist(),
+                    "y": [i % 10] * 5,
+                }
+                for i, u in enumerate(users)
+            },
+        }
+        (d / "all_data.json").write_text(json.dumps(payload))
+    fed = load_data("mnist", data_dir=str(tmp_path), batch_size=4)
+    check_contract(fed)
+    assert fed.client_num == 4
+    assert fed.train_data_num == 20
+
+
+def test_tff_h5_roundtrip(tmp_path):
+    from fedml_tpu.data.loaders import write_synthetic_h5
+
+    tp = tmp_path / "fed_emnist_train.h5"
+    sp = tmp_path / "fed_emnist_test.h5"
+    write_synthetic_h5(str(tp), 5, 12, "pixels", (28, 28), "label", 62)
+    write_synthetic_h5(str(sp), 5, 4, "pixels", (28, 28), "label", 62)
+    fed = load_data("femnist", data_dir=str(tmp_path), batch_size=4)
+    check_contract(fed)
+    assert fed.client_num == 5
+    x0, _ = fed.train_data_local_dict[0][0]
+    assert x0.shape[1:] == (28, 28, 1)
+
+
+def test_cifar10_pickle_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    for i in range(1, 6):
+        with open(tmp_path / f"data_batch_{i}", "wb") as f:
+            pickle.dump(
+                {
+                    b"data": rng.randint(0, 255, (20, 3072), dtype=np.uint8),
+                    b"labels": rng.randint(0, 10, 20).tolist(),
+                },
+                f,
+            )
+    with open(tmp_path / "test_batch", "wb") as f:
+        pickle.dump(
+            {
+                b"data": rng.randint(0, 255, (40, 3072), dtype=np.uint8),
+                b"labels": rng.randint(0, 10, 40).tolist(),
+            },
+            f,
+        )
+    fed = load_data(
+        "cifar10", data_dir=str(tmp_path), partition_method="homo",
+        client_num_in_total=4, batch_size=8,
+    )
+    check_contract(fed)
+    assert fed.train_data_num == 100
+    x0, _ = fed.train_data_local_dict[0][0]
+    assert x0.shape[1:] == (32, 32, 3)
+    assert abs(float(np.asarray(x0).mean())) < 3.0  # normalized
+
+
+def test_hetero_partition_is_nonuniform():
+    fed = load_data(
+        "cifar10", partition_method="hetero", partition_alpha=0.1,
+        client_num_in_total=8, batch_size=16,
+    )
+    sizes = list(fed.train_data_local_num_dict.values())
+    assert min(sizes) >= 10
+    assert max(sizes) > min(sizes)
+
+
+def test_to_federated_arrays_matches_counts():
+    fed = load_data("synthetic_1_1", client_num_in_total=6, batch_size=8)
+    arrays = to_federated_arrays(fed, batch_size=8)
+    assert arrays.num_clients == 6
+
+
+def test_shakespeare_vocab():
+    assert text.VOCAB_SIZE == 90
+    ids = text.word_to_indices("the ")
+    assert all(0 <= i < len(text.ALL_LETTERS) for i in ids)
+    seq = text.shakespeare_preprocess(["to be or not to be"])
+    assert seq.shape == (1, text.SHAKESPEARE_SEQ_LEN + 1)
+
+
+def test_stackoverflow_vocab_size():
+    v = text.StackOverflowVocab([f"w{i}" for i in range(10000)])
+    assert v.vocab_size == 10004
+    x, y = v.encode_nwp(["w1 w2 w3"], max_seq_len=20)
+    assert x.shape == (1, 20) and y.shape == (1, 20)
+
+
+def test_backdoor_and_targeted_sets():
+    x = np.zeros((100, 8, 8, 3), np.float32)
+    y = np.arange(100, dtype=np.int32) % 10
+    xp, yp, mask = make_backdoor_dataset(x, y, target_label=7, fraction=0.3)
+    assert mask.sum() == 30
+    assert (yp[mask] == 7).all()
+    assert (xp[mask][:, -3:, -3:, :] != 0).any() or x.max() == 0
+    tx, ty = make_targeted_test_set(x, y, target_label=7)
+    assert (ty == 7).all() and len(tx) == 90  # non-target classes only
+
+
+def test_poisoned_loader():
+    train, clean, targeted, n_poison = load_poisoned_dataset(n_samples=200, batch_size=16)
+    assert n_poison == 40
+    assert len(train) and len(clean) and len(targeted)
+
+
+def test_vertical_loaders():
+    (xa, xb, y), (xat, xbt, yt) = load_two_party_nus_wide(n_samples=100)
+    assert xa.shape[1] == 634 and xb.shape[1] == 1000
+    assert len(xa) == len(y) == 80
+    (a3, b1, b2, y3), _ = load_three_party_nus_wide(n_samples=100)
+    assert b1.shape[1] + b2.shape[1] == 1000
+    (ga, gb, gy), _ = load_lending_club(n_samples=100)
+    assert ga.shape[1] == 20 and gb.shape[1] == 18
+    parts = vertical_split(np.ones((5, 10)), [3, 3, 4])
+    assert [p.shape[1] for p in parts] == [3, 3, 4]
+
+
+def test_streaming_loader_modes():
+    for mode in ("stochastic", "adversarial"):
+        dl = StreamingDataLoader(sample_num_in_total=160, mode=mode)
+        streams = dl.load_datastream()
+        assert len(streams) == 8
+        assert sum(len(v) for v in streams.values()) == 160
+        xs, ys = dl.stream_arrays()
+        assert xs.shape[0] == 8 and xs.shape[1] == ys.shape[1]
+
+
+def test_on_device_augmentation():
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.data.augment import cifar_train_augment
+
+    x = jnp.ones((4, 32, 32, 3))
+    out = jax.jit(cifar_train_augment)(jax.random.PRNGKey(0), x)
+    assert out.shape == x.shape
+    # cutout must have zeroed something
+    assert float(out.min()) == 0.0
