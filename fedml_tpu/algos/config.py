@@ -36,3 +36,8 @@ class FedConfig:
     # Hierarchical FL (fedml_experiments/standalone/hierarchical_fl/main.py
     # flag --group_comm_round)
     group_comm_round: int = 1
+    # fed_launch extras (fed_launch/main.py:148-165): client-side LR
+    # schedule over rounds and gradient clipping.
+    lr_schedule: str = "none"  # none | cosine | step
+    lr_decay_rate: float = 0.992
+    grad_clip: float = 0.0

@@ -61,27 +61,41 @@ class FedAvgAPI(FederatedLoop):
                 "batch_size as the config"
             )
 
-        optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
-        self.local_train = self._build_local_train(optimizer, loss_fn)
-
-        transform = self._client_transform()
-        if mesh is None:
-            self.n_shards = 1
-            round_fn = make_vmap_round(self.local_train, client_transform=transform)
-        else:
-            # Pad the sampled set to the CLIENT axis size only (a 2-D mesh's
-            # model axis does not multiply the client shards).
-            self.n_shards = int(mesh.shape[mesh.axis_names[0]])
-            round_fn = make_sharded_round(
-                self.local_train, mesh, mesh.axis_names[0], client_transform=transform
-            )
-        self.round_fn = jax.jit(round_fn)
+        self._loss_fn = loss_fn
+        self.n_shards = 1 if mesh is None else int(mesh.shape[mesh.axis_names[0]])
+        self._client_lr = None
+        self.set_client_lr(cfg.lr)
         self.eval_fn = jax.jit(make_eval_fn(self.fns.apply, loss_fn, pad_id=pad_id))
 
         rng = jax.random.PRNGKey(cfg.seed)
         self.rng, init_rng = jax.random.split(rng)
         sample_x = np.asarray(train_fed.x[0, 0])
         self.net = self.fns.init(init_rng, sample_x)
+
+    def set_client_lr(self, lr: float):
+        """(Re)build the jitted round for a new client learning rate —
+        the hook the round-level LR schedulers use (fed_launch
+        schedulers decay the client LR across comm rounds). A no-op when
+        the lr is unchanged; each distinct lr value costs one re-jit, so
+        schedulers should quantize to a few buckets."""
+        if lr == self._client_lr:
+            return
+        self._client_lr = lr
+        cfg, mesh = self.cfg, self.mesh
+        optimizer = make_client_optimizer(
+            cfg.client_optimizer, lr, cfg.wd, cfg.grad_clip
+        )
+        self.local_train = self._build_local_train(optimizer, self._loss_fn)
+        transform = self._client_transform()
+        if mesh is None:
+            round_fn = make_vmap_round(self.local_train, client_transform=transform)
+        else:
+            # Pad the sampled set to the CLIENT axis size only (a 2-D mesh's
+            # model axis does not multiply the client shards).
+            round_fn = make_sharded_round(
+                self.local_train, mesh, mesh.axis_names[0], client_transform=transform
+            )
+        self.round_fn = jax.jit(round_fn)
 
     # --- hooks subclasses override (FedOpt/FedProx/...) -------------------
     def _build_local_train(self, optimizer, loss_fn):

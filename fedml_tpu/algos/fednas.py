@@ -31,7 +31,7 @@ import optax
 
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.loop import FederatedLoop
-from fedml_tpu.core.tree import tree_weighted_mean
+from fedml_tpu.core.tree import tree_select, tree_weighted_mean
 from fedml_tpu.data.batching import FederatedArrays, gather_clients
 from fedml_tpu.trainer.local import NetState, make_eval_fn, model_fns, softmax_ce
 
@@ -79,6 +79,10 @@ class FedNASAPI(FederatedLoop):
         self.xi = xi if unrolled else 0.0
         self.unrolled = unrolled
         self.n_shards = 1
+        # Architecture geometry for genotype() — taken from the model, not
+        # re-guessed from alpha shapes.
+        self._steps = int(getattr(model, "steps", 4))
+        self._multiplier = int(getattr(model, "multiplier", 4))
 
         rng = jax.random.PRNGKey(cfg.seed)
         self.rng, init_rng = jax.random.split(rng)
@@ -136,18 +140,18 @@ class FedNASAPI(FederatedLoop):
                 params = jax.tree.map(
                     lambda a, g: a - lr_w * g, params, _masked(gw, wmask))
 
-                nonempty = jnp.sum(mt) > 0
-                new_net = NetState(params, new_state)
-                net = jax.tree.map(
-                    lambda a, b: jnp.where(nonempty, a, b), new_net, net)
-                return (net, rng), loss
+                ns = jnp.sum(mt)
+                net = tree_select(ns > 0, NetState(params, new_state), net)
+                return (net, rng), (loss, ns)
 
             def epoch(carry, _):
-                carry, losses = jax.lax.scan(
+                # Sample-weighted epoch loss: padded all-masked steps return
+                # loss 0 and must not dilute the reported search_loss.
+                carry, (losses, ns) = jax.lax.scan(
                     step, carry,
                     ((x[:half], y[:half], mask[:half]),
                      (x[half:2 * half], y[half:2 * half], mask[half:2 * half])))
-                return carry, jnp.mean(losses)
+                return carry, jnp.sum(losses * ns) / jnp.maximum(jnp.sum(ns), 1.0)
 
             (net, _), losses = jax.lax.scan(
                 epoch, (net, rng), None, length=epochs)
@@ -182,9 +186,7 @@ class FedNASAPI(FederatedLoop):
         (reference record_model_global_architecture, FedNASAggregator.py:173)."""
         from fedml_tpu.models.darts import derive_genotype
 
-        steps = {14: 4, 9: 3, 5: 2, 2: 1}[
-            int(self.net.params["alphas_normal"].shape[0])]
         return derive_genotype(
             self.net.params["alphas_normal"],
-            self.net.params["alphas_reduce"], steps=steps,
-            multiplier=min(4, steps))
+            self.net.params["alphas_reduce"], steps=self._steps,
+            multiplier=self._multiplier)

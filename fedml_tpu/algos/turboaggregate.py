@@ -53,13 +53,20 @@ class TurboAggregateAPI(FedAvgAPI):
         self.scale = scale
         self.prime = prime
         self.dropout_mask: Optional[np.ndarray] = None
-        # Client-parallel local training WITHOUT the fused average: we need
-        # the per-client models for the MPC protocol.
-        self._local_batch = jax.jit(
-            jax.vmap(self.local_train, in_axes=(None, 0, 0, 0, 0)))
         from jax.flatten_util import ravel_pytree
 
         self._ravel = ravel_pytree
+
+    def set_client_lr(self, lr: float):
+        """Rebuild the client-parallel local step (per-client models,
+        WITHOUT the fused average — they feed the MPC protocol) whenever the
+        base class rebuilds ``local_train``, so LR schedules reach this
+        algorithm too."""
+        if lr == self._client_lr:
+            return
+        super().set_client_lr(lr)
+        self._local_batch = jax.jit(
+            jax.vmap(self.local_train, in_axes=(None, 0, 0, 0, 0)))
 
     def set_dropout(self, dropped: Optional[Sequence[int]]):
         """Mark clients (by position in the sampled round) as dropped
@@ -89,10 +96,13 @@ class TurboAggregateAPI(FedAvgAPI):
         flat0, unravel = self._ravel(self.net)
         group_sums = np.zeros((self.n_groups, flat0.shape[0]), np.int64)
         # Masks must come from secret randomness: derive the share rng from
-        # the session PRNG chain, never from public round state.
+        # the session PRNG chain (full 128-bit key as seed material), never
+        # from public round state. SIMULATION ONLY — MT19937 is not a
+        # CSPRNG; a production deployment must draw masks from an OS CSPRNG
+        # with pairwise key agreement (mpc.my_key_agreement) instead.
         self.rng, mask_rng = jax.random.split(self.rng)
-        share_rng = np.random.RandomState(
-            np.asarray(jax.random.key_data(mask_rng)).ravel()[-1] % (2 ** 31))
+        key_words = np.asarray(jax.random.key_data(mask_rng)).ravel()
+        share_rng = np.random.RandomState(key_words.astype(np.uint32))
         for c in range(len(weights)):
             if wn[c] == 0.0:
                 continue  # dropped or padded client: contributes nothing
@@ -109,6 +119,5 @@ class TurboAggregateAPI(FedAvgAPI):
         avg_flat = mpc.dequantize(total, self.scale, self.prime)
         self.net = unravel(jnp.asarray(avg_flat, jnp.float32))
 
-        lw = weights / max(weights.sum(), 1e-12)
-        loss = float(np.sum(np.asarray(losses, np.float64) * lw))
+        loss = float(np.sum(np.asarray(losses, np.float64) * wn))
         return {"round": round_idx, "train_loss": loss}

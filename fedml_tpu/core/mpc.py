@@ -69,6 +69,15 @@ def lagrange_coeffs(alpha_s, beta_s, p: int = DEFAULT_PRIME) -> np.ndarray:
     return U
 
 
+def _mod_matmul(U: np.ndarray, flat: np.ndarray, p: int) -> np.ndarray:
+    """U @ flat with every term reduced mod p — a plain int64 matmul of
+    field elements overflows at ≥3 accumulated products ((p−1)² ≈ 4.6e18)."""
+    out = np.zeros((U.shape[0], flat.shape[1]), np.int64)
+    for i in range(U.shape[1]):
+        out = np.mod(out + U[:, i, None] * flat[i][None], p)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # BGW / Shamir
 # ---------------------------------------------------------------------------
@@ -83,17 +92,22 @@ def bgw_encode(X, N: int, T: int, p: int = DEFAULT_PRIME,
     coeffs = rng.randint(0, p, size=(T + 1, m, d)).astype(np.int64)
     coeffs[0] = X
     V = _powers(np.arange(1, N + 1), T, p)  # [N, T+1]
-    shares = np.zeros((N, m, d), np.int64)
-    for t in range(T + 1):
-        shares = np.mod(shares + V[:, t, None, None] * coeffs[t][None], p)
-    return shares
+    shares = _mod_matmul(V, coeffs.reshape(T + 1, -1), p)
+    return shares.reshape(N, m, d)
 
 
-def bgw_decode(shares: np.ndarray, worker_idx, p: int = DEFAULT_PRIME):
+def bgw_decode(shares: np.ndarray, worker_idx, p: int = DEFAULT_PRIME,
+               T: int | None = None):
     """Reconstruct the secret from ≥T+1 shares; ``worker_idx`` are the
     0-based worker indices the shares came from (reference BGW_decoding
-    :90-108, evaluation point of worker i is i+1)."""
+    :90-108, evaluation point of worker i is i+1). Pass ``T`` to validate
+    the share count — with < T+1 shares Lagrange interpolation returns a
+    plausible-looking but WRONG reconstruction, so the check must be loud."""
     worker_idx = np.asarray(worker_idx, np.int64)
+    if T is not None and len(worker_idx) < T + 1:
+        raise ValueError(
+            f"bgw_decode needs >= T+1 = {T + 1} shares, got {len(worker_idx)}"
+        )
     alpha_eval = np.mod(worker_idx + 1, p)
     lam = lagrange_coeffs(np.zeros(1, np.int64), alpha_eval, p)[0]  # at x=0
     flat = shares.reshape(len(worker_idx), -1)
@@ -108,10 +122,15 @@ def bgw_decode(shares: np.ndarray, worker_idx, p: int = DEFAULT_PRIME):
 # ---------------------------------------------------------------------------
 
 def _lcc_points(N: int, K: int, T: int, p: int):
+    """Interpolation points beta (data+noise chunks) and evaluation points
+    alpha (workers). The sets MUST be disjoint: a worker whose alpha equals
+    some beta_k (k < K) would receive that plaintext chunk as its "share",
+    voiding the T-noise privacy guarantee. beta = 0..K+T-1,
+    alpha = K+T..K+T+N-1 (requires K+T+N < p, trivially true here)."""
     n_beta = K + T
-    stt_b, stt_a = -int(np.floor(n_beta / 2)), -int(np.floor(N / 2))
-    beta_s = np.mod(np.arange(stt_b, stt_b + n_beta), p).astype(np.int64)
-    alpha_s = np.mod(np.arange(stt_a, stt_a + N), p).astype(np.int64)
+    assert n_beta + N < p, "field too small for disjoint LCC point sets"
+    beta_s = np.arange(n_beta, dtype=np.int64)
+    alpha_s = np.arange(n_beta, n_beta + N, dtype=np.int64)
     return alpha_s, beta_s
 
 
@@ -130,20 +149,8 @@ def lcc_encode(X, N: int, K: int, T: int, p: int = DEFAULT_PRIME,
         chunks = np.concatenate([chunks, noise], axis=0)
     alpha_s, beta_s = _lcc_points(N, K, T, p)
     U = lagrange_coeffs(alpha_s, beta_s, p)  # [N, K+T]
-    flat = chunks.reshape(K + T, -1)
-    out = np.zeros((N, flat.shape[1]), np.int64)
-    for i in range(K + T):
-        out = np.mod(out + U[:, i, None] * flat[i][None], p)
+    out = _mod_matmul(U, chunks.reshape(K + T, -1), p)
     return out.reshape(N, m // K, d)
-
-
-def _mod_matmul(U: np.ndarray, flat: np.ndarray, p: int) -> np.ndarray:
-    """U @ flat with every term reduced mod p — a plain int64 matmul of
-    field elements overflows at ≥3 accumulated products ((p−1)² ≈ 4.6e18)."""
-    out = np.zeros((U.shape[0], flat.shape[1]), np.int64)
-    for i in range(U.shape[1]):
-        out = np.mod(out + U[:, i, None] * flat[i][None], p)
-    return out
 
 
 def lcc_decode(f_eval: np.ndarray, worker_idx, N: int, K: int, T: int,
@@ -152,6 +159,10 @@ def lcc_decode(f_eval: np.ndarray, worker_idx, N: int, K: int, T: int,
     (reference LCC_decoding :195-211). Returns [K, rows, d]."""
     alpha_s, beta_s = _lcc_points(N, K, T, p)
     worker_idx = np.asarray(worker_idx)
+    if len(worker_idx) < K + T:
+        raise ValueError(
+            f"lcc_decode needs >= K+T = {K + T} shares, got {len(worker_idx)}"
+        )
     U = lagrange_coeffs(beta_s[:K], alpha_s[worker_idx], p)  # [K, W]
     flat = f_eval.reshape(len(worker_idx), -1)
     rec = _mod_matmul(U, flat, p)

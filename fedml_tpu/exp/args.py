@@ -1,0 +1,88 @@
+"""Canonical experiment flags.
+
+Mirrors the reference's argparse set 1:1 (fedml_experiments/distributed/
+fedavg/main_fedavg.py:46-130) plus fed_launch's scheduler/clipping flags
+(fed_launch/main.py:148-165), so reference launch commands port unchanged:
+
+    python -m fedml_tpu.exp.main_fedavg --model resnet56 --dataset cifar10 \
+        --partition_method hetero --client_num_in_total 10 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.algos.config import FedConfig
+
+
+def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    p = parser
+    p.add_argument("--model", type=str, default="resnet56")
+    p.add_argument("--dataset", type=str, default="cifar10")
+    p.add_argument("--data_dir", type=str, default=None)
+    p.add_argument("--partition_method", type=str, default="hetero")
+    p.add_argument("--partition_alpha", type=float, default=0.5)
+    p.add_argument("--client_num_in_total", type=int, default=10)
+    p.add_argument("--client_num_per_round", type=int, default=10)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--client_optimizer", type=str, default="sgd")
+    p.add_argument("--backend", type=str, default="collective",
+                   help="collective (on-device) | loopback | tcp")
+    p.add_argument("--lr", type=float, default=0.03)
+    p.add_argument("--wd", type=float, default=1e-4)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--comm_round", type=int, default=10)
+    p.add_argument("--is_mobile", type=int, default=0)
+    p.add_argument("--frequency_of_the_test", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ci", type=int, default=0)
+    # server optimizer family (main_fedopt.py:54-66)
+    p.add_argument("--server_optimizer", type=str, default="sgd")
+    p.add_argument("--server_lr", type=float, default=1.0)
+    p.add_argument("--server_momentum", type=float, default=0.9)
+    # fedprox
+    p.add_argument("--fedprox_mu", type=float, default=0.1)
+    # robust (main_fedavg_robust.py)
+    p.add_argument("--norm_bound", type=float, default=5.0)
+    p.add_argument("--stddev", type=float, default=0.0)
+    # hierarchical (hierarchical_fl/main.py)
+    p.add_argument("--group_comm_round", type=int, default=1)
+    p.add_argument("--group_num", type=int, default=2)
+    # fed_launch extras (fed_launch/main.py:148-165)
+    p.add_argument("--lr_schedule", type=str, default="none",
+                   help="none | cosine | step")
+    p.add_argument("--lr_decay_rate", type=float, default=0.992)
+    p.add_argument("--grad_clip", type=float, default=0.0,
+                   help="max grad norm; 0 disables")
+    # mesh / sharding (TPU-native replacement for gpu_mapping yaml)
+    p.add_argument("--num_devices", type=int, default=0,
+                   help="shard clients over this many devices; 0 = single-device vmap")
+    return p
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description="fedml_tpu experiment")
+    add_args(parser)
+    return parser.parse_args(argv)
+
+
+def config_from_args(args: argparse.Namespace) -> FedConfig:
+    return FedConfig(
+        client_num_in_total=args.client_num_in_total,
+        client_num_per_round=args.client_num_per_round,
+        comm_round=args.comm_round,
+        epochs=args.epochs,
+        batch_size=args.batch_size,
+        client_optimizer=args.client_optimizer,
+        lr=args.lr,
+        wd=args.wd,
+        frequency_of_the_test=args.frequency_of_the_test,
+        seed=args.seed,
+        server_optimizer=args.server_optimizer,
+        server_lr=args.server_lr,
+        server_momentum=args.server_momentum,
+        fedprox_mu=args.fedprox_mu,
+        robust_norm_bound=args.norm_bound,
+        robust_stddev=args.stddev,
+        group_comm_round=args.group_comm_round,
+    )

@@ -1,0 +1,9 @@
+"""Distributed/standalone FedAvg entry — the north-star CLI
+(fedml_experiments/distributed/fedavg/main_fedavg.py:392-491). On TPU the
+"distributed" and "standalone" modes are the same program: clients are
+sharded over the device mesh (``--num_devices``) instead of MPI ranks."""
+
+from fedml_tpu.exp.run import main
+
+if __name__ == "__main__":
+    main(algorithm="FedAvg")

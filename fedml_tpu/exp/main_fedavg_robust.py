@@ -1,0 +1,8 @@
+"""Robust FedAvg entry (fedml_experiments/distributed/fedavg_robust/
+main_fedavg_robust.py): norm-clipping defense ``--norm_bound`` and weak-DP
+noise ``--stddev``."""
+
+from fedml_tpu.exp.run import main
+
+if __name__ == "__main__":
+    main(algorithm="FedAvgRobust")

@@ -1,0 +1,7 @@
+"""FedNova entry (fedml_experiments/standalone/fednova/main.py):
+normalized averaging over heterogeneous local step counts."""
+
+from fedml_tpu.exp.run import main
+
+if __name__ == "__main__":
+    main(algorithm="FedNova")

@@ -1,0 +1,8 @@
+"""FedOpt entry (fedml_experiments/distributed/fedopt/main_fedopt.py):
+FedAvg + server optimizer on the pseudo-gradient; choose with
+``--server_optimizer {sgd,adam,yogi,adagrad} --server_lr ...``."""
+
+from fedml_tpu.exp.run import main
+
+if __name__ == "__main__":
+    main(algorithm="FedOpt")

@@ -1,0 +1,7 @@
+"""FedProx entry — with the proximal μ term the reference's fedprox
+snapshot silently dropped (SURVEY.md §2.3); set ``--fedprox_mu``."""
+
+from fedml_tpu.exp.run import main
+
+if __name__ == "__main__":
+    main(algorithm="FedProx")

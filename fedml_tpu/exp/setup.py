@@ -1,0 +1,77 @@
+"""Experiment wiring: args → data → model → algorithm API.
+
+The reference's mains each re-implement ``load_data``/``create_model``
+switches (main_fedavg.py:133-390); here they are shared functions. The
+(model, dataset) → constructor-kwargs mapping reproduces
+main_fedavg.py:354-390.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fedml_tpu.data.batching import batch_global
+from fedml_tpu.data.loaders import FederatedDataset, load_data as _load_data, to_federated_arrays
+from fedml_tpu.models import create_model
+
+
+def load_data(args) -> FederatedDataset:
+    return _load_data(
+        args.dataset,
+        data_dir=args.data_dir,
+        partition_method=args.partition_method,
+        partition_alpha=args.partition_alpha,
+        client_num_in_total=args.client_num_in_total,
+        batch_size=args.batch_size,
+    )
+
+
+def create_model_for(args, fed: FederatedDataset):
+    """main_fedavg.py:354-390's (model, dataset) switch: lr for
+    mnist/stackoverflow_lr, cnn for femnist, resnet18_gn for fed_cifar100,
+    rnn for the shakespeares, rnn_stackoverflow for nwp, resnet56/mobilenet
+    for the cross-silo CV datasets."""
+    name, ds, ncls = args.model, args.dataset, fed.class_num
+    x0 = fed.train_data_global[0][0]
+    if name == "lr":
+        in_dim = int(np.prod(x0.shape[1:]))
+        return create_model("lr", num_classes=ncls, input_dim=in_dim)
+    if name == "rnn":
+        return create_model("rnn", vocab_size=ncls)
+    if name == "cnn":
+        return create_model("cnn", num_classes=ncls, only_digits=(ds == "mnist"))
+    return create_model(name, num_classes=ncls)
+
+
+def global_test_batches(fed: FederatedDataset, batch_size: int):
+    """Concatenate the global test batches into the on-device
+    ``(x, y, mask)`` eval layout."""
+    if not fed.test_data_global:
+        return None
+    xs = np.concatenate([b[0] for b in fed.test_data_global])
+    ys = np.concatenate([b[1] for b in fed.test_data_global])
+    return batch_global(xs, ys, batch_size)
+
+
+def build_mesh(num_devices: int):
+    if not num_devices:
+        return None
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    return client_mesh(num_devices)
+
+
+def setup_standard(args):
+    """(arrays, test_global, model, cfg, mesh) for the FedAvg-family mains."""
+    from fedml_tpu.exp.args import config_from_args
+
+    fed = load_data(args)
+    arrays = to_federated_arrays(fed, args.batch_size)
+    test = global_test_batches(fed, args.batch_size)
+    model = create_model_for(args, fed)
+    cfg = config_from_args(args)
+    # Clamp sampling like the reference (client_sampling takes min,
+    # FedAVGAggregator.py:92).
+    cfg.client_num_per_round = min(cfg.client_num_per_round, fed.client_num)
+    cfg.client_num_in_total = fed.client_num
+    return fed, arrays, test, model, cfg, build_mesh(args.num_devices)

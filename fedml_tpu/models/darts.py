@@ -108,8 +108,17 @@ class FactorizedReduce(nn.Module):
     def __call__(self, x, train: bool = False):
         x = nn.relu(x)
         a = nn.Conv(self.c_out // 2, (1, 1), (2, 2), use_bias=False)(x)
+        # The shifted branch loses one row/col; on odd spatial dims its
+        # stride-2 output would be one smaller than ``a``'s ceil(H/2), so
+        # pad the shift back to keep both branches the same size.
+        shifted = x[:, 1:, 1:, :]
+        pad_h = x.shape[1] % 2
+        pad_w = x.shape[2] % 2
+        if pad_h or pad_w:
+            shifted = jnp.pad(
+                shifted, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
         b = nn.Conv(self.c_out - self.c_out // 2, (1, 1), (2, 2),
-                    use_bias=False)(x[:, 1:, 1:, :])
+                    use_bias=False)(shifted)
         x = jnp.concatenate([a, b], axis=-1)
         return Norm(self.norm)(x, train)
 
@@ -127,7 +136,10 @@ class MixedOp(nn.Module):
         for prim in PRIMITIVES:
             s = self.strides
             if prim == "none":
-                o = jnp.zeros(x.shape[:1] + (x.shape[1] // s, x.shape[2] // s,
+                # SAME-padding output size = ceil(H/s), matching the pool
+                # and conv branches on odd spatial dims.
+                o = jnp.zeros(x.shape[:1] + (-(-x.shape[1] // s),
+                                             -(-x.shape[2] // s),
                                              self.c_out), x.dtype)
             elif prim == "max_pool_3x3":
                 o = nn.max_pool(x, (3, 3), strides=(s, s), padding="SAME")
@@ -195,6 +207,11 @@ class DartsNetwork(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.multiplier > self.steps:
+            raise ValueError(
+                f"multiplier ({self.multiplier}) must be <= steps "
+                f"({self.steps}): a cell concatenates its last `multiplier` "
+                "INTERMEDIATE nodes, and there are only `steps` of them")
         E, K = n_edges(self.steps), len(PRIMITIVES)
         alphas_normal = self.param(
             "alphas_normal", nn.initializers.normal(1e-3), (E, K))

@@ -71,24 +71,29 @@ def model_fns(module) -> ModelFns:
     return ModelFns(init=init, apply=apply)
 
 
-def make_client_optimizer(name: str, lr: float, wd: float = 0.0):
+def make_client_optimizer(name: str, lr: float, wd: float = 0.0, grad_clip: float = 0.0):
     """Client optimizers matching the reference's choices
     (MyModelTrainer.py:26-31): plain SGD, or Adam with weight decay +
-    amsgrad. ``momentum`` added as a TPU-era convenience."""
+    amsgrad. ``momentum`` added as a TPU-era convenience. ``grad_clip`` > 0
+    prepends global-norm clipping (fed_launch/main.py grad-clipping flag)."""
     if name == "sgd":
-        return optax.sgd(lr)
-    if name == "momentum":
-        return optax.sgd(lr, momentum=0.9)
-    if name == "adam":
+        opt = optax.sgd(lr)
+    elif name == "momentum":
+        opt = optax.sgd(lr, momentum=0.9)
+    elif name == "adam":
         # Coupled L2 (decay added to the gradient BEFORE the amsgrad
         # preconditioner) — matches torch.optim.Adam(weight_decay=wd,
         # amsgrad=True) as used by the reference, not AdamW.
-        return optax.chain(
+        opt = optax.chain(
             optax.add_decayed_weights(wd),
             optax.scale_by_amsgrad(),
             optax.scale(-lr),
         )
-    raise ValueError(f"unknown client optimizer {name!r}")
+    else:
+        raise ValueError(f"unknown client optimizer {name!r}")
+    if grad_clip and grad_clip > 0:
+        opt = optax.chain(optax.clip_by_global_norm(grad_clip), opt)
+    return opt
 
 
 def softmax_ce(logits, labels):

@@ -176,3 +176,51 @@ def test_fednas_unrolled_second_order_runs():
                     xi=0.05, unrolled=True)
     m = api.train_one_round(0)
     assert np.isfinite(m["search_loss"])
+
+
+def test_darts_odd_spatial_dims():
+    """Reduction cells must not crash on odd spatial dims (MixedOp 'none'
+    branch and FactorizedReduce both produce ceil(H/2) like SAME pooling)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.models.darts import darts
+    from fedml_tpu.trainer.local import model_fns
+
+    model = darts(num_classes=4, c=4, layers=2, steps=2, multiplier=2)
+    fns = model_fns(model)
+    net = fns.init(jax.random.PRNGKey(0), jnp.zeros((2, 9, 9, 3)))
+    logits, _ = fns.apply(net, jnp.zeros((2, 9, 9, 3)))
+    assert logits.shape == (2, 4)
+
+
+def test_mpc_decode_share_count_validation():
+    import numpy as np
+    import pytest
+
+    from fedml_tpu.core import mpc
+
+    x = np.arange(8, dtype=np.int64).reshape(4, 2)
+    shares = mpc.bgw_encode(x, N=5, T=2, rng=np.random.RandomState(0))
+    rec = mpc.bgw_decode(shares[:3], [0, 1, 2], T=2)
+    assert np.array_equal(rec, x)
+    with pytest.raises(ValueError):
+        mpc.bgw_decode(shares[:2], [0, 1], T=2)
+    lshares = mpc.lcc_encode(x, N=6, K=2, T=1, rng=np.random.RandomState(0))
+    with pytest.raises(ValueError):
+        mpc.lcc_decode(lshares[:2], [0, 1], N=6, K=2, T=1)
+
+
+def test_lcc_alpha_beta_disjoint_privacy():
+    """No worker's share may equal a raw data chunk (alpha∩beta=∅)."""
+    import numpy as np
+
+    from fedml_tpu.core import mpc
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 1000, (4, 3)).astype(np.int64)
+    shares = mpc.lcc_encode(x, N=6, K=2, T=1, rng=rng)
+    chunks = x.reshape(2, 2, 3)
+    for w in range(6):
+        for k in range(2):
+            assert not np.array_equal(shares[w], chunks[k])
