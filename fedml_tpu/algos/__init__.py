@@ -8,6 +8,7 @@ from fedml_tpu.algos.fednas import FedNASAPI
 from fedml_tpu.algos.fednova import FedNovaAPI
 from fedml_tpu.algos.fedopt import FedOptAPI
 from fedml_tpu.algos.fedprox import FedProxAPI
+from fedml_tpu.algos.fedseg import FedSegAPI
 from fedml_tpu.algos.hierarchical import HierarchicalFedAvgAPI
 from fedml_tpu.algos.robust import FedAvgRobustAPI
 from fedml_tpu.algos.split_nn import SplitNNAPI
@@ -28,6 +29,7 @@ __all__ = [
     "VflAPI",
     "FedOptAPI",
     "FedProxAPI",
+    "FedSegAPI",
     "HierarchicalFedAvgAPI",
     "FedAvgRobustAPI",
 ]

@@ -1,0 +1,172 @@
+"""Federated semantic segmentation (reference fedml_api/distributed/fedseg).
+
+FedAvg aggregation over a segmentation net + the fedseg metric/loss suite
+done the TPU way:
+
+- losses: pixel-wise CE and focal loss with an ``ignore_index``
+  (SegmentationLosses, fedseg/utils.py:71-123) as pure jax functions usable
+  inside the jitted local step;
+- metrics: confusion-matrix based pixel accuracy, per-class accuracy, mIoU
+  and FWIoU (Evaluator, fedseg/utils.py:246-280) computed ON DEVICE with
+  ``jnp.bincount`` over the flattened confusion index — no host sync per
+  batch — then reduced to scalars once per eval;
+- per-client metric tracking mirroring ``EvaluationMetricsKeeper`` and the
+  aggregator's train/test dicts (FedSegAggregator.py:105-160).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algos.fedavg import FedAvgAPI
+
+
+# ---------------------------------------------------------------------------
+# Losses (SegmentationLosses parity)
+# ---------------------------------------------------------------------------
+
+def seg_ce_loss(logits, labels, ignore_index: int = 255):
+    """Pixel-wise softmax CE over [B, H, W, C] logits / [B, H, W] int labels;
+    positions equal to ``ignore_index`` contribute nothing.
+
+    Returns a PER-EXAMPLE loss [B] (each sample's mean over its valid
+    pixels) — the ``loss_fn`` contract of ``make_local_train_fn``, whose
+    sample mask multiplies per-example losses; a batch-scalar here would let
+    padded samples' pixels leak into the gradient."""
+    valid = (labels != ignore_index)
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    per_pix = valid.reshape(valid.shape[0], -1)
+    per_nll = nll.reshape(nll.shape[0], -1)
+    return jnp.sum(per_nll, axis=1) / jnp.maximum(jnp.sum(per_pix, axis=1), 1.0)
+
+
+def seg_focal_loss(logits, labels, gamma: float = 2.0, alpha: float = 0.5,
+                   ignore_index: int = 255):
+    """Focal loss: α(1−p)^γ · CE (fedseg/utils.py:97-123). Per-example [B],
+    same contract as ``seg_ce_loss``."""
+    valid = (labels != ignore_index)
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    focal = alpha * (1.0 - jnp.exp(-nll)) ** gamma * nll
+    focal = jnp.where(valid, focal, 0.0)
+    per_pix = valid.reshape(valid.shape[0], -1)
+    per_f = focal.reshape(focal.shape[0], -1)
+    return jnp.sum(per_f, axis=1) / jnp.maximum(jnp.sum(per_pix, axis=1), 1.0)
+
+
+def build_seg_loss(mode: str = "ce", ignore_index: int = 255):
+    """SegmentationLosses.build_loss parity ('ce' | 'focal')."""
+    if mode == "ce":
+        return partial(seg_ce_loss, ignore_index=ignore_index)
+    if mode == "focal":
+        return partial(seg_focal_loss, ignore_index=ignore_index)
+    raise ValueError(f"unknown segmentation loss mode {mode!r}")
+
+
+# ---------------------------------------------------------------------------
+# Metrics (Evaluator parity, on-device)
+# ---------------------------------------------------------------------------
+
+def confusion_matrix(pred, labels, num_classes: int, ignore_index: int = 255):
+    """[C, C] confusion counts (rows = ground truth) via one bincount."""
+    valid = (labels != ignore_index) & (labels >= 0) & (labels < num_classes)
+    idx = jnp.where(valid, labels * num_classes + pred, num_classes * num_classes)
+    counts = jnp.bincount(idx.ravel(), length=num_classes * num_classes + 1)
+    return counts[:-1].reshape(num_classes, num_classes)
+
+
+def evaluator_scores(cm) -> Dict[str, jnp.ndarray]:
+    """Pixel acc / class acc / mIoU / FWIoU from a confusion matrix
+    (Evaluator.{Pixel_Accuracy,...}, fedseg/utils.py:251-280)."""
+    cm = cm.astype(jnp.float64) if cm.dtype == jnp.int64 else cm.astype(jnp.float32)
+    total = jnp.maximum(jnp.sum(cm), 1.0)
+    diag = jnp.diagonal(cm)
+    gt = jnp.sum(cm, axis=1)
+    pr = jnp.sum(cm, axis=0)
+    union = gt + pr - diag
+    present = gt > 0
+    acc = jnp.sum(diag) / total
+    acc_class = jnp.sum(jnp.where(present, diag / jnp.maximum(gt, 1.0), 0.0)) / (
+        jnp.maximum(jnp.sum(present), 1.0))
+    iou = jnp.where(union > 0, diag / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(jnp.where(present, iou, 0.0)) / jnp.maximum(jnp.sum(present), 1.0)
+    freq = gt / total
+    fwiou = jnp.sum(jnp.where(present, freq * iou, 0.0))
+    return {"acc": acc, "acc_class": acc_class, "mIoU": miou, "FWIoU": fwiou}
+
+
+class EvaluationMetricsKeeper:
+    """Per-client running metric store (fedseg/utils.py:62-69 + the
+    aggregator's dicts, FedSegAggregator.py:105-160)."""
+
+    def __init__(self):
+        self._store: Dict[int, Dict[str, float]] = {}
+
+    def add(self, client_idx: int, metrics: Dict[str, float]):
+        self._store[client_idx] = {k: float(v) for k, v in metrics.items()}
+
+    def aggregate(self) -> Dict[str, float]:
+        if not self._store:
+            return {}
+        keys = next(iter(self._store.values())).keys()
+        return {
+            k: float(np.mean([m[k] for m in self._store.values()]))
+            for k in keys
+        }
+
+
+# ---------------------------------------------------------------------------
+# The federated algorithm
+# ---------------------------------------------------------------------------
+
+class FedSegAPI(FedAvgAPI):
+    """FedAvg over a segmentation model with segmentation losses/metrics.
+
+    ``loss_mode``: 'ce' | 'focal'; labels use ``ignore_index`` for void
+    pixels. Eval reports acc/acc_class/mIoU/FWIoU over the global test set
+    with a single on-device confusion matrix.
+    """
+
+    def __init__(self, model, train_fed, test_global, cfg, num_classes: int,
+                 loss_mode: str = "ce", ignore_index: int = 255, **kw):
+        self.num_classes = num_classes
+        self.ignore_index = ignore_index
+        seg_loss = build_seg_loss(loss_mode, ignore_index)
+        super().__init__(model, train_fed, test_global, cfg,
+                         loss_fn=seg_loss, **kw)
+        self.metrics_keeper = EvaluationMetricsKeeper()
+
+        apply_fn = self.fns.apply
+        nc, ig = num_classes, ignore_index
+
+        def eval_cm(net, x, y, mask):
+            def step(cm, inputs):
+                bx, by, bm = inputs
+                logits, _ = apply_fn(net, bx, train=False)
+                pred = jnp.argmax(logits, axis=-1)
+                # Zero out padded rows via the ignore label.
+                by = jnp.where(bm[:, None, None] > 0, by, ig)
+                return cm + confusion_matrix(pred, by, nc, ig), None
+
+            cm0 = jnp.zeros((nc, nc), jnp.int32)
+            cm, _ = jax.lax.scan(step, cm0, (x, y, mask))
+            return cm
+
+        self._eval_cm = jax.jit(eval_cm)
+
+    def evaluate(self) -> Dict[str, float]:
+        if self.test_global is None:
+            return {}
+        x, y, mask = self.test_global
+        cm = self._eval_cm(self._eval_net(), x, y, mask)
+        scores = evaluator_scores(cm)
+        return {k: float(v) for k, v in scores.items()}

@@ -128,7 +128,12 @@ def _lcc_points(N: int, K: int, T: int, p: int):
     voiding the T-noise privacy guarantee. beta = 0..K+T-1,
     alpha = K+T..K+T+N-1 (requires K+T+N < p, trivially true here)."""
     n_beta = K + T
-    assert n_beta + N < p, "field too small for disjoint LCC point sets"
+    if n_beta + N >= p:
+        # Privacy-critical (a collision hands a worker a plaintext chunk);
+        # must survive python -O, so not an assert.
+        raise ValueError(
+            f"field p={p} too small for disjoint LCC point sets "
+            f"(need K+T+N={n_beta + N} < p)")
     beta_s = np.arange(n_beta, dtype=np.int64)
     alpha_s = np.arange(n_beta, n_beta + N, dtype=np.int64)
     return alpha_s, beta_s

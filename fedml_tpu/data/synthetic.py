@@ -47,6 +47,35 @@ def make_image_classification(
     return x, y
 
 
+def make_segmentation(
+    n_samples: int,
+    hw: Tuple[int, int] = (32, 32),
+    n_classes: int = 4,
+    seed: int = 0,
+    ignore_index: int = 255,
+    ignore_frac: float = 0.05,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Synthetic segmentation pairs: images with class-colored blobs, labels
+    the blob class map; a small fraction of void pixels (``ignore_index``)
+    exercises the ignore path of the fedseg losses/metrics."""
+    rng = np.random.RandomState(seed)
+    h, w = hw
+    x = np.zeros((n_samples, h, w, 3), np.float32)
+    y = np.zeros((n_samples, h, w), np.int32)
+    protos = rng.randn(n_classes, 3).astype(np.float32)
+    for i in range(n_samples):
+        # 2-4 random rectangles of random classes over a class-0 background
+        for _ in range(rng.randint(2, 5)):
+            c = rng.randint(1, n_classes)
+            y0, x0 = rng.randint(0, h // 2), rng.randint(0, w // 2)
+            y1, x1 = y0 + rng.randint(4, h // 2), x0 + rng.randint(4, w // 2)
+            y[i, y0:y1, x0:x1] = c
+        x[i] = protos[y[i]] + 0.3 * rng.randn(h, w, 3)
+        void = rng.rand(h, w) < ignore_frac
+        y[i][void] = ignore_index
+    return x, y
+
+
 def synthetic_alpha_beta(
     alpha: float = 1.0,
     beta: float = 1.0,

@@ -57,6 +57,14 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     # mesh / sharding (TPU-native replacement for gpu_mapping yaml)
     p.add_argument("--num_devices", type=int, default=0,
                    help="shard clients over this many devices; 0 = single-device vmap")
+    # observability (fedml_tpu.obs; the reference hard-wires wandb instead)
+    p.add_argument("--run_dir", type=str, default=None,
+                   help="directory for metrics.jsonl + checkpoints")
+    p.add_argument("--checkpoint_frequency", type=int, default=0,
+                   help="save full run state every N rounds; 0 disables")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in --run_dir")
+    p.add_argument("--wandb_project", type=str, default=None)
     return p
 
 
@@ -85,4 +93,7 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         robust_norm_bound=args.norm_bound,
         robust_stddev=args.stddev,
         group_comm_round=args.group_comm_round,
+        lr_schedule=args.lr_schedule,
+        lr_decay_rate=args.lr_decay_rate,
+        grad_clip=args.grad_clip,
     )

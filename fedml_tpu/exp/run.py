@@ -78,35 +78,75 @@ def run(args, algorithm: str = "FedAvg"):
         level=logging.INFO,
         format=f"[{algorithm} %(asctime)s] %(message)s",
     )
+    if args.backend != "collective":
+        raise NotImplementedError(
+            f"--backend {args.backend!r}: the exp runner drives the "
+            "on-device collective simulator; for message-passing cross-silo "
+            "runs use fedml_tpu.algos.fedavg_distributed with a comm "
+            "backend from fedml_tpu.comm")
     fed, arrays, test, model, cfg, mesh = setup_standard(args)
-    cfg.lr_schedule = args.lr_schedule
-    cfg.lr_decay_rate = args.lr_decay_rate
-    cfg.grad_clip = args.grad_clip
-    if args.ci:
-        # The reference's --ci flag shrinks eval cost
-        # (FedAVGAggregator.py:127-132); here rounds are already cheap, so
-        # just evaluate only at the end.
-        cfg.frequency_of_the_test = max(cfg.frequency_of_the_test, cfg.comm_round)
     api = make_api(algorithm, args, model, arrays, test, cfg, mesh)
 
+    from fedml_tpu.obs import MetricsLogger, RoundTimer
+
+    logger = MetricsLogger.for_run(
+        run_dir=args.run_dir, stdout=True,
+        wandb_project=getattr(args, "wandb_project", None),
+        config=vars(args),
+    )
+    timer = RoundTimer()
+    ckpt_mgr = None
+    start_round = 0
+    if args.run_dir and (args.checkpoint_frequency or args.resume):
+        import os
+
+        from fedml_tpu.obs import CheckpointManager, restore_run, save_run
+
+        ckpt_mgr = CheckpointManager(os.path.join(args.run_dir, "ckpt"))
+        if args.resume:
+            start_round = restore_run(ckpt_mgr, api)
+            if start_round:
+                logging.info("resumed from checkpoint at round %d", start_round)
+
     history = []
-    for r in range(cfg.comm_round):
+    for r in range(start_round, cfg.comm_round):
         if hasattr(api, "set_client_lr"):
             api.set_client_lr(
                 round_lr(args.lr, cfg.lr_schedule, r, cfg.comm_round, cfg.lr_decay_rate)
             )
-        metrics = api.train_one_round(r)
-        if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
-            metrics.update(api.evaluate())
-        logging.info(json.dumps(metrics))
+        timer.mark()
+        with timer.phase("round"):
+            metrics = api.train_one_round(r)
+            timer.fence(api.net)
+        # Reference cadence: every frequency_of_the_test rounds + final
+        # round; --ci evaluates the final round only (the flag's purpose is
+        # to cut eval cost, FedAVGAggregator.py:127-132).
+        do_eval = (r == cfg.comm_round - 1) or (
+            not args.ci and r % cfg.frequency_of_the_test == 0
+        )
+        if do_eval:
+            with timer.phase("eval"):
+                metrics.update(api.evaluate())
+        metrics.update(timer.flat_metrics())
+        logger.log(metrics, step=r)
         history.append(metrics)
+        if ckpt_mgr is not None and args.checkpoint_frequency and (
+            (r + 1) % args.checkpoint_frequency == 0 or r == cfg.comm_round - 1
+        ):
+            from fedml_tpu.obs import save_run
+
+            save_run(ckpt_mgr, api, r)
+    if ckpt_mgr is not None:
+        ckpt_mgr.close()
+    logger.close()
     return api, history
 
 
 def main(argv=None, algorithm: str = "FedAvg"):
     args = parse_args(argv)
     _, history = run(args, algorithm)
-    print(json.dumps(history[-1]))
+    # Empty history = resumed a run that had already completed.
+    print(json.dumps(history[-1] if history else {"status": "already_complete"}))
     return history
 
 
@@ -121,4 +161,4 @@ if __name__ == "__main__":
     add_args(parser)
     ns = parser.parse_args()
     _, hist = run(ns, ns.algorithm)
-    print(json.dumps(hist[-1]))
+    print(json.dumps(hist[-1] if hist else {"status": "already_complete"}))

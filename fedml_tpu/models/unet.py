@@ -1,0 +1,74 @@
+"""Compact encoder–decoder segmentation net (UNet-style, GroupNorm).
+
+Fills the fedseg model role (the reference trains DeepLab-family nets via an
+external repo; its in-repo fedseg package is model-agnostic —
+FedSegAggregator only needs [B, H, W, C] logits). GroupNorm everywhere: the
+reference needed SynchronizedBatchNorm (batchnorm_utils.py, 462 LoC) to sync
+BN across GPUs — GN makes that machinery unnecessary and is the
+federated-friendly choice (BN stats don't average well across non-IID
+clients).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.registry import register_model
+
+
+def _gn(c: int) -> nn.GroupNorm:
+    return nn.GroupNorm(num_groups=min(32, c))
+
+
+class ConvBlock(nn.Module):
+    c: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.c, (3, 3), use_bias=False)(x)
+        x = nn.relu(_gn(self.c)(x))
+        x = nn.Conv(self.c, (3, 3), use_bias=False)(x)
+        return nn.relu(_gn(self.c)(x))
+
+
+class UNet(nn.Module):
+    """Down/up levels with skip connections; logits at input resolution."""
+
+    num_classes: int
+    base: int = 16
+    levels: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        skips = []
+        c = self.base
+        for _ in range(self.levels):
+            x = ConvBlock(c)(x, train)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            c *= 2
+        x = ConvBlock(c)(x, train)
+        for skip in reversed(skips):
+            c //= 2
+            b, h, w, _ = skip.shape
+            x = jnp.reshape(
+                jnp.broadcast_to(x[:, :, None, :, None, :],
+                                 (b, x.shape[1], 2, x.shape[2], 2, x.shape[3])),
+                (b, x.shape[1] * 2, x.shape[2] * 2, x.shape[3]),
+            )
+            # Match the skip's spatial dims exactly: crop the 2x upsample if
+            # oversized, edge-pad if undersized (odd dims floor through
+            # max_pool, so 2*floor(h/2) can be h-1).
+            x = x[:, :h, :w, :]
+            dh, dw = h - x.shape[1], w - x.shape[2]
+            if dh or dw:
+                x = jnp.pad(x, ((0, 0), (0, dh), (0, dw), (0, 0)), mode="edge")
+            x = jnp.concatenate([x, skip], axis=-1)
+            x = ConvBlock(c)(x, train)
+        return nn.Conv(self.num_classes, (1, 1))(x)
+
+
+@register_model("unet")
+def unet(num_classes: int = 21, base: int = 16, levels: int = 3, **_):
+    return UNet(num_classes=num_classes, base=base, levels=levels)

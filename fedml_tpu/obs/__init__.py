@@ -1,0 +1,23 @@
+"""Observability: metrics sinks, round timing/profiling, checkpoint/resume.
+
+The reference's equivalents: wandb calls hard-wired into aggregators
+(FedAVGAggregator.py:140-161), coarse wall-clock logs, and no checkpointing
+(SURVEY.md §5). Here all three are framework subsystems.
+"""
+
+from fedml_tpu.obs.logger import JsonlSink, MetricsLogger, StdoutSink, WandbSink
+from fedml_tpu.obs.timing import RoundTimer, trace
+from fedml_tpu.obs.checkpoint import CheckpointManager, RunState, restore_run, save_run
+
+__all__ = [
+    "JsonlSink",
+    "MetricsLogger",
+    "StdoutSink",
+    "WandbSink",
+    "RoundTimer",
+    "trace",
+    "CheckpointManager",
+    "RunState",
+    "restore_run",
+    "save_run",
+]

@@ -1,0 +1,111 @@
+"""Checkpoint / resume (orbax).
+
+The reference has NO general mechanism — only FedGKT's ad-hoc best/last
+``.pth`` saves (GKTServerTrainer.py:212-219); nothing can resume a federated
+run mid-training (SURVEY.md §5). Here any ``FederatedLoop`` run checkpoints
+its full state — global model, server optimizer state, PRNG key, round
+index — and resumes bit-exactly.
+
+Layout: ``<dir>/<step>/state`` via orbax CheckpointManager (rotating
+``max_to_keep``, optional best-metric retention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass
+class RunState:
+    """Everything needed to resume a federated run."""
+
+    round_idx: int
+    net: Any                      # NetState pytree
+    rng: Any                      # jax PRNG key
+    server_opt_state: Any = None  # FedOpt family; None for plain FedAvg
+
+    def to_pytree(self) -> Dict:
+        return {
+            "round_idx": np.asarray(self.round_idx, np.int64),
+            "net": self.net,
+            "rng": jax.random.key_data(self.rng) if hasattr(
+                self.rng, "dtype") and jax.dtypes.issubdtype(
+                    self.rng.dtype, jax.dtypes.prng_key) else self.rng,
+            "server_opt_state": self.server_opt_state,
+        }
+
+
+class CheckpointManager:
+    """Thin orbax wrapper: ``save(step, state)`` / ``latest()`` /
+    ``restore(step, like=)``."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+        import os
+
+        self._dir = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+        self._ocp = ocp
+
+    def save(self, step: int, pytree: Dict, wait: bool = True):
+        self._mgr.save(step, args=self._ocp.args.StandardSave(pytree))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: Optional[int] = None, like: Optional[Dict] = None):
+        step = self.latest() if step is None else step
+        if step is None:
+            return None
+        if like is not None:
+            return self._mgr.restore(
+                step, args=self._ocp.args.StandardRestore(like)
+            )
+        return self._mgr.restore(step)
+
+    def close(self):
+        self._mgr.close()
+
+
+def save_run(mgr: CheckpointManager, api, round_idx: int):
+    """Checkpoint a ``FederatedLoop`` API (FedAvg family) after
+    ``round_idx`` completed rounds."""
+    state = RunState(
+        round_idx=round_idx,
+        net=api.net,
+        rng=api.rng,
+        server_opt_state=getattr(api, "server_opt_state", None),
+    )
+    mgr.save(round_idx, state.to_pytree())
+
+
+def restore_run(mgr: CheckpointManager, api) -> int:
+    """Restore the latest checkpoint into ``api`` (in place). Returns the
+    next round index to run (0 when no checkpoint exists)."""
+    template = RunState(
+        round_idx=0,
+        net=api.net,
+        rng=api.rng,
+        server_opt_state=getattr(api, "server_opt_state", None),
+    ).to_pytree()
+    restored = mgr.restore(like=template)
+    if restored is None:
+        return 0
+    api.net = restored["net"]
+    rng = restored["rng"]
+    # key_data round-trips as uint32 array; wrap back into a typed key.
+    api.rng = jax.random.wrap_key_data(np.asarray(rng))
+    if restored.get("server_opt_state") is not None and hasattr(api, "server_opt_state"):
+        api.server_opt_state = restored["server_opt_state"]
+    return int(restored["round_idx"]) + 1
