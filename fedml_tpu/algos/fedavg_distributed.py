@@ -236,6 +236,11 @@ def FedML_FedAvg_distributed(
     args = Args()
     if backend == "LOOPBACK":
         args.network = LoopbackNetwork(size)
+    elif backend == "TCP":
+        # Single-host table on ephemeral ports: bind rank servers first
+        # (port 0), then share the resolved table. Multi-host deployments
+        # pass an explicit host_table / grpc_ipconfig.csv instead.
+        args.host_table = {r: ("127.0.0.1", 0) for r in range(size)}
     aggregator = FedAVGAggregator(net0, worker_num, cfg, eval_fn, test_global)
     server = FedAVGServerManager(args, aggregator, cfg, size, backend=backend)
     clients = [

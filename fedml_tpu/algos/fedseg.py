@@ -170,3 +170,17 @@ class FedSegAPI(FedAvgAPI):
         cm = self._eval_cm(self._eval_net(), x, y, mask)
         scores = evaluator_scores(cm)
         return {k: float(v) for k, v in scores.items()}
+
+    def evaluate_clients(self, test_local: Dict[int, tuple]) -> Dict[str, float]:
+        """Per-client evaluation (the aggregator's add_client_test_result /
+        output_global_acc_and_loss flow, FedSegAggregator.py:105-160):
+        ``test_local`` maps client id → batched ``(x, y, mask)``; each
+        client's scores land in ``self.metrics_keeper`` and the unweighted
+        client mean is returned (the reference averages per-client metrics
+        the same way)."""
+        net = self._eval_net()
+        for cid, (x, y, mask) in test_local.items():
+            cm = self._eval_cm(net, x, y, mask)
+            self.metrics_keeper.add(
+                cid, {k: float(v) for k, v in evaluator_scores(cm).items()})
+        return self.metrics_keeper.aggregate()

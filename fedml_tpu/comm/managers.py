@@ -57,8 +57,12 @@ class _Manager(Observer):
 
     def finish(self) -> None:
         """Stop the receive loop. The reference calls MPI Abort here
-        (client_manager.py:72-75); loopback/tcp shut down cleanly."""
+        (client_manager.py:72-75); loopback/tcp shut down cleanly — tcp also
+        releases its native sockets."""
         self.com_manager.stop_receive_message()
+        close = getattr(self.com_manager, "close", None)
+        if close is not None:
+            close()
 
 
 class ClientManager(_Manager):

@@ -1,0 +1,139 @@
+"""Native TCP comm backend (cross-silo / DCN role).
+
+The reference fills this role with gRPC C-core (grpc_comm_manager.py:23):
+each rank runs a server and sends JSON messages to ``ip_config[receiver]``.
+Here the transport is the in-repo C++ ``msgnet`` library (length-prefixed
+frames over cached TCP connections, event-driven condvar queue — see
+fedml_tpu/native/msgnet.cpp) and the payload is the pickled ``Message``
+param dict, the same wire content the reference's MPI backend ships
+(mpi_send_thread.py:27 pickles whole dicts).
+
+Unlike the reference's gRPC manager — which listens on 50000+rank but sends
+to 8888+rank (grpc_comm_manager.py:59-63, a latent port mismatch; SURVEY.md
+§2.1) — the ip table here is the single source of truth for both sides.
+
+``read_ip_config`` parses the reference's ``grpc_ipconfig.csv`` format
+(receiver_id,ip[,port]).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import csv
+import pickle
+import threading
+from typing import Dict, List, Tuple
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.message import Message
+
+DEFAULT_BASE_PORT = 50000
+
+
+def read_ip_config(path: str, base_port: int = DEFAULT_BASE_PORT) -> Dict[int, Tuple[str, int]]:
+    """csv ``receiver_id,ip[,port]`` → {rank: (host, port)}; port defaults
+    to base_port+rank (utils/ip_config_utils.py:4 reads id→ip only)."""
+    out: Dict[int, Tuple[str, int]] = {}
+    with open(path) as f:
+        for row in csv.reader(f):
+            if not row or row[0].strip().startswith("#"):
+                continue
+            if row[0].strip().lower() in ("receiver_id", "rank"):
+                continue  # header
+            rank = int(row[0])
+            host = row[1].strip()
+            port = int(row[2]) if len(row) > 2 else base_port + rank
+            out[rank] = (host, port)
+    return out
+
+
+class TcpCommManager(BaseCommunicationManager):
+    """One instance per rank.
+
+    ``ip_config``: {rank: (host, port)}. The server binds ``port`` for this
+    rank (0 = ephemeral, then ``port`` property reports it — handy in
+    tests).
+    """
+
+    def __init__(self, ip_config: Dict[int, Tuple[str, int]], rank: int,
+                 backlog: int = 128, serializer: str = "pickle"):
+        """``serializer``: 'pickle' (fast; assumes TRUSTED silo peers — the
+        same trust model as the reference's pickled MPI dicts) or 'json'
+        (Message.to_json wire format, safe against malicious payloads, used
+        for untrusted/mobile edges like the reference's is_mobile mode)."""
+        from fedml_tpu.native import load_msgnet
+
+        if serializer not in ("pickle", "json"):
+            raise ValueError(f"unknown serializer {serializer!r}")
+        self._serializer = serializer
+        self._lib = load_msgnet()
+        self.rank = rank
+        # Shared BY REFERENCE: with ephemeral ports (port 0) each rank
+        # writes its resolved port back so peers constructed from the same
+        # table see it (single-host setups construct all managers
+        # sequentially before any send).
+        self.ip_config = ip_config
+        port = self.ip_config[rank][1]
+        self._server = self._lib.mn_server_create(port, backlog)
+        if self._server < 0:
+            raise OSError(f"msgnet: cannot bind port {port} for rank {rank}")
+        real_port = self._lib.mn_server_port(self._server)
+        self.ip_config[rank] = (self.ip_config[rank][0], real_port)
+        self._sender = self._lib.mn_sender_create()
+        self._observers: List[Observer] = []
+        self._running = False
+        self._stop_evt = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return self.ip_config[self.rank][1]
+
+    # -- BaseCommunicationManager ------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        receiver = int(msg.get_receiver_id())
+        host, port = self.ip_config[receiver]
+        if self._serializer == "pickle":
+            blob = pickle.dumps(msg.get_params(), protocol=pickle.HIGHEST_PROTOCOL)
+        else:
+            blob = msg.to_json().encode()
+        buf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
+        rc = self._lib.mn_send(self._sender, host.encode(), port, buf, len(blob))
+        if rc != 0:
+            raise ConnectionError(
+                f"msgnet: send from rank {self.rank} to {receiver} "
+                f"({host}:{port}) failed")
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        """Blocking receive loop; returns after ``stop_receive_message``."""
+        self._running = True
+        out_len = ctypes.c_uint64()
+        while self._running:
+            ptr = self._lib.mn_server_recv(self._server, 200, ctypes.byref(out_len))
+            if not ptr:
+                continue  # timeout tick: re-check _running
+            try:
+                blob = ctypes.string_at(ptr, out_len.value)
+            finally:
+                self._lib.mn_free(ptr)
+            if self._serializer == "pickle":
+                msg = Message()
+                msg.init(pickle.loads(blob))
+            else:
+                msg = Message.from_json(blob.decode())
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+        self._stop_evt.set()
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+
+    def close(self) -> None:
+        self.stop_receive_message()
+        self._lib.mn_server_stop(self._server)
+        self._lib.mn_sender_destroy(self._sender)

@@ -97,48 +97,51 @@ def run(args, algorithm: str = "FedAvg"):
     timer = RoundTimer()
     ckpt_mgr = None
     start_round = 0
-    if args.run_dir and (args.checkpoint_frequency or args.resume):
-        import os
-
-        from fedml_tpu.obs import CheckpointManager, restore_run, save_run
-
-        ckpt_mgr = CheckpointManager(os.path.join(args.run_dir, "ckpt"))
-        if args.resume:
-            start_round = restore_run(ckpt_mgr, api)
-            if start_round:
-                logging.info("resumed from checkpoint at round %d", start_round)
-
     history = []
-    for r in range(start_round, cfg.comm_round):
-        if hasattr(api, "set_client_lr"):
-            api.set_client_lr(
-                round_lr(args.lr, cfg.lr_schedule, r, cfg.comm_round, cfg.lr_decay_rate)
-            )
-        timer.mark()
-        with timer.phase("round"):
-            metrics = api.train_one_round(r)
-            timer.fence(api.net)
-        # Reference cadence: every frequency_of_the_test rounds + final
-        # round; --ci evaluates the final round only (the flag's purpose is
-        # to cut eval cost, FedAVGAggregator.py:127-132).
-        do_eval = (r == cfg.comm_round - 1) or (
-            not args.ci and r % cfg.frequency_of_the_test == 0
-        )
-        if do_eval:
-            with timer.phase("eval"):
-                metrics.update(api.evaluate())
-        metrics.update(timer.flat_metrics())
-        logger.log(metrics, step=r)
-        history.append(metrics)
-        if ckpt_mgr is not None and args.checkpoint_frequency and (
-            (r + 1) % args.checkpoint_frequency == 0 or r == cfg.comm_round - 1
-        ):
-            from fedml_tpu.obs import save_run
+    try:
+        if args.run_dir and (args.checkpoint_frequency or args.resume):
+            import os
 
-            save_run(ckpt_mgr, api, r)
-    if ckpt_mgr is not None:
-        ckpt_mgr.close()
-    logger.close()
+            from fedml_tpu.obs import CheckpointManager, restore_run, save_run
+
+            ckpt_mgr = CheckpointManager(os.path.join(args.run_dir, "ckpt"))
+            if args.resume:
+                start_round = restore_run(ckpt_mgr, api)
+                if start_round:
+                    logging.info("resumed from checkpoint at round %d", start_round)
+
+        for r in range(start_round, cfg.comm_round):
+            if hasattr(api, "set_client_lr"):
+                api.set_client_lr(
+                    round_lr(args.lr, cfg.lr_schedule, r, cfg.comm_round,
+                             cfg.lr_decay_rate)
+                )
+            timer.mark()
+            with timer.phase("round"):
+                metrics = api.train_one_round(r)
+                timer.fence(api.net)
+            # Reference cadence: every frequency_of_the_test rounds + final
+            # round; --ci evaluates the final round only (the flag's purpose
+            # is to cut eval cost, FedAVGAggregator.py:127-132).
+            do_eval = (r == cfg.comm_round - 1) or (
+                not args.ci and r % cfg.frequency_of_the_test == 0
+            )
+            if do_eval:
+                with timer.phase("eval"):
+                    metrics.update(api.evaluate())
+            metrics.update(timer.flat_metrics())
+            logger.log(metrics, step=r)
+            history.append(metrics)
+            if ckpt_mgr is not None and args.checkpoint_frequency and (
+                (r + 1) % args.checkpoint_frequency == 0 or r == cfg.comm_round - 1
+            ):
+                save_run(ckpt_mgr, api, r)
+    finally:
+        # Flush/close sinks and the checkpoint manager even on mid-run
+        # failure (OOM, NaN guard, KeyboardInterrupt).
+        if ckpt_mgr is not None:
+            ckpt_mgr.close()
+        logger.close()
     return api, history
 
 

@@ -15,10 +15,13 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from fedml_tpu.models.registry import register_model
+from fedml_tpu.models.resnet import Norm
 
 
-def _gn(c: int) -> nn.GroupNorm:
-    return nn.GroupNorm(num_groups=min(32, c))
+def _gn(c: int) -> Norm:
+    # Divisor-aware GroupNorm (c is unused — Norm reads channels from x;
+    # kept for call-site readability).
+    return Norm("gn")
 
 
 class ConvBlock(nn.Module):

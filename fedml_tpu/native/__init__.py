@@ -1,0 +1,64 @@
+"""Native (C++) runtime components, built on demand with the system
+toolchain and loaded via ctypes — no pybind11 dependency.
+
+``load_msgnet()`` compiles ``msgnet.cpp`` once (cached as
+``_build/libmsgnet.so``, keyed on source mtime) and returns the ctypes
+library with argtypes configured.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_HERE, "_build")
+_LOCK = threading.Lock()
+_LIB = None
+
+
+def _compile(src: str, out: str):
+    os.makedirs(_BUILD, exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-fPIC", "-shared", "-pthread", "-std=c++17",
+        src, "-o", out,
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed: {' '.join(cmd)}\n{proc.stderr[-4000:]}"
+        )
+
+
+def load_msgnet() -> ctypes.CDLL:
+    """Build (if stale) + load the message-transport library."""
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        src = os.path.join(_HERE, "msgnet.cpp")
+        out = os.path.join(_BUILD, "libmsgnet.so")
+        if not os.path.isfile(out) or os.path.getmtime(out) < os.path.getmtime(src):
+            _compile(src, out)
+        lib = ctypes.CDLL(out)
+        lib.mn_server_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.mn_server_create.restype = ctypes.c_int
+        lib.mn_server_port.argtypes = [ctypes.c_int]
+        lib.mn_server_port.restype = ctypes.c_int
+        lib.mn_server_recv.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)
+        ]
+        lib.mn_server_recv.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.mn_server_stop.argtypes = [ctypes.c_int]
+        lib.mn_sender_create.restype = ctypes.c_int
+        lib.mn_send.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+        ]
+        lib.mn_send.restype = ctypes.c_int
+        lib.mn_sender_destroy.argtypes = [ctypes.c_int]
+        lib.mn_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        _LIB = lib
+        return lib

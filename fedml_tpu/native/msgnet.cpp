@@ -1,0 +1,346 @@
+// msgnet: length-prefixed TCP message transport for cross-silo federation.
+//
+// The native runtime layer filling the role the reference reaches through
+// gRPC C-core / MPI / TensorPipe (SURVEY.md §2.1, §2.9): each rank runs a
+// server socket accepting framed messages into an internal queue
+// (mutex+condvar, event-driven — no 0.3 s polling like the reference's MPI
+// manager, mpi/com_manager.py:78), and sends through cached client
+// connections. Framing: [uint64 LE length][payload bytes].
+//
+// C API (ctypes-friendly): every function is exported with C linkage and
+// plain int/pointer types. Thread-safe. No Python dependency.
+//
+// Build: g++ -O2 -fPIC -shared -pthread msgnet.cpp -o libmsgnet.so
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Frame {
+  std::vector<uint8_t> data;
+};
+
+// Read exactly n bytes; false on EOF/error.
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;  // live connection sockets (for stop())
+  std::mutex conn_mu;         // guards conn_threads + conn_fds
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<Frame> queue;
+  // Bound the queue so a stalled consumer back-pressures instead of
+  // OOMing the host (the reference has no bound at all).
+  size_t max_queue = 4096;
+  // In-flight recv() calls; stop() must not let the object be destroyed
+  // while another thread is blocked inside recv (use-after-free).
+  int active_recvs = 0;
+
+  ~Server() { stop(); }
+
+  bool start(int port_, int backlog) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+      ::close(listen_fd);
+      listen_fd = -1;
+      return false;
+    }
+    if (port_ == 0) {  // ephemeral: report the bound port
+      socklen_t len = sizeof(addr);
+      ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    }
+    port = ntohs(addr.sin_port);
+    if (::listen(listen_fd, backlog) != 0) {
+      ::close(listen_fd);
+      listen_fd = -1;
+      return false;
+    }
+    running = true;
+    accept_thread = std::thread([this] { accept_loop(); });
+    return true;
+  }
+
+  void accept_loop() {
+    while (running) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (!running) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(conn_mu);
+      conn_fds.push_back(fd);
+      conn_threads.emplace_back([this, fd] { conn_loop(fd); });
+    }
+  }
+
+  void conn_loop(int fd) {
+    while (running) {
+      uint64_t len_le = 0;
+      if (!read_exact(fd, &len_le, sizeof(len_le))) break;
+      uint64_t len = le64toh(len_le);
+      // 4 GiB frame cap: a corrupt length must not drive a huge alloc.
+      if (len > (uint64_t(1) << 32)) break;
+      Frame f;
+      f.data.resize(len);
+      if (len > 0 && !read_exact(fd, f.data.data(), len)) break;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [this] { return queue.size() < max_queue || !running; });
+        if (!running) break;
+        queue.push_back(std::move(f));
+      }
+      cv.notify_all();
+    }
+    ::close(fd);
+  }
+
+  // Returns malloc'd buffer (caller frees via mn_free) or nullptr on
+  // timeout/stop. timeout_ms < 0 = block forever.
+  uint8_t* recv(int timeout_ms, uint64_t* out_len) {
+    std::unique_lock<std::mutex> lk(mu);
+    ++active_recvs;
+    auto ready = [this] { return !queue.empty() || !running; };
+    bool have = true;
+    if (timeout_ms < 0) {
+      cv.wait(lk, ready);
+    } else if (!cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), ready)) {
+      have = false;
+    }
+    uint8_t* buf = nullptr;
+    if (have && !queue.empty()) {
+      Frame f = std::move(queue.front());
+      queue.pop_front();
+      buf = static_cast<uint8_t*>(::malloc(f.data.size() ? f.data.size() : 1));
+      if (buf) {
+        std::memcpy(buf, f.data.data(), f.data.size());
+        *out_len = f.data.size();
+      }
+    }
+    --active_recvs;
+    lk.unlock();
+    cv.notify_all();  // wake back-pressured producers and a waiting stop()
+    return buf;
+  }
+
+  void stop() {
+    if (!running.exchange(false)) return;
+    cv.notify_all();
+    ::shutdown(listen_fd, SHUT_RDWR);
+    ::close(listen_fd);
+    if (accept_thread.joinable()) accept_thread.join();
+    {
+      // Unblock conn threads stuck in recv() on still-open peer
+      // connections, then join them.
+      std::lock_guard<std::mutex> lk(conn_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+      conn_fds.clear();
+      for (auto& t : conn_threads)
+        if (t.joinable()) t.join();
+      conn_threads.clear();
+    }
+    // Drain in-flight recv() calls before the destructor can run.
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [this] { return active_recvs == 0; });
+  }
+};
+
+struct Sender {
+  std::mutex mu;
+  std::map<std::pair<std::string, int>, int> conns;  // (host,port) -> fd
+
+  ~Sender() {
+    for (auto& kv : conns) ::close(kv.second);
+  }
+
+  int connect_to(const std::string& host, int port) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res) != 0)
+      return -1;
+    int fd = -1;
+    for (auto* rp = res; rp; rp = rp->ai_next) {
+      fd = ::socket(rp->ai_family, rp->ai_socktype, rp->ai_protocol);
+      if (fd < 0) continue;
+      if (::connect(fd, rp->ai_addr, rp->ai_addrlen) == 0) break;
+      ::close(fd);
+      fd = -1;
+    }
+    ::freeaddrinfo(res);
+    if (fd >= 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    }
+    return fd;
+  }
+
+  // 0 on success, -1 on failure (after one reconnect attempt — a cached
+  // connection may have been closed by the peer).
+  int send(const std::string& host, int port, const uint8_t* data, uint64_t len) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto key = std::make_pair(host, port);
+    for (int attempt = 0; attempt < 2; ++attempt) {
+      auto it = conns.find(key);
+      int fd;
+      if (it == conns.end()) {
+        fd = connect_to(host, port);
+        if (fd < 0) return -1;
+        conns[key] = fd;
+      } else {
+        fd = it->second;
+      }
+      uint64_t len_le = htole64(len);
+      if (write_exact(fd, &len_le, sizeof(len_le)) &&
+          write_exact(fd, data, len)) {
+        return 0;
+      }
+      ::close(fd);
+      conns.erase(key);
+    }
+    return -1;
+  }
+};
+
+std::mutex g_mu;
+std::map<int, Server*> g_servers;
+std::map<int, Sender*> g_senders;
+int g_next = 1;
+
+}  // namespace
+
+extern "C" {
+
+// Create a server listening on `port` (0 = ephemeral). Returns handle > 0
+// or -1.
+int mn_server_create(int port, int backlog) {
+  auto* s = new Server();
+  if (!s->start(port, backlog > 0 ? backlog : 128)) {
+    delete s;
+    return -1;
+  }
+  std::lock_guard<std::mutex> lk(g_mu);
+  int h = g_next++;
+  g_servers[h] = s;
+  return h;
+}
+
+int mn_server_port(int handle) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_servers.find(handle);
+  return it == g_servers.end() ? -1 : it->second->port;
+}
+
+// Blocking receive; returns malloc'd buffer (free with mn_free) or NULL.
+uint8_t* mn_server_recv(int handle, int timeout_ms, uint64_t* out_len) {
+  Server* s;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_servers.find(handle);
+    if (it == g_servers.end()) return nullptr;
+    s = it->second;
+  }
+  return s->recv(timeout_ms, out_len);
+}
+
+void mn_server_stop(int handle) {
+  Server* s = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_servers.find(handle);
+    if (it != g_servers.end()) {
+      s = it->second;
+      g_servers.erase(it);
+    }
+  }
+  if (s) {
+    s->stop();
+    delete s;
+  }
+}
+
+int mn_sender_create() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  int h = g_next++;
+  g_senders[h] = new Sender();
+  return h;
+}
+
+int mn_send(int handle, const char* host, int port, const uint8_t* data,
+            uint64_t len) {
+  Sender* s;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_senders.find(handle);
+    if (it == g_senders.end()) return -1;
+    s = it->second;
+  }
+  return s->send(host, port, data, len);
+}
+
+void mn_sender_destroy(int handle) {
+  Sender* s = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_senders.find(handle);
+    if (it != g_senders.end()) {
+      s = it->second;
+      g_senders.erase(it);
+    }
+  }
+  delete s;
+}
+
+void mn_free(uint8_t* buf) { ::free(buf); }
+
+}  // extern "C"

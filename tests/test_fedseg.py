@@ -96,3 +96,11 @@ def test_fedseg_learns():
     assert after["mIoU"] > before["mIoU"]
     assert after["acc"] > 0.5
     assert set(after) == {"acc", "acc_class", "mIoU", "FWIoU"}
+    # Per-client eval populates the keeper and averages client scores.
+    test_local = {
+        c: batch_global(xt[c * 8:(c + 1) * 8], yt[c * 8:(c + 1) * 8], 8)
+        for c in range(4)
+    }
+    per_client = api.evaluate_clients(test_local)
+    assert len(api.metrics_keeper._store) == 4
+    assert 0.0 <= per_client["mIoU"] <= 1.0
