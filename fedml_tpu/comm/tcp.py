@@ -21,7 +21,6 @@ from __future__ import annotations
 import ctypes
 import csv
 import pickle
-import threading
 from typing import Dict, List, Tuple
 
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
@@ -82,7 +81,6 @@ class TcpCommManager(BaseCommunicationManager):
         self._sender = self._lib.mn_sender_create()
         self._observers: List[Observer] = []
         self._running = False
-        self._stop_evt = threading.Event()
 
     @property
     def port(self) -> int:
@@ -96,8 +94,8 @@ class TcpCommManager(BaseCommunicationManager):
             blob = pickle.dumps(msg.get_params(), protocol=pickle.HIGHEST_PROTOCOL)
         else:
             blob = msg.to_json().encode()
-        buf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
-        rc = self._lib.mn_send(self._sender, host.encode(), port, buf, len(blob))
+        # bytes → const uint8* zero-copy (argtype c_char_p).
+        rc = self._lib.mn_send(self._sender, host.encode(), port, blob, len(blob))
         if rc != 0:
             raise ConnectionError(
                 f"msgnet: send from rank {self.rank} to {receiver} "
@@ -128,7 +126,6 @@ class TcpCommManager(BaseCommunicationManager):
                 msg = Message.from_json(blob.decode())
             for obs in list(self._observers):
                 obs.receive_message(msg.get_type(), msg)
-        self._stop_evt.set()
 
     def stop_receive_message(self) -> None:
         self._running = False

@@ -33,6 +33,7 @@ def create_model(name: str, **kwargs):
         import fedml_tpu.models.resnet  # noqa: F401
         import fedml_tpu.models.resnet_split  # noqa: F401
         import fedml_tpu.models.rnn  # noqa: F401
+        import fedml_tpu.models.transformer  # noqa: F401
         import fedml_tpu.models.unet  # noqa: F401
         import fedml_tpu.models.vfl  # noqa: F401
         import fedml_tpu.models.vgg  # noqa: F401

@@ -23,7 +23,7 @@ setting the reference's published fed_cifar100 baseline uses).
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Any, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -36,11 +36,13 @@ class Norm(nn.Module):
 
     kind: str = "gn"
     groups: int = 32
+    dtype: Any = None  # compute dtype (params stay float32)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if self.kind == "bn":
-            return nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                dtype=self.dtype)(x)
         c = x.shape[-1]
         # num_groups must divide the channel count: largest divisor of c
         # that is <= self.groups (reference group_normalization.py defaults
@@ -49,7 +51,7 @@ class Norm(nn.Module):
         g = min(self.groups, c)
         while c % g:
             g -= 1
-        return nn.GroupNorm(num_groups=g)(x)
+        return nn.GroupNorm(num_groups=g, dtype=self.dtype)(x)
 
 
 class BottleneckBlock(nn.Module):
@@ -57,25 +59,28 @@ class BottleneckBlock(nn.Module):
     strides: int = 1
     norm: str = "gn"
     expansion: int = 4
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         residual = x
-        y = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
-        y = Norm(self.norm)(y, train)
+        y = nn.Conv(self.planes, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = Norm(self.norm, dtype=self.dtype)(y, train)
         y = nn.relu(y)
         y = nn.Conv(self.planes, (3, 3), (self.strides, self.strides),
-                    padding="SAME", use_bias=False)(y)
-        y = Norm(self.norm)(y, train)
+                    padding="SAME", use_bias=False, dtype=self.dtype)(y)
+        y = Norm(self.norm, dtype=self.dtype)(y, train)
         y = nn.relu(y)
-        y = nn.Conv(self.planes * self.expansion, (1, 1), use_bias=False)(y)
-        y = Norm(self.norm)(y, train)
+        y = nn.Conv(self.planes * self.expansion, (1, 1), use_bias=False,
+                    dtype=self.dtype)(y)
+        y = Norm(self.norm, dtype=self.dtype)(y, train)
         if residual.shape != y.shape:
             residual = nn.Conv(
                 self.planes * self.expansion, (1, 1),
                 (self.strides, self.strides), use_bias=False, name="downsample",
+                dtype=self.dtype,
             )(x)
-            residual = Norm(self.norm)(residual, train)
+            residual = Norm(self.norm, dtype=self.dtype)(residual, train)
         return nn.relu(residual + y)
 
 
@@ -84,22 +89,24 @@ class BasicBlock(nn.Module):
     strides: int = 1
     norm: str = "gn"
     expansion: int = 1
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         residual = x
         y = nn.Conv(self.planes, (3, 3), (self.strides, self.strides),
-                    padding="SAME", use_bias=False)(x)
-        y = Norm(self.norm)(y, train)
+                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        y = Norm(self.norm, dtype=self.dtype)(y, train)
         y = nn.relu(y)
-        y = nn.Conv(self.planes, (3, 3), padding="SAME", use_bias=False)(y)
-        y = Norm(self.norm)(y, train)
+        y = nn.Conv(self.planes, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        y = Norm(self.norm, dtype=self.dtype)(y, train)
         if residual.shape != y.shape:
             residual = nn.Conv(
                 self.planes, (1, 1), (self.strides, self.strides),
-                use_bias=False, name="downsample",
+                use_bias=False, name="downsample", dtype=self.dtype,
             )(x)
-            residual = Norm(self.norm)(residual, train)
+            residual = Norm(self.norm, dtype=self.dtype)(residual, train)
         return nn.relu(residual + y)
 
 
@@ -109,17 +116,20 @@ class CifarResNet(nn.Module):
     layers: Sequence[int] = (6, 6, 6)  # 56 = 6*3*3 + 2
     num_classes: int = 10
     norm: str = "gn"
+    dtype: Any = None  # compute dtype; jnp.bfloat16 = mixed precision
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
-        x = Norm(self.norm)(x, train)
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = Norm(self.norm, dtype=self.dtype)(x, train)
         x = nn.relu(x)
         for stage, (planes, n_blocks) in enumerate(zip((16, 32, 64), self.layers)):
             for i in range(n_blocks):
                 strides = 2 if (stage > 0 and i == 0) else 1
-                x = BottleneckBlock(planes, strides, self.norm)(x, train)
-        x = jnp.mean(x, axis=(1, 2))
+                x = BottleneckBlock(planes, strides, self.norm,
+                                    dtype=self.dtype)(x, train)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         return nn.Dense(self.num_classes)(x)
 
 
@@ -134,14 +144,17 @@ class ResNetGN(nn.Module):
     num_classes: int = 100
     norm: str = "gn"
     small_input: bool = True
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if self.small_input:
-            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False)(x)
+            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype)(x)
         else:
-            x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], use_bias=False)(x)
-        x = Norm(self.norm)(x, train)
+            x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.dtype)(x)
+        x = Norm(self.norm, dtype=self.dtype)(x, train)
         x = nn.relu(x)
         if not self.small_input:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
@@ -150,25 +163,35 @@ class ResNetGN(nn.Module):
             planes = 64 * (2 ** stage)
             for i in range(n_blocks):
                 strides = 2 if (stage > 0 and i == 0) else 1
-                x = blk(planes, strides, self.norm)(x, train)
-        x = jnp.mean(x, axis=(1, 2))
+                x = blk(planes, strides, self.norm, dtype=self.dtype)(x, train)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         return nn.Dense(self.num_classes)(x)
 
 
+def _dt(dtype):
+    """'bf16'/'bfloat16' → jnp.bfloat16 (CLI-friendly); None/np dtype passthrough."""
+    if dtype in ("bf16", "bfloat16"):
+        return jnp.bfloat16
+    return dtype
+
+
 @register_model("resnet56")
-def resnet56(num_classes: int = 10, norm: str = "gn", **_):
-    return CifarResNet(layers=(6, 6, 6), num_classes=num_classes, norm=norm)
+def resnet56(num_classes: int = 10, norm: str = "gn", dtype=None, **_):
+    return CifarResNet(layers=(6, 6, 6), num_classes=num_classes, norm=norm,
+                       dtype=_dt(dtype))
 
 
 @register_model("resnet110")
-def resnet110(num_classes: int = 10, norm: str = "gn", **_):
-    return CifarResNet(layers=(12, 12, 12), num_classes=num_classes, norm=norm)
+def resnet110(num_classes: int = 10, norm: str = "gn", dtype=None, **_):
+    return CifarResNet(layers=(12, 12, 12), num_classes=num_classes, norm=norm,
+                       dtype=_dt(dtype))
 
 
 @register_model("resnet20")
-def resnet20(num_classes: int = 10, norm: str = "gn", **_):
+def resnet20(num_classes: int = 10, norm: str = "gn", dtype=None, **_):
     """Small CIFAR ResNet (2-2-2 bottleneck) — test/dryrun workhorse."""
-    return CifarResNet(layers=(2, 2, 2), num_classes=num_classes, norm=norm)
+    return CifarResNet(layers=(2, 2, 2), num_classes=num_classes, norm=norm,
+                       dtype=_dt(dtype))
 
 
 @register_model("resnet18_gn")

@@ -1,0 +1,87 @@
+"""Decoder-only transformer LM (flax) with pluggable attention.
+
+New TPU-era capability (the reference's NLP ceiling is an 80-char LSTM,
+model/nlp/rnn.py:4): a causal LM whose attention implementation is injected
+— dense single-chip attention by default, ring attention over a mesh
+``sp`` axis for long-context training (fedml_tpu.parallel.ring_attention).
+Pre-LN blocks, learned positional embeddings, bf16-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.registry import register_model
+from fedml_tpu.parallel.ring_attention import reference_attention
+
+
+class MHA(nn.Module):
+    n_heads: int
+    d_model: int
+    attn_fn: Optional[Callable] = None  # (q,k,v[,causal]) -> o, else dense
+    causal: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, _ = x.shape
+        d_head = self.d_model // self.n_heads
+        qkv = nn.Dense(3 * self.d_model, use_bias=False)(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (b, t, self.n_heads, d_head)
+        q, k, v = q.reshape(shp), k.reshape(shp), v.reshape(shp)
+        if self.attn_fn is not None:
+            o = self.attn_fn(q, k, v)
+        else:
+            o = reference_attention(q, k, v, causal=self.causal)
+        return nn.Dense(self.d_model, use_bias=False)(o.reshape(b, t, self.d_model))
+
+
+class Block(nn.Module):
+    n_heads: int
+    d_model: int
+    mlp_ratio: int = 4
+    attn_fn: Optional[Callable] = None
+    causal: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.LayerNorm()(x)
+        x = x + MHA(self.n_heads, self.d_model, self.attn_fn, self.causal)(h)
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(self.mlp_ratio * self.d_model)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(self.d_model)(h)
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    max_len: int = 2048
+    attn_fn: Optional[Callable] = None
+    causal: bool = True
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        b, t = tokens.shape
+        x = nn.Embed(self.vocab_size, self.d_model)(tokens)
+        pos = nn.Embed(self.max_len, self.d_model)(jnp.arange(t))
+        x = x + pos[None]
+        for _ in range(self.n_layers):
+            x = Block(self.n_heads, self.d_model,
+                      attn_fn=self.attn_fn, causal=self.causal)(x, train)
+        x = nn.LayerNorm()(x)
+        return nn.Dense(self.vocab_size, use_bias=False)(x)
+
+
+@register_model("transformer_lm")
+def transformer_lm(vocab_size: int = 90, d_model: int = 128, n_heads: int = 4,
+                   n_layers: int = 2, max_len: int = 2048,
+                   attn_fn: Optional[Callable] = None, **_):
+    return TransformerLM(vocab_size=vocab_size, d_model=d_model,
+                         n_heads=n_heads, n_layers=n_layers, max_len=max_len,
+                         attn_fn=attn_fn)

@@ -53,9 +53,11 @@ def load_msgnet() -> ctypes.CDLL:
         lib.mn_server_recv.restype = ctypes.POINTER(ctypes.c_uint8)
         lib.mn_server_stop.argtypes = [ctypes.c_int]
         lib.mn_sender_create.restype = ctypes.c_int
+        # data as c_char_p: a Python bytes object passes zero-copy (the C
+        # side takes const uint8* + explicit length; embedded NULs are fine).
         lib.mn_send.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64,
         ]
         lib.mn_send.restype = ctypes.c_int
         lib.mn_sender_destroy.argtypes = [ctypes.c_int]

@@ -19,6 +19,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -142,14 +143,30 @@ struct Server {
       }
       cv.notify_all();
     }
+    // Deregister BEFORE closing: once closed the kernel may recycle this fd
+    // number for an unrelated socket, and stop()'s shutdown(fd) would sever
+    // that stranger's connection.
+    {
+      std::lock_guard<std::mutex> lk(conn_mu);
+      conn_fds.erase(std::remove(conn_fds.begin(), conn_fds.end(), fd),
+                     conn_fds.end());
+    }
     ::close(fd);
   }
 
   // Returns malloc'd buffer (caller frees via mn_free) or nullptr on
   // timeout/stop. timeout_ms < 0 = block forever.
+  // Register an in-flight recv. MUST be called under g_mu (before the
+  // Server* escapes the handle map) so stop()+delete cannot slip between
+  // the map lookup and the increment (TOCTOU use-after-free).
+  void acquire_recv() {
+    std::lock_guard<std::mutex> lk(mu);
+    ++active_recvs;
+  }
+
+  // Caller must have called acquire_recv().
   uint8_t* recv(int timeout_ms, uint64_t* out_len) {
     std::unique_lock<std::mutex> lk(mu);
-    ++active_recvs;
     auto ready = [this] { return !queue.empty() || !running; };
     bool have = true;
     if (timeout_ms < 0) {
@@ -179,16 +196,18 @@ struct Server {
     ::shutdown(listen_fd, SHUT_RDWR);
     ::close(listen_fd);
     if (accept_thread.joinable()) accept_thread.join();
+    // Unblock conn threads stuck in recv() on still-open peer connections,
+    // then join them OUTSIDE conn_mu — an exiting conn thread takes conn_mu
+    // to deregister its fd, so joining under the lock would deadlock.
+    std::vector<std::thread> to_join;
     {
-      // Unblock conn threads stuck in recv() on still-open peer
-      // connections, then join them.
       std::lock_guard<std::mutex> lk(conn_mu);
       for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
       conn_fds.clear();
-      for (auto& t : conn_threads)
-        if (t.joinable()) t.join();
-      conn_threads.clear();
+      to_join.swap(conn_threads);
     }
+    for (auto& t : to_join)
+      if (t.joinable()) t.join();
     // Drain in-flight recv() calls before the destructor can run.
     std::unique_lock<std::mutex> lk(mu);
     cv.wait(lk, [this] { return active_recvs == 0; });
@@ -289,6 +308,7 @@ uint8_t* mn_server_recv(int handle, int timeout_ms, uint64_t* out_len) {
     auto it = g_servers.find(handle);
     if (it == g_servers.end()) return nullptr;
     s = it->second;
+    s->acquire_recv();  // under g_mu: stop() cannot delete s before this
   }
   return s->recv(timeout_ms, out_len);
 }

@@ -1,0 +1,115 @@
+"""Ring attention: exact attention over sequences sharded across a mesh
+axis (sequence/context parallelism).
+
+The reference has NO long-context machinery (SURVEY.md §2.10 — its largest
+sequence is an 80-char LSTM window); this is the TPU-native capability axis
+the task mandates. Design follows the blockwise/ring formulation (Liu &
+Abbeel; Ring Attention with Blockwise Transformers): each device holds a
+sequence shard of Q, K, V; K/V blocks rotate around the ring via
+``lax.ppermute`` over ICI while every device accumulates its Q-shard's
+attention with a streaming (online) softmax — running max ``m``, normalizer
+``l``, and unnormalized output ``o`` — so the result is bit-for-bit exact
+attention, never materializing the full [T, T] score matrix.
+
+Collectives ride the mesh axis (ICI when the axis maps to ICI), overlapping
+the permute of block ``i+1`` with compute of block ``i`` is left to XLA's
+latency-hiding scheduler.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One (Q-shard × KV-block) partial: returns scores-softmax pieces.
+
+    q: [B, Tq, H, D], k/v: [B, Tk, H, D], mask: [Tq, Tk] bool (True=keep).
+    Returns (m, l, o) block stats: m [B,H,Tq], l [B,H,Tq], o [B,Tq,H,D].
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # [B,H,Tq]
+    # exp(-inf - -inf) guards: fully-masked rows get m=-inf; make exp 0.
+    p = jnp.exp(s - jnp.where(jnp.isfinite(m), m, 0.0)[..., None])
+    p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B,H,Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return m, l, o
+
+
+def ring_attention_sharded(q, k, v, axis_name: str, causal: bool = False):
+    """Body to run INSIDE shard_map: q/k/v are the local shards
+    [B, T_local, H, D]; returns the local attention output shard.
+
+    Streaming-softmax accumulation across ring steps; the K/V pair rotates
+    ``n`` times so every Q shard sees every KV block exactly once.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    q_pos = my * t + jnp.arange(t)  # global positions of the local Q rows
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        src = (my - i) % n  # whose KV block we hold at step i
+        k_pos = src * t + jnp.arange(t)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = jnp.ones((t, t), bool)
+        bm, bl, bo = _block_attn(q, k_cur, v_cur, scale, mask)
+        m_new = jnp.maximum(m, bm)
+        # Correction factors; exp(-inf - -inf)=nan guard via where.
+        c_old = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+        c_blk = jnp.where(jnp.isfinite(bm), jnp.exp(bm - m_new), 0.0)
+        l = l * c_old + bl * c_blk
+        o = (o * c_old.transpose(0, 2, 1)[..., None]
+             + bo * c_blk.transpose(0, 2, 1)[..., None])
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return o, m_new, l, k_nxt, v_nxt
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full((b, h, t), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((b, h, t), q.dtype)
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    denom = jnp.where(l > 0, l, 1.0).transpose(0, 2, 1)[..., None]
+    return o / denom
+
+
+def make_ring_attention(mesh, axis_name: str = "sp", causal: bool = False):
+    """[B, T, H, D] full arrays → exact attention, sequence axis sharded
+    over ``mesh[axis_name]``; output replicates the input sharding."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
+        out_specs=P(None, axis_name),
+        check_vma=False,
+    )
+    def attn(q, k, v):
+        return ring_attention_sharded(q, k, v, axis_name, causal=causal)
+
+    return attn
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Naive full-matrix attention (test oracle)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

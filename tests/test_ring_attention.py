@@ -1,0 +1,105 @@
+"""Ring attention (sequence parallelism) correctness on the 8-device virtual
+mesh, and the transformer LM that consumes it."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.parallel.mesh import client_mesh
+from fedml_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    reference_attention,
+)
+
+
+def _mesh(n, name="sp"):
+    return client_mesh(n, axis_name=name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("n_dev", [2, 4, 8])
+def test_ring_attention_matches_dense(causal, n_dev):
+    rng = np.random.RandomState(0)
+    b, t, h, d = 2, 8 * n_dev, 3, 16
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    want = reference_attention(q, k, v, causal=causal)
+    mesh = _mesh(n_dev)
+    got = jax.jit(make_ring_attention(mesh, "sp", causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads_match_dense():
+    """Backward pass through the ring (ppermute differentiates) must equal
+    dense attention grads — training correctness, not just inference."""
+    rng = np.random.RandomState(1)
+    b, t, h, d = 1, 16, 2, 8
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    mesh = _mesh(4)
+    ring = make_ring_attention(mesh, "sp", causal=True)
+
+    g_ring = jax.grad(lambda a, b_, c: jnp.sum(ring(a, b_, c) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda a, b_, c: jnp.sum(reference_attention(a, b_, c, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_transformer_lm_with_ring_attention_trains():
+    """Tiny causal LM: loss falls with ring attention and matches the dense
+    implementation step-for-step (same params/rng)."""
+    import optax
+
+    from fedml_tpu.models import create_model
+    from fedml_tpu.trainer.local import model_fns
+
+    vocab, t = 31, 32
+    mesh = _mesh(4)
+    ring = make_ring_attention(mesh, "sp", causal=True)
+    dense = create_model("transformer_lm", vocab_size=vocab, d_model=32,
+                         n_heads=2, n_layers=1, max_len=t)
+    ringm = create_model("transformer_lm", vocab_size=vocab, d_model=32,
+                         n_heads=2, n_layers=1, max_len=t, attn_fn=ring)
+
+    rng = np.random.RandomState(2)
+    toks = jnp.asarray(rng.randint(0, vocab, (4, t)), jnp.int32)
+    fns_d, fns_r = model_fns(dense), model_fns(ringm)
+    net_d = fns_d.init(jax.random.PRNGKey(0), toks)
+    net_r = fns_r.init(jax.random.PRNGKey(0), toks)
+
+    def loss_fn(fns):
+        def f(net, toks):
+            logits, _ = fns.apply(net, toks, train=True)
+            x, y = toks[:, :-1], toks[:, 1:]
+            lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+            return -jnp.mean(jnp.take_along_axis(lp, y[..., None], -1))
+        return f
+
+    ld = loss_fn(fns_d)(net_d, toks)
+    lr_ = loss_fn(fns_r)(net_r, toks)
+    np.testing.assert_allclose(float(ld), float(lr_), rtol=1e-5)
+
+    opt = optax.adam(1e-2)
+
+    @jax.jit
+    def step(net, opt_state):
+        l, g = jax.value_and_grad(loss_fn(fns_r))(net, toks)
+        upd, opt_state = opt.update(g, opt_state)
+        import optax as _o
+
+        return _o.apply_updates(net, upd), opt_state, l
+
+    opt_state = opt.init(net_r)
+    losses = []
+    for _ in range(20):
+        net_r, opt_state, l = step(net_r, opt_state)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.7

@@ -25,9 +25,8 @@ def test_native_builds_and_raw_roundtrip(msgnet):
     port = msgnet.mn_server_port(h)
     assert port > 0
     s = msgnet.mn_sender_create()
-    payload = b"x" * 1_000_000  # 1 MB frame
-    buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
-    assert msgnet.mn_send(s, b"127.0.0.1", port, buf, len(payload)) == 0
+    payload = b"x" * 500_000 + b"\x00mid-null\x00" + b"y" * 500_000
+    assert msgnet.mn_send(s, b"127.0.0.1", port, payload, len(payload)) == 0
     out_len = ctypes.c_uint64()
     ptr = msgnet.mn_server_recv(h, 5000, ctypes.byref(out_len))
     assert ptr
