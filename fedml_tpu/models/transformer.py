@@ -81,7 +81,7 @@ class TransformerLM(nn.Module):
 @register_model("transformer_lm")
 def transformer_lm(vocab_size: int = 90, d_model: int = 128, n_heads: int = 4,
                    n_layers: int = 2, max_len: int = 2048,
-                   attn_fn: Optional[Callable] = None, **_):
+                   attn_fn: Optional[Callable] = None, causal: bool = True, **_):
     return TransformerLM(vocab_size=vocab_size, d_model=d_model,
                          n_heads=n_heads, n_layers=n_layers, max_len=max_len,
-                         attn_fn=attn_fn)
+                         attn_fn=attn_fn, causal=causal)

@@ -185,8 +185,11 @@ struct Server {
       }
     }
     --active_recvs;
-    lk.unlock();
+    // Notify while still holding mu: once we unlock with active_recvs==0 a
+    // waiting stop() may return and the object be deleted — notifying after
+    // unlock would touch a freed condition_variable.
     cv.notify_all();  // wake back-pressured producers and a waiting stop()
+    lk.unlock();
     return buf;
   }
 

@@ -1,0 +1,5 @@
+"""Fused TPU kernels (pallas)."""
+
+from fedml_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

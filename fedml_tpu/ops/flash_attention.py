@@ -1,0 +1,313 @@
+"""Flash attention as pallas TPU kernels (single-chip hot path).
+
+Fused blockwise attention with streaming softmax: the [T, T] score matrix
+is never materialized and VMEM usage is block-sized regardless of sequence
+length. Forward stores only the output and row log-sum-exp; backward
+recomputes probabilities blockwise (FlashAttention-2 style: dP = dO·Vᵀ,
+dS = P∘(dP − δ), δ = rowsum(dO∘O)) in three kernels (fwd, dq, dkv) wired
+through ``jax.custom_vjp``.
+
+Kernel structure (the TPU-idiomatic pattern): 3-D grid with the
+contraction block dim INNERMOST — TPU grids iterate sequentially over the
+last dimension, so VMEM scratch accumulators carry across it; the kernel
+initializes scratch on the first inner step and writes the output block on
+the last. K/V stream through VMEM one block per step (HBM→VMEM pipelined
+by pallas), which is what keeps T=64k+ within the 16 MB VMEM budget.
+
+Layout: [B, T, H, D] public API (matching
+fedml_tpu.parallel.ring_attention), flattened to [B*H, T, D]; the
+log-sum-exp / delta vectors are stored [B*H, 8, T] (8 identical sublanes)
+to satisfy the TPU (8, 128) tiling rule for 1-D-per-row outputs. On
+non-TPU backends the kernels run in interpreter mode so the same code path
+is testable on the CPU mesh; composes under ring attention as the
+per-shard computation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_SUB = 8  # sublane replication for per-row vectors
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _blk(t: int, want: int = 128) -> int:
+    return min(want, t)
+
+
+def _dot(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Forward: grid (bh, n_q, n_k), scratch carries (acc, m, l) across n_k
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
+                scale, causal, blk_q, blk_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+
+    # Causal: blocks entirely above the diagonal contribute nothing.
+    diag_ok = (qi + 1) * blk_q > ki * blk_k if causal else True
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = _dot(q, k, (((1,), (1,)))) * scale  # [blk_q, blk_k]
+        if causal:
+            q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, 1), 0)
+            k_pos = ki * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, blk_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev, l_prev = m_s[...], l_s[...]
+        m_blk = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)
+        c = jnp.exp(m_prev - m_new)
+        m_s[...] = m_new
+        l_s[...] = l_prev * c + jnp.sum(p, axis=1, keepdims=True)
+        acc[...] = acc[...] * c + _dot(p, v, ((1,), (0,)))
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_s[...]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc[...] / l_safe).astype(o_ref.dtype)
+        lse = (m_s[...] + jnp.log(l_safe))[:, 0]
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], (_SUB, blk_q))
+
+
+def _fwd(q3, k3, v3, scale, causal, blk_q, blk_k):
+    bh, t, d = q3.shape
+    grid = (bh, t // blk_q, t // blk_k)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, _SUB, blk_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, _SUB, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, d), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+
+
+# ---------------------------------------------------------------------------
+# Backward dq: grid (bh, n_q, n_k), dq accumulates across n_k
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc, *,
+               scale, causal, blk_q, blk_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+
+    diag_ok = (qi + 1) * blk_q > ki * blk_k if causal else True
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = _dot(q, k, ((1,), (1,))) * scale
+        if causal:
+            q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, 1), 0)
+            k_pos = ki * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, blk_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = _dot(do, v, ((1,), (1,)))
+        ds = p * (dp - delta)
+        acc[...] += _dot(ds, k, ((1,), (0,))) * scale
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0] = acc[...].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Backward dk/dv: grid (bh, n_k, n_q), dk/dv accumulate across n_q
+# ---------------------------------------------------------------------------
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *,
+                scale, causal, blk_q, blk_k):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    diag_ok = (qi + 1) * blk_q > ki * blk_k if causal else True
+
+    @pl.when(diag_ok)
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = _dot(q, k, ((1,), (1,))) * scale
+        if causal:
+            q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, 1), 0)
+            k_pos = ki * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, blk_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [blk_q, blk_k]
+        dv_acc[...] += _dot(p, do, ((0,), (0,)))
+        dp = _dot(do, v, ((1,), (1,)))
+        ds = p * (dp - delta)
+        dk_acc[...] += _dot(ds, q, ((0,), (0,))) * scale
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, blk_q, blk_k):
+    bh, t, d = q3.shape
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :], (bh, _SUB, t))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k),
+        grid=(bh, t // blk_q, t // blk_k),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, _SUB, blk_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, _SUB, blk_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k),
+        grid=(bh, t // blk_k, t // blk_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, _SUB, blk_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, _SUB, blk_q), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, d), jnp.float32),
+            pltpu.VMEM((blk_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q3, k3, v3, causal, blocks):
+    blk_q, blk_k = blocks
+    scale = 1.0 / (q3.shape[-1] ** 0.5)
+    o, _ = _fwd(q3, k3, v3, scale, causal, blk_q, blk_k)
+    return o
+
+
+def _flash_fwd(q3, k3, v3, causal, blocks):
+    blk_q, blk_k = blocks
+    scale = 1.0 / (q3.shape[-1] ** 0.5)
+    o, lse = _fwd(q3, k3, v3, scale, causal, blk_q, blk_k)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_bwd(causal, blocks, res, do3):
+    q3, k3, v3, o3, lse = res
+    blk_q, blk_k = blocks
+    scale = 1.0 / (q3.shape[-1] ** 0.5)
+    return _bwd(q3, k3, v3, o3, lse, do3, scale, causal, blk_q, blk_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128):
+    """Fused attention: q/k/v [B, T, H, D] → o [B, T, H, D].
+
+    T must be a multiple of the (clamped) block sizes; pad upstream if not.
+    Differentiable (custom VJP, FlashAttention-2-style backward).
+    """
+    b, t, h, d = q.shape
+    blk_q = _blk(t, block_q)
+    blk_k = _blk(t, block_k)
+    if t % blk_q or t % blk_k:
+        raise ValueError(
+            f"sequence length {t} must be a multiple of block sizes "
+            f"({blk_q}, {blk_k}); pad the sequence")
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    o3 = _flash(to3(q), to3(k), to3(v), causal, (blk_q, blk_k))
+    return o3.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -58,8 +58,7 @@ def ring_attention_sharded(q, k, v, axis_name: str, causal: bool = False):
 
     q_pos = my * t + jnp.arange(t)  # global positions of the local Q rows
 
-    def step(i, carry):
-        o, m, l, k_cur, v_cur = carry
+    def accumulate(i, o, m, l, k_cur, v_cur):
         src = (my - i) % n  # whose KV block we hold at step i
         k_pos = src * t + jnp.arange(t)
         if causal:
@@ -74,14 +73,22 @@ def ring_attention_sharded(q, k, v, axis_name: str, causal: bool = False):
         l = l * c_old + bl * c_blk
         o = (o * c_old.transpose(0, 2, 1)[..., None]
              + bo * c_blk.transpose(0, 2, 1)[..., None])
+        return o, m_new, l
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        o, m, l = accumulate(i, o, m, l, k_cur, v_cur)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return o, m_new, l, k_nxt, v_nxt
+        return o, m, l, k_nxt, v_nxt
 
     o0 = jnp.zeros_like(q)
     m0 = jnp.full((b, h, t), -jnp.inf, q.dtype)
     l0 = jnp.zeros((b, h, t), q.dtype)
-    o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    # n-1 rotating steps, then the final block WITHOUT the trailing
+    # ppermute pair (its result would be discarded — dead ICI traffic).
+    o, m, l, k_last, v_last = jax.lax.fori_loop(0, n - 1, step, (o0, m0, l0, k, v))
+    o, m, l = accumulate(n - 1, o, m, l, k_last, v_last)
     denom = jnp.where(l > 0, l, 1.0).transpose(0, 2, 1)[..., None]
     return o / denom
 
