@@ -1,0 +1,76 @@
+"""MQTT comm backend (device/mobile edge transport).
+
+Parity with the reference's ``MqttCommManager``
+(fedml_core/distributed/communication/mqtt/mqtt_comm_manager.py:14-128):
+pub/sub through an external broker, JSON payloads, topic scheme
+``fedml_<receiver>`` with per-sender uniqueness appended. Requires
+``paho-mqtt`` and a reachable broker — both import- and connect-gated, so
+the module is loadable (and the class introspectable) without them; the
+constructor raises a clear error if paho is absent.
+
+In the TPU framework this is strictly the DCN-edge bridge for real mobile
+devices (SURVEY.md §2.9); simulated federations use the collective path and
+cross-silo uses the native TCP backend.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import List
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.message import Message
+
+
+def _topic(receiver_id: int) -> str:
+    # Reference: server subscribes "fedml_<id>", clients mirror
+    # (mqtt_comm_manager.py:47-63).
+    return f"fedml_{receiver_id}"
+
+
+class MqttCommManager(BaseCommunicationManager):
+    def __init__(self, host: str, port: int, rank: int, size: int,
+                 topic_prefix: str = "fedml", keepalive: int = 180):
+        try:
+            import paho.mqtt.client as mqtt
+        except ImportError as e:  # pragma: no cover - env without paho
+            raise ImportError(
+                "MqttCommManager requires paho-mqtt and a reachable broker; "
+                "pip install paho-mqtt (the simulated/collective and TCP "
+                "backends have no such dependency)") from e
+
+        self.rank = rank
+        self.size = size
+        self.topic_prefix = topic_prefix
+        self._observers: List[Observer] = []
+        self._client = mqtt.Client(
+            client_id=f"{topic_prefix}_{rank}_{uuid.uuid4().hex[:8]}")
+        self._client.on_connect = self._on_connect
+        self._client.on_message = self._on_message
+        self._client.connect(host, port, keepalive)
+
+    # -- paho callbacks -----------------------------------------------------
+    def _on_connect(self, client, userdata, flags, rc):
+        client.subscribe(f"{self.topic_prefix}_{self.rank}", qos=1)
+
+    def _on_message(self, client, userdata, mqtt_msg):
+        msg = Message.from_json(mqtt_msg.payload.decode())
+        for obs in list(self._observers):
+            obs.receive_message(msg.get_type(), msg)
+
+    # -- BaseCommunicationManager -------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        topic = f"{self.topic_prefix}_{int(msg.get_receiver_id())}"
+        self._client.publish(topic, payload=msg.to_json(), qos=1)
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._client.loop_forever()
+
+    def stop_receive_message(self) -> None:
+        self._client.disconnect()

@@ -65,6 +65,13 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --run_dir")
     p.add_argument("--wandb_project", type=str, default=None)
+    p.add_argument("--synthetic_samples", type=int, default=0,
+                   help="override the synthetic-fallback dataset size "
+                        "(zero-egress runs); 0 = loader default")
+    # MQTT bridge (reference mqtt_comm_manager.py connects to an external
+    # broker; used only with --backend MQTT)
+    p.add_argument("--mqtt_host", type=str, default="127.0.0.1")
+    p.add_argument("--mqtt_port", type=int, default=1883)
     return p
 
 

@@ -16,6 +16,19 @@ from fedml_tpu.models import create_model
 
 
 def load_data(args) -> FederatedDataset:
+    from fedml_tpu.data.loaders import _CIFAR_FAMILY
+
+    kw = {}
+    n_synth = getattr(args, "synthetic_samples", 0)
+    if n_synth:
+        if args.dataset not in _CIFAR_FAMILY:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "--synthetic_samples is only honored by the CIFAR-family "
+                "loaders; ignored for %s", args.dataset)
+        else:
+            kw["synthetic_samples"] = n_synth
     return _load_data(
         args.dataset,
         data_dir=args.data_dir,
@@ -23,6 +36,7 @@ def load_data(args) -> FederatedDataset:
         partition_alpha=args.partition_alpha,
         client_num_in_total=args.client_num_in_total,
         batch_size=args.batch_size,
+        **kw,
     )
 
 

@@ -88,6 +88,39 @@ def resnet56_server(num_classes: int = 10, norm: str = "gn", **_):
                             num_classes=num_classes, norm=norm)
 
 
+@register_model("resnet20_server")
+def resnet20_server(num_classes: int = 10, norm: str = "gn", **_):
+    """Small server tail (2-2-2) — CI/smoke-size counterpart of
+    resnet56_server."""
+    return ResNetServerTail(layers=(2, 2, 2), block="bottleneck",
+                            num_classes=num_classes, norm=norm)
+
+
+class ResNetSplitBottom(nn.Module):
+    """SplitNN client bottom: the model's early layers only, features out
+    (the reference's split cuts one net at a layer — split_nn/client.py runs
+    just the bottom; no local logits, unlike the GKT stump)."""
+
+    n_blocks: int = 1
+    block: str = "basic"
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        x = Norm(self.norm)(x, train)
+        x = nn.relu(x)
+        blk = BasicBlock if self.block == "basic" else BottleneckBlock
+        for _ in range(self.n_blocks):
+            x = blk(16, 1, self.norm)(x, train)
+        return x
+
+
+@register_model("resnet_split_bottom")
+def resnet_split_bottom(n_blocks: int = 1, norm: str = "gn", **_):
+    return ResNetSplitBottom(n_blocks=n_blocks, norm=norm)
+
+
 @register_model("resnet110_server")
 def resnet110_server(num_classes: int = 10, norm: str = "gn", **_):
     return ResNetServerTail(layers=(12, 12, 12), block="bottleneck",

@@ -33,7 +33,21 @@ class MHA(nn.Module):
         shp = (b, t, self.n_heads, d_head)
         q, k, v = q.reshape(shp), k.reshape(shp), v.reshape(shp)
         if self.attn_fn is not None:
-            o = self.attn_fn(q, k, v)
+            # Forward causal when the injected attention accepts it (e.g.
+            # flash_attention defaults to causal=False — silently building a
+            # non-causal decoder would make training look great and
+            # generation garbage). Pre-bound callables (ring attention from
+            # make_ring_attention, partials, lambdas) are used as-is.
+            import inspect
+
+            try:
+                accepts_causal = "causal" in inspect.signature(self.attn_fn).parameters
+            except (TypeError, ValueError):
+                accepts_causal = False
+            if accepts_causal:
+                o = self.attn_fn(q, k, v, causal=self.causal)
+            else:
+                o = self.attn_fn(q, k, v)
         else:
             o = reference_attention(q, k, v, causal=self.causal)
         return nn.Dense(self.d_model, use_bias=False)(o.reshape(b, t, self.d_model))

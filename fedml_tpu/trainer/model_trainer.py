@@ -1,0 +1,185 @@
+"""ModelTrainer ABC + task trainers.
+
+Parity with the reference's framework-agnostic operator interface
+(fedml_core/trainer/model_trainer.py:4-38: get/set_model_params, train,
+test, test_on_the_server) and its three standalone task implementations
+(fedml_api/standalone/fedavg/my_model_trainer_classification.py, _nwp.py,
+_tag_prediction.py).
+
+On TPU the train loop is the jitted ``make_local_train_fn`` machinery; this
+class packages it in the reference's object shape so custom trainers can be
+passed to the experiment layer the way the reference passes
+``custom_model_trainer`` (standalone main_fedavg.py:269).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.trainer.local import (
+    NetState,
+    make_client_optimizer,
+    make_eval_fn,
+    make_local_train_fn,
+    model_fns,
+    seq_softmax_ce,
+    softmax_ce,
+)
+
+
+def sigmoid_bce(logits, labels):
+    """Per-example multi-label BCE (tag prediction: labels are multi-hot
+    [B, C]); mean over labels per sample."""
+    logp = jax.nn.log_sigmoid(logits)
+    lognp = jax.nn.log_sigmoid(-logits)
+    per_label = -(labels * logp + (1.0 - labels) * lognp)
+    return jnp.mean(per_label, axis=-1)
+
+
+class ModelTrainer(abc.ABC):
+    """The reference ABC, TPU-shaped: params are a pytree (NetState), the
+    id is the client index (model_trainer.py:10 set_id)."""
+
+    def __init__(self, model, args=None):
+        self.model = model
+        self.fns = model_fns(model)
+        self.args = args
+        self.id = 0
+        self.net: Optional[NetState] = None
+
+    def set_id(self, trainer_id: int):
+        self.id = trainer_id
+
+    def get_model_params(self):
+        return self.net
+
+    def set_model_params(self, net: NetState):
+        self.net = net
+
+    def init(self, rng, sample_x):
+        self.net = self.fns.init(rng, sample_x)
+        return self.net
+
+    @abc.abstractmethod
+    def train(self, train_data, device=None, args=None) -> None:
+        """Local training over [S, B, ...] packed batches (or a list of
+        (x, y) numpy batch pairs from the data loaders)."""
+
+    @abc.abstractmethod
+    def test(self, test_data, device=None, args=None) -> Dict[str, float]:
+        ...
+
+    def test_on_the_server(self, train_local_dict, test_local_dict,
+                           device=None, args=None) -> bool:
+        """Reference default: returns False (aggregator falls back to
+        per-client eval), model_trainer.py:34-38."""
+        return False
+
+    # -- shared plumbing ----------------------------------------------------
+    def _pack(self, data):
+        """Accept loader batch lists or pre-packed arrays."""
+        if isinstance(data, tuple) and len(data) == 3:
+            return data  # (x, y, mask) packed
+        xs = np.concatenate([np.asarray(b[0]) for b in data])
+        ys = np.concatenate([np.asarray(b[1]) for b in data])
+        bs = len(np.asarray(data[0][0]))
+        from fedml_tpu.data.batching import batch_global
+
+        return batch_global(xs, ys, bs)
+
+    def _build(self, loss_fn, pad_id=0):
+        args = self.args
+        opt = make_client_optimizer(
+            getattr(args, "client_optimizer", "sgd"),
+            getattr(args, "lr", 0.03),
+            getattr(args, "wd", 0.0),
+        )
+        epochs = getattr(args, "epochs", 1)
+        self._local = jax.jit(
+            make_local_train_fn(self.fns.apply, opt, epochs, loss_fn))
+        self._eval = jax.jit(make_eval_fn(self.fns.apply, loss_fn, pad_id=pad_id))
+        self._rng = jax.random.PRNGKey(getattr(args, "seed", 0) + self.id)
+
+    def _train_packed(self, data):
+        x, y, mask = self._pack(data)
+        self._rng, rng = jax.random.split(self._rng)
+        self.net, loss = self._local(self.net, x, y, mask, rng)
+        return float(loss)
+
+    def _test_packed(self, data):
+        x, y, mask = self._pack(data)
+        m = self._eval(self.net, x, y, mask)
+        return {k: float(v) for k, v in m.items()}
+
+
+class ClassificationTrainer(ModelTrainer):
+    """my_model_trainer_classification.py parity: CE loss, accuracy metric."""
+
+    def __init__(self, model, args=None):
+        super().__init__(model, args)
+        self._build(softmax_ce)
+
+    def train(self, train_data, device=None, args=None):
+        return self._train_packed(train_data)
+
+    def test(self, test_data, device=None, args=None):
+        return self._test_packed(test_data)
+
+
+class NwpTrainer(ModelTrainer):
+    """my_model_trainer_nwp.py parity: per-position CE with pad masking."""
+
+    def __init__(self, model, args=None, pad_id: int = 0):
+        super().__init__(model, args)
+        from functools import partial
+
+        self._build(partial(seq_softmax_ce, pad_id=pad_id), pad_id=pad_id)
+
+    def train(self, train_data, device=None, args=None):
+        return self._train_packed(train_data)
+
+    def test(self, test_data, device=None, args=None):
+        return self._test_packed(test_data)
+
+
+class TagPredictionTrainer(ModelTrainer):
+    """my_model_trainer_tag_prediction.py parity: multi-label BCE; test
+    reports precision/recall over the 0.5 threshold like the reference."""
+
+    def __init__(self, model, args=None):
+        super().__init__(model, args)
+        self._build(sigmoid_bce)
+
+        apply_fn = self.fns.apply
+
+        def prf(net, x, y, mask):
+            def step(acc, inputs):
+                bx, by, bm = inputs
+                logits, _ = apply_fn(net, bx, train=False)
+                pred = (logits > 0).astype(jnp.float32)
+                w = bm[:, None]
+                tp = jnp.sum(pred * by * w)
+                fp = jnp.sum(pred * (1 - by) * w)
+                fn = jnp.sum((1 - pred) * by * w)
+                t, p_, f_ = acc
+                return (t + tp, p_ + fp, f_ + fn), None
+
+            (tp, fp, fn), _ = jax.lax.scan(step, (0.0, 0.0, 0.0), (x, y, mask))
+            precision = tp / jnp.maximum(tp + fp, 1.0)
+            recall = tp / jnp.maximum(tp + fn, 1.0)
+            return precision, recall
+
+        self._prf = jax.jit(prf)
+
+    def train(self, train_data, device=None, args=None):
+        return self._train_packed(train_data)
+
+    def test(self, test_data, device=None, args=None):
+        x, y, mask = self._pack(test_data)
+        precision, recall = self._prf(self.net, x, y, mask)
+        return {"precision": float(precision), "recall": float(recall)}

@@ -124,3 +124,22 @@ def test_distributed_fedavg_loopback_trains():
     assert len(agg.test_history) >= 2
     accs = [h["accuracy"] for h in agg.test_history]
     assert accs[-1] > 0.5  # learns the linearly-separable task
+
+
+def test_mqtt_backend_gated_import():
+    """MQTT backend is import-gated: module loads without paho, constructor
+    raises a clear ImportError when paho is absent (or constructs when
+    present)."""
+    import pytest
+
+    from fedml_tpu.comm.mqtt import MqttCommManager, _topic
+
+    assert _topic(3) == "fedml_3"
+    try:
+        import paho.mqtt.client  # noqa: F401
+        has_paho = True
+    except ImportError:
+        has_paho = False
+    if not has_paho:
+        with pytest.raises(ImportError, match="paho-mqtt"):
+            MqttCommManager("localhost", 1883, rank=0, size=2)

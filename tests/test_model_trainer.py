@@ -1,0 +1,76 @@
+"""ModelTrainer ABC + the three task trainers (reference
+my_model_trainer_{classification,nwp,tag_prediction} parity)."""
+
+import types
+
+import jax
+import numpy as np
+
+from fedml_tpu.data.loaders.common import batch_data
+from fedml_tpu.models import create_model
+from fedml_tpu.trainer.model_trainer import (
+    ClassificationTrainer,
+    NwpTrainer,
+    TagPredictionTrainer,
+)
+
+
+def _args(**kw):
+    d = dict(client_optimizer="sgd", lr=0.3, wd=0.0, epochs=2, seed=0)
+    d.update(kw)
+    return types.SimpleNamespace(**d)
+
+
+def test_classification_trainer_learns():
+    rng = np.random.RandomState(0)
+    w = rng.randn(10, 4)
+    x = rng.randn(200, 10).astype(np.float32)
+    y = np.argmax(x @ w, 1).astype(np.int32)
+    batches = batch_data(x, y, 16)
+    tr = ClassificationTrainer(create_model("lr", input_dim=10, num_classes=4), _args())
+    tr.init(jax.random.PRNGKey(0), x[:1])
+    before = tr.test(batches)["accuracy"]
+    for _ in range(5):
+        tr.train(batches)
+    after = tr.test(batches)["accuracy"]
+    assert after > max(before, 0.5)
+
+
+def test_nwp_trainer_runs_and_masks_pad():
+    vocab, t = 23, 12
+    rng = np.random.RandomState(1)
+    x = rng.randint(1, vocab, (40, t)).astype(np.int32)
+    y = np.concatenate([x[:, 1:], np.zeros((40, 1), np.int32)], 1)  # pad tail
+    batches = batch_data(x, y, 8)
+    tr = NwpTrainer(create_model("rnn", vocab_size=vocab), _args(lr=0.5))
+    tr.init(jax.random.PRNGKey(0), x[:1])
+    l0 = tr.train(batches)
+    l1 = tr.train(batches)
+    assert np.isfinite(l0) and l1 < l0
+    m = tr.test(batches)
+    assert 0.0 <= m["accuracy"] <= 1.0
+
+
+def test_tag_trainer_precision_recall():
+    rng = np.random.RandomState(2)
+    x = rng.randn(120, 30).astype(np.float32)
+    w = rng.randn(30, 5)
+    y = ((x @ w) > 0).astype(np.float32)
+    batches = batch_data(x, y, 16)
+    tr = TagPredictionTrainer(create_model("lr", input_dim=30, num_classes=5),
+                              _args(lr=0.5, epochs=3))
+    tr.init(jax.random.PRNGKey(0), x[:1])
+    for _ in range(5):
+        tr.train(batches)
+    m = tr.test(batches)
+    assert m["precision"] > 0.7 and m["recall"] > 0.7
+
+
+def test_trainer_abc_surface():
+    tr = ClassificationTrainer(create_model("lr", input_dim=4, num_classes=2), _args())
+    tr.set_id(7)
+    assert tr.id == 7
+    tr.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.float32))
+    params = tr.get_model_params()
+    tr.set_model_params(params)
+    assert tr.test_on_the_server({}, {}) is False
