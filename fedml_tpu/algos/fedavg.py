@@ -44,11 +44,15 @@ class FedAvgAPI(FederatedLoop):
         mesh=None,
         loss_fn=softmax_ce,
         pad_id: int = 0,
+        nan_guard: bool = False,
     ):
         """``pad_id`` marks padding positions in sequence-task labels
         (excluded from eval accuracy); it must match the pad id baked into a
         sequence ``loss_fn`` (e.g. ``partial(seq_softmax_ce, pad_id=...)``).
-        Irrelevant for flat classification tasks."""
+        Irrelevant for flat classification tasks.
+
+        ``nan_guard``: zero-weight any client whose local training diverged
+        to non-finite params (fedml_tpu.core.faults failure containment)."""
         self.cfg = cfg
         self.mesh = mesh
         self.train_fed = train_fed
@@ -62,6 +66,7 @@ class FedAvgAPI(FederatedLoop):
             )
 
         self._loss_fn = loss_fn
+        self._nan_guard = nan_guard
         self.n_shards = 1 if mesh is None else int(mesh.shape[mesh.axis_names[0]])
         self._client_lr = None
         self.set_client_lr(cfg.lr)
@@ -87,13 +92,17 @@ class FedAvgAPI(FederatedLoop):
         )
         self.local_train = self._build_local_train(optimizer, self._loss_fn)
         transform = self._client_transform()
+        guard = self._nan_guard
         if mesh is None:
-            round_fn = make_vmap_round(self.local_train, client_transform=transform)
+            round_fn = make_vmap_round(
+                self.local_train, client_transform=transform, nan_guard=guard
+            )
         else:
             # Pad the sampled set to the CLIENT axis size only (a 2-D mesh's
             # model axis does not multiply the client shards).
             round_fn = make_sharded_round(
-                self.local_train, mesh, mesh.axis_names[0], client_transform=transform
+                self.local_train, mesh, mesh.axis_names[0],
+                client_transform=transform, nan_guard=guard,
             )
         self.round_fn = jax.jit(round_fn)
 

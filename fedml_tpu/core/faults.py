@@ -1,0 +1,151 @@
+"""Fault injection + failure detection.
+
+The reference's entire failure story is ``raise_MPI_error`` → traceback →
+``MPI.COMM_WORLD.Abort()`` (fedml_api/utils/context.py:9-18), plus
+Turbo-Aggregate's client dropout flag (TA_client.py:25) and the robustness
+harness's adversarial clients (main_fedavg_robust.py:82-83). Here those
+become framework subsystems:
+
+- ``DropoutInjector`` — per-round Bernoulli client dropout (the TA dropout
+  generalized to every algorithm: returns a weight mask);
+- ``UpdateCorruptor`` — adversarial/fault update injection for robustness
+  testing (sign-flip, gradient-scaling, NaN faults);
+- ``HeartbeatMonitor`` — wall-clock failure detector for the message-passing
+  path: ranks check in, anything silent past ``timeout_s`` is reported
+  failed instead of hanging the federation;
+- the aggregation-side NaN guard lives in fedml_tpu.parallel.shard
+  (``nan_guard=True``): a diverged client is zero-weighted, not averaged.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DropoutInjector:
+    """Bernoulli(p) per-round client dropout; seeded and round-keyed so runs
+    reproduce (reference TA dropout is a manual list; this simulates churn)."""
+
+    def __init__(self, p: float, seed: int = 0):
+        if not 0.0 <= p < 1.0:
+            raise ValueError(f"dropout p must be in [0, 1), got {p}")
+        self.p = p
+        self.seed = seed
+
+    def round_mask(self, round_idx: int, n_clients: int) -> np.ndarray:
+        """[n] float mask — 0.0 = dropped this round. Guarantees at least
+        one survivor (an all-dropped round would be a silent no-op; keep the
+        lowest-index client instead, deterministically)."""
+        rng = np.random.RandomState((self.seed * 1_000_003 + round_idx) % (2**31))
+        mask = (rng.rand(n_clients) >= self.p).astype(np.float32)
+        if mask.sum() == 0:
+            mask[0] = 1.0
+        return mask
+
+
+class UpdateCorruptor:
+    """Inject faults into a trained client update (NetState pytree) —
+    the attack/fault models the robust aggregator defends against."""
+
+    MODES = ("sign_flip", "scale", "nan", "random")
+
+    def __init__(self, mode: str = "sign_flip", scale: float = 10.0, seed: int = 0):
+        if mode not in self.MODES:
+            raise ValueError(f"unknown corruption mode {mode!r}; known {self.MODES}")
+        self.mode = mode
+        self.scale = scale
+        self.rng = jax.random.PRNGKey(seed)
+
+    def corrupt(self, net, global_net=None):
+        """Returns the corrupted pytree (params leaf-wise)."""
+        params = net.params if hasattr(net, "params") else net
+
+        if self.mode == "sign_flip":
+            # Model replacement: w_g - scale*(w - w_g) if global given, else -w.
+            if global_net is not None:
+                gp = global_net.params if hasattr(global_net, "params") else global_net
+                new = jax.tree.map(lambda w, g: g - self.scale * (w - g), params, gp)
+            else:
+                new = jax.tree.map(lambda w: -w, params)
+        elif self.mode == "scale":
+            new = jax.tree.map(lambda w: w * self.scale, params)
+        elif self.mode == "nan":
+            new = jax.tree.map(
+                lambda w: w.at[(0,) * w.ndim].set(jnp.nan) if w.ndim else jnp.nan * w,
+                params,
+            )
+        else:  # random
+            self.rng, sub = jax.random.split(self.rng)
+            leaves, treedef = jax.tree.flatten(params)
+            keys = jax.random.split(sub, len(leaves))
+            new = jax.tree.unflatten(
+                treedef,
+                [self.scale * jax.random.normal(k, l.shape, l.dtype)
+                 for k, l in zip(keys, leaves)],
+            )
+        if hasattr(net, "params"):
+            return type(net)(new, net.model_state)
+        return new
+
+
+class HeartbeatMonitor:
+    """Failure detector for the host-side federation: ranks ``beat()``;
+    ``failed()`` lists ranks silent for > timeout_s. The reference has no
+    equivalent — a dead client hangs its server forever
+    (FedAVGAggregator.check_whether_all_receive waits unconditionally)."""
+
+    def __init__(self, ranks: Sequence[int], timeout_s: float = 30.0,
+                 clock=time.monotonic):
+        self.timeout_s = timeout_s
+        self._clock = clock
+        now = clock()
+        self._last: Dict[int, float] = {r: now for r in ranks}
+        self._declared: set = set()
+
+    def beat(self, rank: int):
+        self._last[rank] = self._clock()
+        self._declared.discard(rank)
+
+    def failed(self) -> List[int]:
+        now = self._clock()
+        out = [
+            r for r, t in self._last.items()
+            if now - t > self.timeout_s
+        ]
+        self._declared.update(out)
+        return sorted(out)
+
+    def alive(self) -> List[int]:
+        return sorted(set(self._last) - set(self.failed()))
+
+    def wait_all_or_failed(self, expected: Sequence[int], have,
+                           poll_s: float = 0.05) -> List[int]:
+        """Block until ``have()`` covers ``expected`` minus failed ranks;
+        returns the failed set. Replaces the reference's unconditional
+        check_whether_all_receive spin."""
+        expected = set(expected)
+        while True:
+            failed = set(self.failed())
+            if set(have()) >= (expected - failed):
+                return sorted(failed)
+            time.sleep(poll_s)
+
+
+def fault_injected_round(api, round_idx: int,
+                         dropout: Optional[DropoutInjector] = None):
+    """Harness: run one round of an API that supports host-side dropout
+    (TurboAggregate's ``set_dropout``, reference TA_client.py:25) with
+    injected per-round client churn. Update corruption is a BUILD-time
+    concern — install ``UpdateCorruptor.corrupt`` through the algorithm's
+    ``client_transform`` hook (see FedAvgRobustAPI for the pattern) and pair
+    it with ``nan_guard`` / robust clipping to test the defenses."""
+    if dropout is not None and hasattr(api, "set_dropout"):
+        n = api.cfg.client_num_per_round
+        mask = dropout.round_mask(round_idx, n)
+        api.set_dropout(np.where(mask == 0.0)[0])
+    return api.train_one_round(round_idx)

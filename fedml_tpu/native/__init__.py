@@ -32,6 +32,24 @@ def _compile(src: str, out: str):
         )
 
 
+def build_stress(sanitize: str = "thread") -> str:
+    """Build the msgnet stress binary (linked with the transport sources)
+    with a sanitizer — the race-detection harness. Returns the binary path."""
+    os.makedirs(_BUILD, exist_ok=True)
+    out = os.path.join(_BUILD, f"msgnet_stress_{sanitize}")
+    srcs = [os.path.join(_HERE, "msgnet.cpp"), os.path.join(_HERE, "msgnet_stress.cpp")]
+    newest = max(os.path.getmtime(s) for s in srcs)
+    if os.path.isfile(out) and os.path.getmtime(out) >= newest:
+        return out
+    cmd = ["g++", "-O1", "-g", "-pthread", "-std=c++17",
+           f"-fsanitize={sanitize}", *srcs, "-o", out]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"stress build failed: {' '.join(cmd)}\n{proc.stderr[-4000:]}")
+    return out
+
+
 def load_msgnet() -> ctypes.CDLL:
     """Build (if stale) + load the message-transport library."""
     global _LIB

@@ -1,0 +1,116 @@
+// msgnet_stress: concurrency stress harness for the msgnet transport.
+//
+// Race detection the reference never had (SURVEY.md §5 — its concurrency
+// is hand-managed threads with no sanitizers). Built with
+// -fsanitize=thread by fedml_tpu.native.build_stress() and run in CI: N
+// sender threads hammer M servers while receivers drain and the main
+// thread tears everything down mid-flight — exercising the accept/conn/
+// recv/stop lifecycle under TSAN. Exit 0 = no data races detected.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int mn_server_create(int port, int backlog);
+int mn_server_port(int handle);
+uint8_t* mn_server_recv(int handle, int timeout_ms, uint64_t* out_len);
+void mn_server_stop(int handle);
+int mn_sender_create();
+int mn_send(int handle, const char* host, int port, const uint8_t* data,
+            uint64_t len);
+void mn_sender_destroy(int handle);
+void mn_free(uint8_t* buf);
+}
+
+int main() {
+  constexpr int kServers = 3;
+  constexpr int kSendersPerServer = 4;
+  constexpr int kMsgs = 200;
+
+  int handles[kServers], ports[kServers];
+  for (int s = 0; s < kServers; ++s) {
+    handles[s] = mn_server_create(0, 64);
+    if (handles[s] < 0) return 2;
+    ports[s] = mn_server_port(handles[s]);
+  }
+
+  std::atomic<long> received{0};
+  std::atomic<bool> give_up{false};
+  std::vector<std::thread> threads;
+
+  // Deadline watchdog: on message loss the receivers must still exit so the
+  // final count check can report exit 3 instead of hanging the harness.
+  std::thread watchdog([&] {
+    for (int i = 0; i < 600 && !give_up; ++i) {  // 60 s budget
+      if (received.load() >= long(kServers) * kSendersPerServer * kMsgs) return;
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+    give_up = true;
+  });
+
+  // Receivers: several concurrent drainers per server (stresses the
+  // recv/stop refcount path).
+  for (int s = 0; s < kServers; ++s) {
+    for (int r = 0; r < 2; ++r) {
+      threads.emplace_back([&, s] {
+        uint64_t len;
+        while (!give_up) {
+          uint8_t* buf = mn_server_recv(handles[s], 50, &len);
+          if (buf) {
+            received.fetch_add(1);
+            mn_free(buf);
+          } else if (received.load() >= kServers * kSendersPerServer * kMsgs) {
+            return;
+          }
+        }
+      });
+    }
+  }
+
+  // Senders.
+  for (int s = 0; s < kServers; ++s) {
+    for (int w = 0; w < kSendersPerServer; ++w) {
+      threads.emplace_back([&, s, w] {
+        int snd = mn_sender_create();
+        std::string payload(128 + 64 * w, 'x');
+        for (int i = 0; i < kMsgs; ++i) {
+          if (mn_send(snd, "127.0.0.1", ports[s],
+                      reinterpret_cast<const uint8_t*>(payload.data()),
+                      payload.size()) != 0) {
+            std::fprintf(stderr, "send failed\n");
+            break;
+          }
+        }
+        mn_sender_destroy(snd);
+      });
+    }
+  }
+
+  for (auto& t : threads) t.join();
+  give_up = true;
+  watchdog.join();
+
+  // Teardown while a late receiver is still mid-recv: spawn one more
+  // blocked receiver, then stop the servers under it.
+  std::thread late([&] {
+    uint64_t len;
+    uint8_t* buf = mn_server_recv(handles[0], 5000, &len);
+    if (buf) mn_free(buf);
+  });
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  for (int s = 0; s < kServers; ++s) mn_server_stop(handles[s]);
+  late.join();
+
+  long got = received.load();
+  if (got != long(kServers) * kSendersPerServer * kMsgs) {
+    std::fprintf(stderr, "lost messages: %ld\n", got);
+    return 3;
+  }
+  std::printf("stress ok: %ld messages\n", got);
+  return 0;
+}

@@ -8,6 +8,7 @@ The reference's equivalents: wandb calls hard-wired into aggregators
 from fedml_tpu.obs.logger import JsonlSink, MetricsLogger, StdoutSink, WandbSink
 from fedml_tpu.obs.timing import RoundTimer, trace
 from fedml_tpu.obs.checkpoint import CheckpointManager, RunState, restore_run, save_run
+from fedml_tpu.obs.flops import count_params, flops_str, model_cost
 
 __all__ = [
     "JsonlSink",
@@ -20,4 +21,7 @@ __all__ = [
     "RunState",
     "restore_run",
     "save_run",
+    "count_params",
+    "flops_str",
+    "model_cost",
 ]

@@ -22,7 +22,20 @@ from jax import shard_map
 from fedml_tpu.core.tree import tree_weighted_mean
 
 
-def make_vmap_round(local_train, client_transform=None):
+def client_finite_mask(client_params) -> jnp.ndarray:
+    """[C] float mask: 1.0 where EVERY leaf of that client's model is
+    finite. Failure containment the reference lacks entirely (its only
+    response to trouble is MPI Abort, fedml_api/utils/context.py:9-18): a
+    client whose local training diverged to NaN/Inf must not poison the
+    global average."""
+    flags = [
+        jnp.all(jnp.isfinite(leaf.reshape(leaf.shape[0], -1)), axis=1)
+        for leaf in jax.tree.leaves(client_params)
+    ]
+    return jnp.all(jnp.stack(flags, axis=0), axis=0).astype(jnp.float32)
+
+
+def make_vmap_round(local_train, client_transform=None, nan_guard: bool = False):
     """``round_fn(params, x, y, mask, weights, loss_weights, rng) ->
     (avg_params, mean_loss)`` with client-stacked inputs ``[C, S, B, ...]``.
 
@@ -33,6 +46,10 @@ def make_vmap_round(local_train, client_transform=None):
 
     ``client_transform(global_net, client_net) -> client_net`` is applied to
     every trained client model before averaging (robust clipping etc.).
+
+    ``nan_guard`` zero-weights any client whose trained model contains a
+    non-finite value (and its loss), so one diverged client cannot poison
+    the round.
     """
 
     def round_fn(params, x, y, mask, weights, loss_weights, rng):
@@ -44,8 +61,26 @@ def make_vmap_round(local_train, client_transform=None):
             client_params = jax.vmap(client_transform, in_axes=(None, 0))(
                 params, client_params
             )
+        if nan_guard:
+            finite = client_finite_mask(client_params)
+            weights = weights * finite
+            loss_weights = loss_weights * finite
+            # Zero via where — NaN * 0 is still NaN.
+            client_params = jax.tree.map(
+                lambda p: jnp.where(
+                    finite.reshape((-1,) + (1,) * (p.ndim - 1)).astype(bool),
+                    p, jnp.zeros((), p.dtype)),
+                client_params,
+            )
         avg = tree_weighted_mean(client_params, weights)
+        if nan_guard:
+            # Every sampled client diverged → keep the previous global model
+            # (a zero-total weighted mean would silently zero the params).
+            any_ok = jnp.sum(weights) > 0
+            avg = jax.tree.map(
+                lambda a, p: jnp.where(any_ok, a, p), avg, params)
         lw = loss_weights / jnp.maximum(jnp.sum(loss_weights), 1e-12)
+        losses = jnp.where(jnp.isfinite(losses), losses, 0.0) if nan_guard else losses
         return avg, jnp.sum(losses * lw)
 
     return round_fn
@@ -58,11 +93,13 @@ def client_rngs(rng, n_local, offset):
     return jax.vmap(lambda i: jax.random.fold_in(rng, i))(offset + jnp.arange(n_local))
 
 
-def make_sharded_round(local_train, mesh, axis: str = "clients", client_transform=None):
+def make_sharded_round(local_train, mesh, axis: str = "clients",
+                       client_transform=None, nan_guard: bool = False):
     """Sharded round: client axis split over ``mesh[axis]``; output replicated.
 
     Weighted average = psum of per-shard weighted partial sums / psum of
     weights — exact regardless of how clients land on shards.
+    ``nan_guard`` as in :func:`make_vmap_round` (applied per shard).
     """
 
     @partial(
@@ -83,6 +120,17 @@ def make_sharded_round(local_train, mesh, axis: str = "clients", client_transfor
             client_params = jax.vmap(client_transform, in_axes=(None, 0))(
                 params, client_params
             )
+        if nan_guard:
+            finite = client_finite_mask(client_params)
+            weights = weights * finite
+            loss_weights = loss_weights * finite
+            client_params = jax.tree.map(
+                lambda p: jnp.where(
+                    finite.reshape((-1,) + (1,) * (p.ndim - 1)).astype(bool),
+                    p, jnp.zeros((), p.dtype)),
+                client_params,
+            )
+            losses = jnp.where(jnp.isfinite(losses), losses, 0.0)
         w = weights.astype(jnp.float32)
         total = jax.lax.psum(jnp.sum(w), axis)
         wn = w / jnp.maximum(total, 1e-12)
@@ -92,6 +140,10 @@ def make_sharded_round(local_train, mesh, axis: str = "clients", client_transfor
             ).astype(p.dtype),
             client_params,
         )
+        if nan_guard:
+            # All-diverged round: keep the previous global model.
+            avg = jax.tree.map(
+                lambda a, p: jnp.where(total > 0, a, p), avg, params)
         lw = loss_weights.astype(jnp.float32)
         lw = lw / jnp.maximum(jax.lax.psum(jnp.sum(lw), axis), 1e-12)
         loss = jax.lax.psum(jnp.sum(losses * lw), axis)

@@ -1,0 +1,142 @@
+"""Fault injection + failure containment/detection subsystem."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algos import FedAvgAPI, FedConfig
+from fedml_tpu.core.faults import DropoutInjector, HeartbeatMonitor, UpdateCorruptor
+from fedml_tpu.data.batching import build_federated_arrays
+from fedml_tpu.data.partition import partition_homo
+from fedml_tpu.data.synthetic import make_classification
+from fedml_tpu.models import create_model
+
+
+def _task(n_clients=4, n=160, d=8, classes=4, batch=8):
+    x, y = make_classification(n, n_features=d, n_classes=classes)
+    fed = build_federated_arrays(x, y, partition_homo(n, n_clients), batch)
+    return fed
+
+
+def test_dropout_injector_reproducible_and_never_empty():
+    inj = DropoutInjector(0.9, seed=3)
+    m1 = inj.round_mask(5, 8)
+    m2 = inj.round_mask(5, 8)
+    np.testing.assert_array_equal(m1, m2)
+    for r in range(30):
+        assert inj.round_mask(r, 8).sum() >= 1.0
+    with pytest.raises(ValueError):
+        DropoutInjector(1.0)
+
+
+def test_update_corruptor_modes():
+    import jax
+
+    from fedml_tpu.trainer.local import model_fns
+
+    fns = model_fns(create_model("lr", input_dim=4, num_classes=2))
+    net = fns.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.float32))
+    for mode in UpdateCorruptor.MODES:
+        bad = UpdateCorruptor(mode).corrupt(net, global_net=net)
+        leaves = jax.tree.leaves(bad.params)
+        assert all(l.shape == o.shape for l, o in zip(leaves, jax.tree.leaves(net.params)))
+    nan_bad = UpdateCorruptor("nan").corrupt(net)
+    assert not all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(nan_bad.params))
+
+
+def test_nan_guard_contains_diverged_client():
+    """A client driven to NaN (absurd lr on its shard via corrupted labels)
+    must not poison the global average when nan_guard=True."""
+    import jax
+    import jax.numpy as jnp
+
+    fed = _task()
+    # Corrupt client 0's inputs to NaN — its local training will go NaN.
+    x = np.array(fed.x, copy=True)
+    x[0] = np.nan
+    fed = type(fed)(x=jnp.asarray(x), y=fed.y, mask=fed.mask, counts=fed.counts)
+
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=2, epochs=1, batch_size=8, lr=0.1)
+    api = FedAvgAPI(create_model("lr", input_dim=8, num_classes=4), fed, None,
+                    cfg, nan_guard=True)
+    m = api.train_one_round(0)
+    assert np.isfinite(m["train_loss"])
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(api.net.params))
+
+    # Without the guard the same round poisons the model.
+    api2 = FedAvgAPI(create_model("lr", input_dim=8, num_classes=4), fed, None,
+                     cfg, nan_guard=False)
+    api2.train_one_round(0)
+    poisoned = not all(np.isfinite(np.asarray(l)).all()
+                       for l in jax.tree.leaves(api2.net.params))
+    assert poisoned
+
+
+def test_nan_guard_sharded_matches_vmap():
+    import jax
+
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    fed = _task(n_clients=8, n=320)
+    cfg = FedConfig(client_num_in_total=8, client_num_per_round=8,
+                    comm_round=1, epochs=1, batch_size=8, lr=0.1)
+    a = FedAvgAPI(create_model("lr", input_dim=8, num_classes=4), fed, None,
+                  cfg, nan_guard=True)
+    b = FedAvgAPI(create_model("lr", input_dim=8, num_classes=4), fed, None,
+                  cfg, mesh=client_mesh(4), nan_guard=True)
+    a.train_one_round(0)
+    b.train_one_round(0)
+    for la, lb in zip(jax.tree.leaves(a.net.params), jax.tree.leaves(b.net.params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=2e-6, atol=2e-6)
+
+
+def test_heartbeat_monitor():
+    t = [0.0]
+    mon = HeartbeatMonitor([1, 2, 3], timeout_s=10.0, clock=lambda: t[0])
+    assert mon.failed() == []
+    t[0] = 5.0
+    mon.beat(1)
+    t[0] = 12.0
+    assert mon.failed() == [2, 3]
+    assert mon.alive() == [1]
+    mon.beat(2)
+    assert mon.failed() == [3]
+
+    got = {1: True, 2: True}
+    failed = mon.wait_all_or_failed([1, 2, 3], have=lambda: list(got), poll_s=0.01)
+    assert failed == [3]
+
+
+def test_turboaggregate_dropout_harness():
+    from fedml_tpu.algos import TurboAggregateAPI
+    from fedml_tpu.core.faults import fault_injected_round
+
+    fed = _task()
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=2, epochs=1, batch_size=8, lr=0.1)
+    api = TurboAggregateAPI(create_model("lr", input_dim=8, num_classes=4),
+                            fed, None, cfg)
+    m = fault_injected_round(api, 0, dropout=DropoutInjector(0.5, seed=1))
+    assert np.isfinite(m["train_loss"])
+
+
+def test_nan_guard_all_diverged_keeps_previous_model():
+    """If EVERY sampled client diverges, the round must keep the previous
+    global model, not replace it with zeros."""
+    import jax
+    import jax.numpy as jnp
+
+    fed = _task()
+    x = np.array(fed.x, copy=True)
+    x[:] = np.nan  # every client poisoned
+    fed = type(fed)(x=jnp.asarray(x), y=fed.y, mask=fed.mask, counts=fed.counts)
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=1, epochs=1, batch_size=8, lr=0.1)
+    api = FedAvgAPI(create_model("lr", input_dim=8, num_classes=4), fed, None,
+                    cfg, nan_guard=True)
+    before = [np.array(l, copy=True) for l in jax.tree.leaves(api.net.params)]
+    api.train_one_round(0)
+    after = jax.tree.leaves(api.net.params)
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b, np.asarray(a))

@@ -107,3 +107,17 @@ def test_run_with_obs_flags(tmp_path):
     _, history2 = run(args2)
     assert history2[0]["round"] == 4  # rounds 0-3 checkpointed
     assert len(history2) == 2
+
+
+def test_model_cost_analysis():
+    """XLA cost analysis: LR on 16 features = 16*4*2 flops/sample matmul
+    scale; params exact."""
+    from fedml_tpu.models import create_model
+    from fedml_tpu.obs import flops_str, model_cost
+
+    cost = model_cost(create_model("lr", input_dim=16, num_classes=4),
+                      np.zeros((8, 16), np.float32))
+    assert cost["params"] == 16 * 4 + 4
+    assert cost["flops"] >= 8 * 16 * 4 * 2  # at least the matmul
+    s = flops_str(cost)
+    assert "M params" in s

@@ -99,3 +99,23 @@ def test_distributed_fedavg_over_tcp_trains():
     )
     accs = [h["accuracy"] for h in agg.test_history]
     assert accs[-1] > 0.5
+
+
+@pytest.mark.slow
+def test_msgnet_tsan_stress():
+    """Race detection: the transport's full lifecycle under ThreadSanitizer
+    (multi-sender/multi-receiver + teardown mid-recv). TSAN failures abort
+    with a nonzero exit; message-loss exits 3."""
+    import subprocess
+
+    from fedml_tpu.native import build_stress
+
+    import os
+
+    binary = build_stress("thread")
+    proc = subprocess.run(
+        [binary], capture_output=True, text=True, timeout=240,
+        env={**os.environ, "TSAN_OPTIONS": "halt_on_error=1 exitcode=66"},
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-4000:])
+    assert "stress ok" in proc.stdout
