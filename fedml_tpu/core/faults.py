@@ -105,34 +105,41 @@ class HeartbeatMonitor:
         self._clock = clock
         now = clock()
         self._last: Dict[int, float] = {r: now for r in ranks}
-        self._declared: set = set()
 
     def beat(self, rank: int):
+        """Unknown ranks are registered on first beat."""
         self._last[rank] = self._clock()
-        self._declared.discard(rank)
 
     def failed(self) -> List[int]:
         now = self._clock()
-        out = [
-            r for r, t in self._last.items()
-            if now - t > self.timeout_s
-        ]
-        self._declared.update(out)
-        return sorted(out)
+        return sorted(
+            r for r, t in self._last.items() if now - t > self.timeout_s
+        )
 
     def alive(self) -> List[int]:
         return sorted(set(self._last) - set(self.failed()))
 
     def wait_all_or_failed(self, expected: Sequence[int], have,
-                           poll_s: float = 0.05) -> List[int]:
+                           poll_s: float = 0.05,
+                           deadline_s: Optional[float] = None) -> List[int]:
         """Block until ``have()`` covers ``expected`` minus failed ranks;
         returns the failed set. Replaces the reference's unconditional
-        check_whether_all_receive spin."""
+        check_whether_all_receive spin. Ranks in ``expected`` the monitor
+        has never seen count as failed once the timeout elapses (they are
+        registered at entry). ``deadline_s`` (default 2x timeout) bounds the
+        total wait: anything still missing then is declared failed."""
         expected = set(expected)
+        start = self._clock()
+        for r in expected - set(self._last):
+            self._last[r] = start  # start their timeout clocks now
+        deadline = deadline_s if deadline_s is not None else 2 * self.timeout_s
         while True:
             failed = set(self.failed())
-            if set(have()) >= (expected - failed):
+            present = set(have())
+            if present >= (expected - failed):
                 return sorted(failed)
+            if self._clock() - start > deadline:
+                return sorted(expected - present)
             time.sleep(poll_s)
 
 

@@ -24,9 +24,12 @@ def model_cost(model, sample_x, train: bool = False) -> Dict[str, float]:
 
     fns = model_fns(model)
     net = fns.init(jax.random.PRNGKey(0), sample_x)
+    # Dropout-bearing models need an rng in train mode; a fixed key is fine
+    # for a static cost analysis.
+    rng = jax.random.PRNGKey(1) if train else None
 
     def fwd(net, x):
-        logits, _ = fns.apply(net, x, train=train)
+        logits, _ = fns.apply(net, x, train=train, rng=rng)
         return logits
 
     compiled = jax.jit(fwd).lower(net, sample_x).compile()

@@ -1,4 +1,26 @@
-from fedml_tpu.parallel.mesh import client_mesh
+from fedml_tpu.parallel.mesh import client_mesh, mesh_2d
 from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
+from fedml_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    reference_attention,
+)
+from fedml_tpu.parallel.tensor_parallel import make_tp_forward, shard_tp_params
+from fedml_tpu.parallel.expert_parallel import (
+    init_moe,
+    make_moe_ep,
+    moe_reference,
+)
 
-__all__ = ["client_mesh", "make_sharded_round", "make_vmap_round"]
+__all__ = [
+    "client_mesh",
+    "mesh_2d",
+    "make_sharded_round",
+    "make_vmap_round",
+    "make_ring_attention",
+    "reference_attention",
+    "make_tp_forward",
+    "shard_tp_params",
+    "init_moe",
+    "make_moe_ep",
+    "moe_reference",
+]

@@ -1,0 +1,56 @@
+"""Tensor parallelism: TP forward == replicated forward exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.models import create_model
+from fedml_tpu.parallel.mesh import client_mesh
+from fedml_tpu.parallel.tensor_parallel import make_tp_forward, shard_tp_params
+from fedml_tpu.trainer.local import model_fns
+
+
+@pytest.mark.parametrize("n_dev", [2, 4])
+def test_tp_forward_matches_dense(n_dev):
+    vocab, t = 29, 16
+    model = create_model("transformer_lm", vocab_size=vocab, d_model=32,
+                         n_heads=4, n_layers=2, max_len=t)
+    fns = model_fns(model)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, vocab, (2, t)))
+    net = fns.init(jax.random.PRNGKey(0), toks)
+    want, _ = fns.apply(net, toks)
+
+    mesh = client_mesh(n_dev, axis_name="tp")
+    sharded = shard_tp_params(net.params, n_dev)
+    fwd = jax.jit(make_tp_forward(model, mesh, "tp"))
+    got = fwd(sharded, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_tp_rejects_bad_head_split():
+    model = create_model("transformer_lm", vocab_size=10, d_model=32,
+                         n_heads=3, n_layers=1, max_len=8)
+    with pytest.raises(ValueError):
+        make_tp_forward(model, client_mesh(2, axis_name="tp"), "tp")
+
+
+def test_tp_grads_flow():
+    """TP forward is differentiable end-to-end (training usable)."""
+    vocab, t = 17, 8
+    model = create_model("transformer_lm", vocab_size=vocab, d_model=16,
+                         n_heads=2, n_layers=1, max_len=t)
+    fns = model_fns(model)
+    toks = jnp.asarray(np.random.RandomState(1).randint(0, vocab, (2, t)))
+    net = fns.init(jax.random.PRNGKey(0), toks)
+    mesh = client_mesh(2, axis_name="tp")
+    sharded = shard_tp_params(net.params, 2)
+    fwd = make_tp_forward(model, mesh, "tp")
+
+    def loss(p):
+        return jnp.mean(fwd(p, toks) ** 2)
+
+    g = jax.jit(jax.grad(loss))(sharded)
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+    assert any(float(jnp.abs(l).max()) > 0 for l in jax.tree.leaves(g))
