@@ -137,9 +137,12 @@ class HeartbeatMonitor:
             failed = set(self.failed())
             present = set(have())
             if present >= (expected - failed):
-                return sorted(failed)
+                return sorted(failed & expected)
             if self._clock() - start > deadline:
-                return sorted(expected - present)
+                # Deadline: report heartbeat-failed ranks PLUS whatever is
+                # still missing (even if its heartbeat looks alive, its
+                # result never arrived — the caller must not keep waiting).
+                return sorted((failed | (expected - present)) & expected)
             time.sleep(poll_s)
 
 

@@ -5,6 +5,12 @@ from fedml_tpu.parallel.ring_attention import (
     reference_attention,
 )
 from fedml_tpu.parallel.tensor_parallel import make_tp_forward, shard_tp_params
+from fedml_tpu.parallel.pipeline import (
+    make_pipeline,
+    sequential_reference,
+    stack_stage_params,
+)
+from fedml_tpu.parallel.multihost import hybrid_mesh, initialize, process_local_client_slice
 from fedml_tpu.parallel.expert_parallel import (
     init_moe,
     make_moe_ep,
@@ -23,4 +29,10 @@ __all__ = [
     "init_moe",
     "make_moe_ep",
     "moe_reference",
+    "make_pipeline",
+    "sequential_reference",
+    "stack_stage_params",
+    "hybrid_mesh",
+    "initialize",
+    "process_local_client_slice",
 ]

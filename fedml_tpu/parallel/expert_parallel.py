@@ -70,13 +70,23 @@ def make_moe_ep(mesh, axis: str = "ep", capacity: int | None = None):
     defaults to tokens_per_device (lossless)."""
     n_dev = int(mesh.shape[axis])
 
+    def validated(params, x):
+        e = params.w_in.shape[0]
+        if e != n_dev:
+            raise ValueError(
+                f"MoE has {e} experts but the '{axis}' mesh axis has "
+                f"{n_dev} devices; this layout runs one expert per device "
+                f"(a mismatch would silently drop tokens routed to experts "
+                f">= {n_dev})")
+        return _moe(params, x)
+
     @partial(shard_map, mesh=mesh,
              in_specs=(
                  MoEParams(P(), P(axis), P(axis), P(axis), P(axis)),
                  P(axis),
              ),
              out_specs=P(axis), check_vma=False)
-    def moe(params, x):
+    def _moe(params, x):
         n_local, d = x.shape
         cap = capacity or n_local
         # Local routing over the FULL router (replicated) --------------
@@ -108,4 +118,4 @@ def make_moe_ep(mesh, axis: str = "ep", capacity: int | None = None):
         out = jnp.einsum("nec,ecd->nd", dispatch, back)
         return out * (gate * keep.astype(x.dtype))[:, None]
 
-    return moe
+    return validated

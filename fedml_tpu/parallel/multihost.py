@@ -1,0 +1,85 @@
+"""Multi-host (pod-scale) scaffolding.
+
+The reference scales across machines with mpirun + hostfiles
+(run_fedavg_distributed_pytorch.sh:19-21); the TPU-native equivalent is
+``jax.distributed`` + a hybrid DCN×ICI device mesh: the outer mesh axis
+maps to hosts (collectives cross DCN), inner axes ride ICI within each
+host's chips. ``hybrid_mesh`` uses
+``mesh_utils.create_hybrid_device_mesh`` so collective-heavy axes (clients,
+tp) stay on ICI and only the host-level aggregation crosses DCN.
+
+Single-host processes (this environment) run unchanged: ``initialize`` is a
+no-op when no coordinator is configured, and ``hybrid_mesh`` falls back to
+a flat mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Join the multi-host runtime. Arguments fall back to the standard env
+    vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, the
+    TPU-pod equivalents of the reference's mpi_host_file). Returns True if
+    distributed mode was initialized, False for single-process runs."""
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not addr:
+        return False
+    kw = {"coordinator_address": addr}
+    # Only pass what is explicitly configured — unset values stay None so
+    # jax.distributed can auto-detect the pod topology (forcing 1/0 here
+    # would make every host start its own single-process "cluster").
+    n = num_processes if num_processes is not None else os.environ.get("JAX_NUM_PROCESSES")
+    pid = process_id if process_id is not None else os.environ.get("JAX_PROCESS_ID")
+    if n is not None:
+        kw["num_processes"] = int(n)
+    if pid is not None:
+        kw["process_id"] = int(pid)
+    jax.distributed.initialize(**kw)
+    return True
+
+
+def hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int] = (),
+                axis_names: Tuple[str, ...] = ("clients",)) -> Mesh:
+    """Hybrid DCN×ICI mesh following the jax ``create_hybrid_device_mesh``
+    contract: ``ici_shape`` and ``dcn_shape`` have the SAME rank (one entry
+    per mesh axis) and axis ``i``'s global size is ``ici[i] * dcn[i]``. Put
+    the DCN factor on the axis whose collective tolerates DCN latency (for
+    FL, the client axis: ``hybrid_mesh((chips_per_host, k), (n_hosts, 1),
+    ("clients", "model"))``) and keep ``1`` everywhere else so those
+    collectives stay on ICI. Empty/all-ones ``dcn_shape`` → plain
+    single-host mesh over the local devices."""
+    if dcn_shape and int(np.prod(dcn_shape)) > 1:
+        if len(dcn_shape) != len(ici_shape):
+            raise ValueError(
+                f"dcn_shape rank {len(dcn_shape)} must equal ici_shape rank "
+                f"{len(ici_shape)} (per-axis factors; use 1 for ICI-only axes)")
+        if len(axis_names) != len(ici_shape):
+            raise ValueError("axis_names must have one name per mesh axis")
+        devices = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), tuple(dcn_shape))
+        return Mesh(devices, axis_names)
+    n = int(np.prod(ici_shape))
+    devices = mesh_utils.create_device_mesh(
+        tuple(ici_shape), devices=jax.devices()[:n])
+    return Mesh(devices, axis_names)
+
+
+def process_local_client_slice(n_clients: int) -> slice:
+    """Which contiguous client range this host owns when client data is
+    loaded per-host (each host loads only its shard — unlike the reference,
+    where every rank loads the full dataset, main_fedavg.py:133)."""
+    pid, n = jax.process_index(), jax.process_count()
+    per = n_clients // n
+    extra = n_clients % n
+    start = pid * per + min(pid, extra)
+    return slice(start, start + per + (1 if pid < extra else 0))

@@ -70,13 +70,12 @@ def shard_tp_params(params: Dict[str, Any], n_dev: int) -> Dict[str, Any]:
 def make_tp_forward(model, mesh, axis: str = "tp"):
     """``fwd(sharded_params, tokens) -> logits`` running the TP math inside
     shard_map. ``model`` is a TransformerLM (used for static shape config:
-    layers, heads, dims). Heads must divide the tp size."""
+    layers, heads, dims). The tp size must divide the head count."""
     n_dev = int(mesh.shape[axis])
     if model.n_heads % n_dev:
         raise ValueError(
-            f"n_heads={model.n_heads} must divide tp={n_dev}"
-            if model.n_heads < n_dev else
-            f"tp={n_dev} must divide n_heads={model.n_heads}")
+            f"tp={n_dev} must divide n_heads={model.n_heads} "
+            "(attention heads are split across the tp axis)")
     d_model = model.d_model
     n_layers = model.n_layers
     heads_local = model.n_heads // n_dev

@@ -55,3 +55,11 @@ def test_moe_ep_grads_flow():
     g = jax.jit(jax.grad(lambda p: jnp.sum(moe(p, x) ** 2)))(params)
     assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
     assert float(jnp.abs(g.w_in).max()) > 0
+
+
+def test_moe_ep_rejects_expert_mesh_mismatch():
+    params = init_moe(jax.random.PRNGKey(3), 8, 16, n_experts=8)
+    mesh = client_mesh(4, axis_name="ep")
+    moe = make_moe_ep(mesh, "ep")
+    with pytest.raises(ValueError, match="8 experts"):
+        moe(params, jnp.zeros((8, 8), jnp.float32))
