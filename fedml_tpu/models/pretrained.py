@@ -1,0 +1,76 @@
+"""Pretrained-weights save/load.
+
+Parity with the reference's checkpoint loading (model/cv/resnet.py:209-220
+loads ``.pth`` state_dicts for resnet56 ``pretrained=True``; ckpt dirs under
+model/cv/pretrained/). TPU-native formats:
+
+- ``save_params`` / ``load_params``: flat ``.npz`` of the NetState (params +
+  model_state), path-keyed — portable, no pickle;
+- orbax checkpoints from fedml_tpu.obs.checkpoint restore full run state;
+  this module is for model-only weights (zoo distribution).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import numpy as np
+
+from fedml_tpu.trainer.local import NetState
+
+_SEP = "::"
+
+
+def _flatten(tree, prefix: str) -> Dict[str, np.ndarray]:
+    out = {}
+
+    def visit(path, leaf):
+        keys = [str(getattr(k, "key", k)) for k in path]
+        out[prefix + _SEP + _SEP.join(keys)] = np.asarray(leaf)
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    return out
+
+
+def save_params(net: NetState, path: str) -> None:
+    flat = {**_flatten(net.params, "params"),
+            **_flatten(net.model_state, "state")}
+    np.savez(path, **flat)
+
+
+def load_params(net: NetState, path: str) -> NetState:
+    """Load weights saved by :func:`save_params` into ``net``'s structure.
+    Shapes/keys must match exactly IN BOTH DIRECTIONS — a missing key,
+    shape mismatch, or unused checkpoint entry (wrong architecture whose
+    common layers happen to match) raises with the offending key."""
+    with np.load(path if path.endswith(".npz") else path + ".npz") as data:
+        used = set()
+
+        def rebuild(tree, prefix):
+            def visit(path_keys, leaf):
+                keys = [str(getattr(k, "key", k)) for k in path_keys]
+                key = prefix + _SEP + _SEP.join(keys)
+                if key not in data:
+                    raise KeyError(
+                        f"checkpoint {path!r} is missing {key!r} "
+                        f"(available: {sorted(data.files)[:5]}...)")
+                arr = data[key]
+                if arr.shape != leaf.shape:
+                    raise ValueError(
+                        f"{key!r}: checkpoint shape {arr.shape} != model "
+                        f"shape {leaf.shape}")
+                used.add(key)
+                return arr.astype(leaf.dtype)
+
+            return jax.tree_util.tree_map_with_path(visit, tree)
+
+        out = NetState(rebuild(net.params, "params"),
+                       rebuild(net.model_state, "state"))
+        leftover = set(data.files) - used
+        if leftover:
+            raise ValueError(
+                f"checkpoint {path!r} has {len(leftover)} entries the model "
+                f"does not use (first: {sorted(leftover)[:3]}) — wrong "
+                "architecture?")
+        return out

@@ -37,9 +37,18 @@ def make_pipeline(stage_fn, mesh, axis: str = "pp"):
     n_stages = int(mesh.shape[axis])
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
+    def validated(stage_params, x):
+        s = jax.tree.leaves(stage_params)[0].shape[0]
+        if s != n_stages:
+            raise ValueError(
+                f"stage_params has {s} stages but the '{axis}' mesh axis has "
+                f"{n_stages} devices; this schedule runs one stage per "
+                "device (a mismatch would silently drop stages)")
+        return _pipe(stage_params, x)
+
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(),
              check_vma=False)
-    def pipe(stage_params, x):
+    def _pipe(stage_params, x):
         params_local = jax.tree.map(lambda a: a[0], stage_params)
         s = jax.lax.axis_index(axis)
         m, b, d = x.shape
@@ -64,7 +73,7 @@ def make_pipeline(stage_fn, mesh, axis: str = "pp"):
         # Only the last stage wrote anything; replicate its buffer.
         return jax.lax.psum(acc, axis)
 
-    return pipe
+    return validated
 
 
 def stack_stage_params(per_stage_params):

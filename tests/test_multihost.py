@@ -1,0 +1,32 @@
+"""Multi-host scaffolding helpers (single-process behavior)."""
+
+import os
+from unittest import mock
+
+
+def test_multihost_helpers_single_process():
+    from fedml_tpu.parallel.multihost import (
+        hybrid_mesh,
+        initialize,
+        process_local_client_slice,
+    )
+
+    # Isolate from any pod environment: no coordinator -> no-op.
+    with mock.patch.dict(os.environ, {}, clear=False):
+        os.environ.pop("JAX_COORDINATOR_ADDRESS", None)
+        assert initialize() is False
+    mesh = hybrid_mesh((4,), axis_names=("clients",))
+    assert mesh.shape["clients"] == 4
+    mesh2 = hybrid_mesh((2, 2), axis_names=("clients", "model"))
+    assert mesh2.shape == {"clients": 2, "model": 2}
+    sl = process_local_client_slice(10)
+    assert sl == slice(0, 10)  # single process owns everything
+
+
+def test_hybrid_mesh_validates_ranks():
+    import pytest
+
+    from fedml_tpu.parallel.multihost import hybrid_mesh
+
+    with pytest.raises(ValueError, match="rank"):
+        hybrid_mesh((2, 2), (4,), ("hosts", "clients"))

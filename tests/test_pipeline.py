@@ -56,17 +56,11 @@ def test_pipeline_grads_match_sequential():
                                    rtol=5e-5, atol=5e-5)
 
 
-def test_multihost_helpers_single_process():
-    from fedml_tpu.parallel.multihost import (
-        hybrid_mesh,
-        initialize,
-        process_local_client_slice,
-    )
+def test_pipeline_rejects_stage_mesh_mismatch():
+    import pytest
 
-    assert initialize() is False  # no coordinator configured → single host
-    mesh = hybrid_mesh((4,), axis_names=("clients",))
-    assert mesh.shape["clients"] == 4
-    mesh2 = hybrid_mesh((2, 2), axis_names=("clients", "model"))
-    assert mesh2.shape == {"clients": 2, "model": 2}
-    sl = process_local_client_slice(10)
-    assert sl == slice(0, 10)  # single process owns everything
+    stages = _stages(8, 8)
+    mesh = client_mesh(4, axis_name="pp")
+    pipe = make_pipeline(_stage_fn, mesh, "pp")
+    with pytest.raises(ValueError, match="8 stages"):
+        pipe(stack_stage_params(stages), jnp.zeros((4, 2, 8), jnp.float32))

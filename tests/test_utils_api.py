@@ -45,3 +45,49 @@ def test_synthetic_alpha_beta_shapes():
 
     stats = record_data_stats(y, parts)
     assert len({tuple(sorted(s.items())) for s in stats.values()}) > 1
+
+
+def test_pretrained_save_load_roundtrip(tmp_path):
+    import jax
+    import numpy as np
+
+    from fedml_tpu.models import create_model
+    from fedml_tpu.models.pretrained import load_params, save_params
+    from fedml_tpu.trainer.local import model_fns
+
+    fns = model_fns(create_model("resnet20", num_classes=10))
+    net = fns.init(jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32))
+    p = str(tmp_path / "resnet20.npz")
+    save_params(net, p)
+
+    net2 = fns.init(jax.random.PRNGKey(1), np.zeros((1, 32, 32, 3), np.float32))
+    restored = load_params(net2, p)
+    for a, b in zip(jax.tree.leaves(net.params), jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # shape mismatch raises with the offending key
+    import pytest
+
+    fns4 = model_fns(create_model("resnet20", num_classes=4))
+    net4 = fns4.init(jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32))
+    with pytest.raises((ValueError, KeyError)):
+        load_params(net4, p)
+
+
+def test_shared_utils():
+    import logging
+    import threading
+
+    import pytest
+
+    from fedml_tpu.utils import get_lock, logging_config, raise_error
+
+    lock = threading.Lock()
+    with get_lock(lock):
+        assert lock.locked()
+    assert not lock.locked()
+
+    with pytest.raises(RuntimeError):
+        with raise_error(logging.getLogger("t")):
+            raise RuntimeError("boom")
+
+    logging_config(process_id=3)
