@@ -18,7 +18,13 @@ class FederatedLoop:
     """Mixin. Subclasses provide ``cfg``, ``train_one_round(round_idx)``,
     ``eval_fn``, ``test_global``, and ``_eval_net()``. Subclasses that also
     provide ``n_shards``, ``train_fed``, ``net``, ``rng`` and ``round_fn``
-    get the shared round scaffold (``sample_round``/``run_round``) for free."""
+    get the shared round scaffold (``sample_round``/``run_round``) for free.
+
+    ``round_fn_fused`` is an optional extension point: a jitted
+    ``(net, train_fed, idx, wmask, rng)`` round with the client gather
+    traced inside (single-device fast path built by FedAvgAPI)."""
+
+    round_fn_fused = None
 
     def _eval_net(self):
         raise NotImplementedError
@@ -40,13 +46,21 @@ class FederatedLoop:
     def run_round(self, round_idx: int):
         """One sampled round through ``round_fn``: gather client shards,
         sample-count weights (padded slots weight 0), fresh round rng.
-        Returns ``(avg_net, mean_loss)`` without touching ``self.net``."""
+        Returns ``(avg_net, mean_loss)`` without touching ``self.net``.
+
+        When the subclass built a fused single-device round
+        (``round_fn_fused``), the gather happens inside the jit — one
+        dispatch per round instead of five."""
+        self.rng, rnd_rng = jax.random.split(self.rng)
+        idx, wmask = self.sample_round(round_idx)
+        if self.round_fn_fused is not None:
+            return self.round_fn_fused(
+                self.net, self.train_fed,
+                jnp.asarray(idx), jnp.asarray(wmask), rnd_rng)
         from fedml_tpu.data.batching import gather_clients
 
-        idx, wmask = self.sample_round(round_idx)
         sub = gather_clients(self.train_fed, idx)
         weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
-        self.rng, rnd_rng = jax.random.split(self.rng)
         return self.round_fn(
             self.net, sub.x, sub.y, sub.mask, weights, weights, rnd_rng
         )
