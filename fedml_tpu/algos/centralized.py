@@ -27,7 +27,8 @@ class CentralizedTrainer:
         self.fns = model_fns(model)
         optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
         self.train_fn = jax.jit(
-            make_local_train_fn(self.fns.apply, optimizer, cfg.epochs, loss_fn)
+            make_local_train_fn(self.fns.apply, optimizer, cfg.epochs, loss_fn,
+                                remat=cfg.remat)
         )
         self.eval_fn = jax.jit(make_eval_fn(self.fns.apply, loss_fn))
         self.rng, init_rng = jax.random.split(jax.random.PRNGKey(cfg.seed))

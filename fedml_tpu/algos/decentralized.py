@@ -81,7 +81,8 @@ class DecentralizedAPI(FederatedLoop):
         )
 
         optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
-        local_train = make_local_train_fn(self.fns.apply, optimizer, cfg.epochs, loss_fn)
+        local_train = make_local_train_fn(self.fns.apply, optimizer, cfg.epochs,
+                                          loss_fn, remat=cfg.remat)
 
         def mix(stacked):
             return jax.tree.map(

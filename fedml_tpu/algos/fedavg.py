@@ -124,7 +124,8 @@ class FedAvgAPI(FederatedLoop):
 
     # --- hooks subclasses override (FedOpt/FedProx/...) -------------------
     def _build_local_train(self, optimizer, loss_fn):
-        return make_local_train_fn(self.fns.apply, optimizer, self.cfg.epochs, loss_fn)
+        return make_local_train_fn(self.fns.apply, optimizer, self.cfg.epochs,
+                                   loss_fn, remat=self.cfg.remat)
 
     def _server_update(self, old_net, avg_net):
         """FedAvg: the new global model is the client average."""

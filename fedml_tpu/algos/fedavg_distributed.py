@@ -226,7 +226,8 @@ def FedML_FedAvg_distributed(
     net0 = fns.init(jax.random.PRNGKey(cfg.seed), sample_x)
     optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
     local_train = jax.jit(
-        make_local_train_fn(fns.apply, optimizer, cfg.epochs, loss_fn=loss_fn)
+        make_local_train_fn(fns.apply, optimizer, cfg.epochs, loss_fn=loss_fn,
+                            remat=cfg.remat)
     )
     eval_fn = jax.jit(make_eval_fn(fns.apply, loss_fn=loss_fn)) if test_global else None
 

@@ -30,4 +30,5 @@ class FedProxAPI(FedAvgAPI):
             self.cfg.epochs,
             loss_fn,
             extra_grad_fn=prox_grad if mu > 0 else None,
+            remat=self.cfg.remat,
         )

@@ -54,6 +54,8 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--lr_decay_rate", type=float, default=0.992)
     p.add_argument("--grad_clip", type=float, default=0.0,
                    help="max grad norm; 0 disables")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize activations in backprop (less HBM)")
     # mesh / sharding (TPU-native replacement for gpu_mapping yaml)
     p.add_argument("--num_devices", type=int, default=0,
                    help="shard clients over this many devices; 0 = single-device vmap")
@@ -103,4 +105,5 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         lr_schedule=args.lr_schedule,
         lr_decay_rate=args.lr_decay_rate,
         grad_clip=args.grad_clip,
+        remat=args.remat,
     )

@@ -101,7 +101,8 @@ class ModelTrainer(abc.ABC):
         )
         epochs = getattr(args, "epochs", 1)
         self._local = jax.jit(
-            make_local_train_fn(self.fns.apply, opt, epochs, loss_fn))
+            make_local_train_fn(self.fns.apply, opt, epochs, loss_fn,
+                                remat=getattr(args, "remat", False)))
         self._eval = jax.jit(make_eval_fn(self.fns.apply, loss_fn, pad_id=pad_id))
         self._rng = jax.random.PRNGKey(getattr(args, "seed", 0) + self.id)
 

@@ -70,3 +70,31 @@ def test_fedavg_padded_sampling_unbiased():
     api_shard.train()
     for a, b in zip(jax.tree.leaves(api_local.net.params), jax.tree.leaves(api_shard.net.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_remat_matches_no_remat_exactly():
+    """jax.checkpoint changes memory, not math: identical trained params."""
+    import jax
+
+    from fedml_tpu.algos import FedAvgAPI, FedConfig
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_image_classification
+    from fedml_tpu.models.resnet import resnet20
+
+    x, y = make_image_classification(96, hwc=(16, 16, 3), n_classes=4)
+    fed = build_federated_arrays(x, y, partition_homo(96, 4), 8)
+
+    def run(remat):
+        cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                        comm_round=2, epochs=1, batch_size=8, lr=0.05,
+                        remat=remat)
+        api = FedAvgAPI(resnet20(num_classes=4), fed, None, cfg)
+        for r in range(2):
+            api.train_one_round(r)
+        return api.net.params
+
+    a, b = run(False), run(True)
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-6, atol=1e-6)
