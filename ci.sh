@@ -1,0 +1,35 @@
+#!/bin/bash
+# CI smoke script — parity with the reference's CI-script-*.sh family
+# (pyflakes gate + tiny-config end-to-end runs, CI-script-fedavg.sh:6-56).
+# The pytest suite (python -m pytest tests/ -x -q) is the primary gate; this
+# script is the fast end-to-end sanity layer.
+set -euo pipefail
+
+export PALLAS_AXON_POOL_IPS=
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+echo "== static check (compileall; the reference ran pyflakes) =="
+python -m compileall -q fedml_tpu
+
+common="--client_num_in_total 4 --client_num_per_round 4 --batch_size 8 \
+        --comm_round 2 --epochs 1 --ci 1"
+
+echo "== standalone FedAvg on LEAF-shaped mnist =="
+python -m fedml_tpu.exp.main_fedavg --model lr --dataset mnist $common
+
+echo "== FedOpt (server adam) on synthetic =="
+python -m fedml_tpu.exp.run --algorithm FedOpt --server_optimizer adam \
+    --model lr --dataset synthetic_1_1 $common
+
+echo "== FedAvg sharded over 4 devices =="
+python -m fedml_tpu.exp.main_fedavg --model lr --dataset synthetic_1_1 \
+    --num_devices 4 $common
+
+echo "== message-passing framework templates =="
+python -m fedml_tpu.exp.main_extra --algorithm BaseFramework $common
+
+echo "== vertical FL =="
+python -m fedml_tpu.exp.main_extra --algorithm VFL --dataset cifar10 $common
+
+echo "CI OK"
