@@ -29,7 +29,7 @@ python -m fedml_tpu.exp.main_fedavg --model lr --dataset synthetic_1_1 \
 echo "== message-passing framework templates =="
 python -m fedml_tpu.exp.main_extra --algorithm BaseFramework $common
 
-echo "== vertical FL =="
-python -m fedml_tpu.exp.main_extra --algorithm VFL --dataset cifar10 $common
+echo "== vertical FL (synthetic NUS-WIDE-shaped two-party data) =="
+python -m fedml_tpu.exp.main_extra --algorithm VFL $common
 
 echo "CI OK"

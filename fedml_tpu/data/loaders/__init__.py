@@ -51,6 +51,30 @@ from fedml_tpu.data.loaders.vertical import (
 from fedml_tpu.data.loaders.streaming import StreamingDataLoader
 
 
+def load_synthetic_seg(
+    batch_size: int,
+    n_clients: int = 8,
+    samples_per_client: int = 24,
+    hw=(16, 16),
+    n_classes: int = 4,
+    seed: int = 0,
+) -> FederatedDataset:
+    """Synthetic segmentation dataset (blob masks + void pixels) for the
+    FedSeg pipeline — the reference's fedseg has no in-repo dataset either
+    (it points at external Pascal/ADE setups)."""
+    from fedml_tpu.data.synthetic import make_segmentation
+
+    train, test = {}, {}
+    for c in range(n_clients):
+        x, y = make_segmentation(samples_per_client, hw=hw, n_classes=n_classes,
+                                 seed=seed + c)
+        train[c] = (x, y)
+        xt, yt = make_segmentation(max(4, samples_per_client // 4), hw=hw,
+                                   n_classes=n_classes, seed=seed + 100 + c)
+        test[c] = (xt, yt)
+    return build_federated_dataset(train, test, batch_size, class_num=n_classes)
+
+
 def load_synthetic_1_1(batch_size: int, n_clients: int = 30, seed: int = 0) -> FederatedDataset:
     """LEAF synthetic(α=1, β=1) LR task (data_preprocessing/synthetic_1_1/)."""
     from fedml_tpu.data.synthetic import synthetic_alpha_beta
@@ -107,6 +131,8 @@ def load_data(
         return load_partition_data_landmarks(data_dir, kw.pop("fed_train_map_file", None), kw.pop("fed_test_map_file", None), batch_size, **kw)
     if dataset == "synthetic_1_1":
         return load_synthetic_1_1(batch_size, n_clients=client_num_in_total, **kw)
+    if dataset == "synthetic_seg":
+        return load_synthetic_seg(batch_size, n_clients=client_num_in_total, **kw)
     raise ValueError(f"unknown dataset {dataset!r}")
 
 
@@ -144,6 +170,7 @@ __all__ = [
     "load_partition_data_landmarks",
     "load_poisoned_dataset",
     "load_synthetic_1_1",
+    "load_synthetic_seg",
     "load_two_party_nus_wide",
     "load_three_party_nus_wide",
     "load_lending_club",

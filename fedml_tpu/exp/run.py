@@ -36,7 +36,8 @@ def round_lr(base_lr: float, schedule: str, round_idx: int, total_rounds: int,
 SEQ_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp"}
 
 
-def make_api(algorithm: str, args, model, arrays, test, cfg, mesh):
+def make_api(algorithm: str, args, model, arrays, test, cfg, mesh,
+             class_num: int | None = None):
     from fedml_tpu import algos
     from fedml_tpu.trainer.local import seq_softmax_ce
 
@@ -60,6 +61,15 @@ def make_api(algorithm: str, args, model, arrays, test, cfg, mesh):
     }
     if algorithm in table:
         return table[algorithm](model, arrays, test, cfg, **common)
+    if algorithm == "FedSeg":
+        if class_num is None:
+            raise ValueError("FedSeg needs class_num (the dataset's classes)")
+        if args.dataset in SEQ_DATASETS:
+            raise ValueError(
+                "FedSeg is a segmentation task; it cannot run on sequence "
+                f"dataset {args.dataset!r}")
+        return algos.FedSegAPI(model, arrays, test, cfg,
+                               num_classes=class_num, **common)
     if algorithm == "HierarchicalFL":
         import numpy as np
 
@@ -69,7 +79,8 @@ def make_api(algorithm: str, args, model, arrays, test, cfg, mesh):
             model, arrays, test, cfg, group_ids=group_ids, **common
         )
     raise ValueError(
-        f"unknown algorithm {algorithm!r}; known: {sorted(table) + ['HierarchicalFL']}"
+        f"unknown algorithm {algorithm!r}; known: "
+        f"{sorted(table) + ['FedSeg', 'HierarchicalFL']}"
     )
 
 
@@ -85,7 +96,8 @@ def run(args, algorithm: str = "FedAvg"):
             "runs use fedml_tpu.algos.fedavg_distributed with a comm "
             "backend from fedml_tpu.comm")
     fed, arrays, test, model, cfg, mesh = setup_standard(args)
-    api = make_api(algorithm, args, model, arrays, test, cfg, mesh)
+    api = make_api(algorithm, args, model, arrays, test, cfg, mesh,
+                   class_num=fed.class_num)
 
     from fedml_tpu.obs import MetricsLogger, RoundTimer
 

@@ -78,3 +78,15 @@ def test_cli_subprocess_north_star():
     assert out.returncode == 0, out.stderr[-2000:]
     last = json.loads(out.stdout.strip().splitlines()[-1])
     assert "train_loss" in last
+
+
+def test_run_fedseg_cli():
+    args = parse_args([
+        "--model", "unet", "--dataset", "synthetic_seg",
+        "--client_num_in_total", "4", "--client_num_per_round", "4",
+        "--batch_size", "8", "--comm_round", "2", "--epochs", "1",
+        "--lr", "0.05", "--client_optimizer", "adam",
+    ])
+    _, history = run(args, algorithm="FedSeg")
+    assert np.isfinite(history[-1]["train_loss"])
+    assert "mIoU" in history[-1]
