@@ -86,6 +86,7 @@ class FedAvgAPI(FederatedLoop):
         if lr == self._client_lr:
             return
         self._client_lr = lr
+        self._rounds_scan_fn = None  # round_fn changes → cached scan stale
         cfg, mesh = self.cfg, self.mesh
         optimizer = make_client_optimizer(
             cfg.client_optimizer, lr, cfg.wd, cfg.grad_clip
@@ -143,6 +144,76 @@ class FedAvgAPI(FederatedLoop):
         avg, loss = self.run_round(round_idx)
         self.net = self._server_update(self.net, avg)
         return {"round": round_idx, "train_loss": float(loss)}
+
+    def train_rounds_on_device(self, n_rounds: int):
+        """Run ``n_rounds`` WHOLE federated rounds in one jit: a
+        ``lax.scan`` over rounds with on-device client sampling — zero
+        host round-trips between rounds (the reference pays an MPI
+        broadcast + gather per round; even our fused round pays one
+        dispatch). Returns the per-round loss array.
+
+        Semantics notes: sampling uses the jax PRNG stream (fold_in per
+        round) rather than the reference's ``np.random.seed(round_idx)``
+        — with FULL participation both are the identity and this method is
+        bit-equal to the host loop (tested); with subsampling the client
+        choice differs from host-loop runs. Only plain FedAvg server
+        updates (new = avg) can ride the scan; subclasses with stateful
+        server optimizers must use the host loop."""
+        if (type(self)._server_update is not FedAvgAPI._server_update
+                or type(self).train_one_round is not FedAvgAPI.train_one_round
+                or type(self).run_round is not FederatedLoop.run_round):
+            raise NotImplementedError(
+                "train_rounds_on_device supports plain-FedAvg rounds only; "
+                "this subclass customizes the round or server update "
+                "(hierarchical grouping, MPC aggregation, server optimizers "
+                "cannot ride the scan)")
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "train_rounds_on_device currently targets the single-device "
+                "vmap path (the sharded path's resharding gather must run "
+                "outside shard_map)")
+        cfg = self.cfg
+        n_total = int(self.train_fed.num_clients)
+        cpr = min(cfg.client_num_per_round, n_total)
+
+        scan_fn = getattr(self, "_rounds_scan_fn", None)
+        if scan_fn is None:
+            round_fn = self.round_fn  # jitted; nested jit is fine under scan
+
+            def body(fed, net, key):
+                if cpr == n_total:
+                    idx = jnp.arange(n_total)
+                else:
+                    idx = jax.random.choice(
+                        jax.random.fold_in(key, 0x5A), n_total, (cpr,),
+                        replace=False)
+                sx = jnp.take(fed.x, idx, axis=0)
+                sy = jnp.take(fed.y, idx, axis=0)
+                sm = jnp.take(fed.mask, idx, axis=0)
+                w = jnp.take(fed.counts, idx, axis=0).astype(jnp.float32)
+                # The round key is used AS the host loop uses rnd_rng, so
+                # with full participation this scan is bit-equal to it.
+                avg, loss = round_fn(net, sx, sy, sm, w, w, key)
+                return avg, loss
+
+            # fed and keys are jit ARGUMENTS (FederatedArrays is a struct
+            # pytree): the dataset is not baked into the program as
+            # constants, and the compiled scan is cached on self — repeat
+            # calls with the same n_rounds reuse the executable.
+            def scan_fn(net, fed, keys):
+                return jax.lax.scan(
+                    lambda n, k: body(fed, n, k), net, keys)
+
+            scan_fn = jax.jit(scan_fn)
+            self._rounds_scan_fn = scan_fn
+
+        # Reproduce the host loop's per-round rng chain exactly.
+        keys = []
+        for _ in range(n_rounds):
+            self.rng, rnd = jax.random.split(self.rng)
+            keys.append(rnd)
+        self.net, losses = scan_fn(self.net, self.train_fed, jnp.stack(keys))
+        return losses
 
     def _eval_net(self):
         return self.net

@@ -98,3 +98,81 @@ def test_remat_matches_no_remat_exactly():
     for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
                                    rtol=1e-6, atol=1e-6)
+
+
+def test_train_rounds_on_device_full_participation_bit_equal():
+    """The one-jit multi-round scan equals the host loop exactly at full
+    participation (same rng chain, identity sampling)."""
+    import jax
+
+    from fedml_tpu.algos import FedAvgAPI, FedConfig
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+    from fedml_tpu.models import create_model
+
+    x, y = make_classification(160, n_features=8, n_classes=4)
+    fed = build_federated_arrays(x, y, partition_homo(160, 4), 8)
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=5, epochs=2, batch_size=8, lr=0.2)
+
+    host = FedAvgAPI(create_model("lr", input_dim=8, num_classes=4), fed, None, cfg)
+    host_losses = [host.train_one_round(r)["train_loss"] for r in range(5)]
+
+    dev = FedAvgAPI(create_model("lr", input_dim=8, num_classes=4), fed, None, cfg)
+    dev_losses = dev.train_rounds_on_device(5)
+
+    np.testing.assert_allclose(np.asarray(dev_losses), np.asarray(host_losses),
+                               rtol=1e-6, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(host.net.params), jax.tree.leaves(dev.net.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_train_rounds_on_device_subsampled_runs():
+    import numpy as _np
+
+    from fedml_tpu.algos import FedAvgAPI, FedConfig, FedOptAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+    from fedml_tpu.models import create_model
+
+    x, y = make_classification(320, n_features=8, n_classes=4)
+    fed = build_federated_arrays(x, y, partition_homo(320, 16), 8)
+    cfg = FedConfig(client_num_in_total=16, client_num_per_round=4,
+                    comm_round=10, epochs=1, batch_size=8, lr=0.2)
+    api = FedAvgAPI(create_model("lr", input_dim=8, num_classes=4), fed, None, cfg)
+    losses = api.train_rounds_on_device(10)
+    assert losses.shape == (10,)
+    assert _np.isfinite(_np.asarray(losses)).all()
+    assert float(losses[-1]) < float(losses[0])
+
+    # Stateful-server subclasses refuse the scan path.
+    import pytest
+
+    opt_api = FedOptAPI(create_model("lr", input_dim=8, num_classes=4), fed, None, cfg)
+    with pytest.raises(NotImplementedError):
+        opt_api.train_rounds_on_device(3)
+
+
+def test_train_rounds_on_device_rejects_custom_round_subclasses():
+    import pytest
+
+    from fedml_tpu.algos import FedConfig, HierarchicalFedAvgAPI, TurboAggregateAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+    from fedml_tpu.models import create_model
+
+    x, y = make_classification(96, n_features=8, n_classes=4)
+    fed = build_federated_arrays(x, y, partition_homo(96, 4), 8)
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=2, epochs=1, batch_size=8, lr=0.1)
+    for api in (
+        HierarchicalFedAvgAPI(create_model("lr", input_dim=8, num_classes=4),
+                              fed, None, cfg, group_ids=[0, 0, 1, 1]),
+        TurboAggregateAPI(create_model("lr", input_dim=8, num_classes=4),
+                          fed, None, cfg),
+    ):
+        with pytest.raises(NotImplementedError):
+            api.train_rounds_on_device(2)
