@@ -180,6 +180,8 @@ class FedAvgAPI(FederatedLoop):
         if scan_fn is None:
             round_fn = self.round_fn  # jitted; nested jit is fine under scan
 
+            from fedml_tpu.data.batching import gather_clients
+
             def body(fed, net, key):
                 if cpr == n_total:
                     idx = jnp.arange(n_total)
@@ -187,13 +189,11 @@ class FedAvgAPI(FederatedLoop):
                     idx = jax.random.choice(
                         jax.random.fold_in(key, 0x5A), n_total, (cpr,),
                         replace=False)
-                sx = jnp.take(fed.x, idx, axis=0)
-                sy = jnp.take(fed.y, idx, axis=0)
-                sm = jnp.take(fed.mask, idx, axis=0)
-                w = jnp.take(fed.counts, idx, axis=0).astype(jnp.float32)
+                sub = gather_clients(fed, idx)
+                w = sub.counts.astype(jnp.float32)
                 # The round key is used AS the host loop uses rnd_rng, so
                 # with full participation this scan is bit-equal to it.
-                avg, loss = round_fn(net, sx, sy, sm, w, w, key)
+                avg, loss = round_fn(net, sub.x, sub.y, sub.mask, w, w, key)
                 return avg, loss
 
             # fed and keys are jit ARGUMENTS (FederatedArrays is a struct
