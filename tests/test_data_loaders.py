@@ -206,3 +206,15 @@ def test_on_device_augmentation():
     assert out.shape == x.shape
     # cutout must have zeroed something
     assert float(out.min()) == 0.0
+
+
+def test_landmarks_csv_reader(tmp_path):
+    """read_landmarks_csv parses the gld federated-split csv format."""
+    from fedml_tpu.data.loaders.imagenet import read_landmarks_csv
+
+    p = tmp_path / "fed_train.csv"
+    p.write_text("user_id,image_id,class\nu1,img_a,3\nu1,img_b,5\nu2,img_c,3\n")
+    users = read_landmarks_csv(str(p))
+    assert set(users) == {"u1", "u2"}
+    assert users["u1"] == [("img_a", 3), ("img_b", 5)]
+    assert users["u2"] == [("img_c", 3)]
