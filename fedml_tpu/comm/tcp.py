@@ -21,6 +21,7 @@ from __future__ import annotations
 import ctypes
 import csv
 import pickle
+import time
 from typing import Dict, List, Tuple
 
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
@@ -81,25 +82,40 @@ class TcpCommManager(BaseCommunicationManager):
         self._sender = self._lib.mn_sender_create()
         self._observers: List[Observer] = []
         self._running = False
+        self._contacted: set = set()  # peers reached at least once
 
     @property
     def port(self) -> int:
         return self.ip_config[self.rank][1]
 
     # -- BaseCommunicationManager ------------------------------------------
-    def send_message(self, msg: Message) -> None:
+    def send_message(self, msg: Message, retries: int = 20,
+                     backoff_s: float = 0.5) -> None:
+        """Send with connect retries ONLY until a peer is first reached:
+        cross-silo processes start in any order, so the first sends may
+        race the receiver's bind (the reference's MPI launcher sidesteps
+        this because mpirun barrier-starts all ranks). Once a peer has been
+        contacted, failures are treated as real (one quick re-attempt via
+        the C layer's reconnect, then raise) — a crashed silo must surface
+        in ~0 s, not after a 10 s retry window per message."""
         receiver = int(msg.get_receiver_id())
         host, port = self.ip_config[receiver]
         if self._serializer == "pickle":
             blob = pickle.dumps(msg.get_params(), protocol=pickle.HIGHEST_PROTOCOL)
         else:
             blob = msg.to_json().encode()
+        n_tries = (retries if receiver not in self._contacted else 0) + 1
         # bytes → const uint8* zero-copy (argtype c_char_p).
-        rc = self._lib.mn_send(self._sender, host.encode(), port, blob, len(blob))
-        if rc != 0:
-            raise ConnectionError(
-                f"msgnet: send from rank {self.rank} to {receiver} "
-                f"({host}:{port}) failed")
+        for attempt in range(n_tries):
+            rc = self._lib.mn_send(self._sender, host.encode(), port, blob, len(blob))
+            if rc == 0:
+                self._contacted.add(receiver)
+                return
+            if attempt < n_tries - 1:
+                time.sleep(backoff_s)
+        raise ConnectionError(
+            f"msgnet: send from rank {self.rank} to {receiver} "
+            f"({host}:{port}) failed after {n_tries} attempts")
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

@@ -1,0 +1,114 @@
+"""Per-rank cross-silo FedAvg entry — the reference's mpirun story with
+separate OS processes over the native TCP transport.
+
+The reference launches `mpirun -np W+1 python main_fedavg.py` and every rank
+runs the same program (run_fedavg_distributed_pytorch.sh:21). Here each silo
+process runs:
+
+    python -m fedml_tpu.exp.main_cross_silo --rank 0 --size 3 \
+        --host_table hosts.csv --model lr --dataset mnist ...   # server
+    python -m fedml_tpu.exp.main_cross_silo --rank 1 --size 3 ...  # silo 1
+    python -m fedml_tpu.exp.main_cross_silo --rank 2 --size 3 ...  # silo 2
+
+``--host_table`` is the grpc_ipconfig.csv-format rank→host[,port] table
+(defaults: every rank on 127.0.0.1 with port ``--port_base``+rank). Every
+rank loads the dataset with identical flags/seed (as the reference does,
+main_fedavg.py:133 — "every rank loads the full dataset"), so client shards
+agree across processes without shipping data.
+
+The server prints one JSON line with the final test metrics when done.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algos.fedavg_distributed import (
+    FedAVGAggregator,
+    FedAVGClientManager,
+    FedAVGServerManager,
+)
+from fedml_tpu.exp.args import add_args, config_from_args
+from fedml_tpu.exp.setup import create_model_for, global_test_batches, load_data
+from fedml_tpu.data.loaders import to_federated_arrays
+from fedml_tpu.trainer.local import (
+    make_client_optimizer,
+    make_eval_fn,
+    make_local_train_fn,
+    model_fns,
+    softmax_ce,
+)
+
+DEFAULT_PORT_BASE = 50100
+
+
+def build_host_table(args):
+    if args.host_table:
+        from fedml_tpu.comm.tcp import read_ip_config
+
+        return read_ip_config(args.host_table, base_port=args.port_base)
+    return {r: ("127.0.0.1", args.port_base + r) for r in range(args.size)}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rank", type=int, required=True)
+    parser.add_argument("--size", type=int, required=True,
+                        help="total processes = 1 server + W silos")
+    parser.add_argument("--host_table", type=str, default=None,
+                        help="grpc_ipconfig.csv-format rank,host[,port] table")
+    parser.add_argument("--port_base", type=int, default=DEFAULT_PORT_BASE)
+    add_args(parser)
+    args = parser.parse_args(argv)
+    if not 0 <= args.rank < args.size:
+        raise SystemExit(f"--rank {args.rank} outside [0, {args.size})")
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[cross-silo rank {args.rank}] %(asctime)s %(message)s")
+
+    fed = load_data(args)
+    arrays = to_federated_arrays(fed, args.batch_size)
+    cfg = config_from_args(args)
+    cfg.client_num_in_total = fed.client_num
+    worker_num = args.size - 1
+    cfg.client_num_per_round = min(worker_num, fed.client_num)
+    model = create_model_for(args, fed)
+    fns = model_fns(model)
+
+    class NetArgs:
+        pass
+
+    net_args = NetArgs()
+    net_args.host_table = build_host_table(args)
+
+    if args.rank == 0:
+        sample_x = jnp.zeros((1,) + arrays.x.shape[3:], arrays.x.dtype)
+        net0 = fns.init(jax.random.PRNGKey(cfg.seed), sample_x)
+        test = global_test_batches(fed, args.batch_size)
+        eval_fn = jax.jit(make_eval_fn(fns.apply)) if test is not None else None
+        aggregator = FedAVGAggregator(net0, worker_num, cfg, eval_fn, test)
+        server = FedAVGServerManager(net_args, aggregator, cfg, args.size,
+                                     backend="TCP")
+        server.run()
+        final = aggregator.test_history[-1] if aggregator.test_history else {}
+        print(json.dumps({"rank": 0, **final}))
+    else:
+        optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd,
+                                          cfg.grad_clip)
+        local_train = jax.jit(make_local_train_fn(
+            fns.apply, optimizer, cfg.epochs, loss_fn=softmax_ce,
+            remat=cfg.remat))
+        client = FedAVGClientManager(net_args, args.rank, args.size, arrays,
+                                     local_train, cfg, backend="TCP")
+        client.run()
+        print(json.dumps({"rank": args.rank, "status": "done"}))
+
+
+if __name__ == "__main__":
+    main()
