@@ -33,9 +33,7 @@ from fedml_tpu.algos.fedavg_distributed import (
     FedAVGClientManager,
     FedAVGServerManager,
 )
-from fedml_tpu.exp.args import add_args, config_from_args
-from fedml_tpu.exp.setup import create_model_for, global_test_batches, load_data
-from fedml_tpu.data.loaders import to_federated_arrays
+from fedml_tpu.exp.args import add_args
 from fedml_tpu.trainer.local import (
     make_client_optimizer,
     make_eval_fn,
@@ -72,13 +70,16 @@ def main(argv=None):
         level=logging.INFO,
         format=f"[cross-silo rank {args.rank}] %(asctime)s %(message)s")
 
-    fed = load_data(args)
-    arrays = to_federated_arrays(fed, args.batch_size)
-    cfg = config_from_args(args)
-    cfg.client_num_in_total = fed.client_num
+    from fedml_tpu.exp.setup import setup_standard
+
+    fed, arrays, test, model, cfg, _mesh = setup_standard(args)
     worker_num = args.size - 1
-    cfg.client_num_per_round = min(worker_num, fed.client_num)
-    model = create_model_for(args, fed)
+    if worker_num > fed.client_num:
+        raise SystemExit(
+            f"--size {args.size} needs {worker_num} clients but the dataset "
+            f"has only {fed.client_num}; reduce --size or raise "
+            "--client_num_in_total")
+    cfg.client_num_per_round = worker_num
     fns = model_fns(model)
 
     class NetArgs:
@@ -90,7 +91,6 @@ def main(argv=None):
     if args.rank == 0:
         sample_x = jnp.zeros((1,) + arrays.x.shape[3:], arrays.x.dtype)
         net0 = fns.init(jax.random.PRNGKey(cfg.seed), sample_x)
-        test = global_test_batches(fed, args.batch_size)
         eval_fn = jax.jit(make_eval_fn(fns.apply)) if test is not None else None
         aggregator = FedAVGAggregator(net0, worker_num, cfg, eval_fn, test)
         server = FedAVGServerManager(net_args, aggregator, cfg, args.size,

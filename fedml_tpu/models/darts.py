@@ -279,9 +279,105 @@ def derive_genotype(alphas_normal, alphas_reduce, steps: int = 4,
     return Genotype(parse(alphas_normal), concat, parse(alphas_reduce), concat)
 
 
+class GenotypeCell(nn.Module):
+    """Discrete cell built from a searched genotype — the retraining model
+    (reference darts/model.py Cell: each intermediate node sums its two
+    chosen ops; output = concat of the genotype's concat nodes)."""
+
+    genotype: Genotype
+    c: int
+    reduction: bool = False
+    reduction_prev: bool = False
+    norm: str = "gn"
+
+    def _op(self, name: str, h, strides: int, train: bool):
+        if name == "max_pool_3x3":
+            return nn.max_pool(h, (3, 3), strides=(strides, strides), padding="SAME")
+        if name == "avg_pool_3x3":
+            return nn.avg_pool(h, (3, 3), strides=(strides, strides), padding="SAME")
+        if name == "skip_connect":
+            return h if strides == 1 else FactorizedReduce(self.c, self.norm)(h, train)
+        if name.startswith("sep_conv"):
+            return SepConv(self.c, int(name[-1]), strides, self.norm)(h, train)
+        if name.startswith("dil_conv"):
+            return DilConv(self.c, int(name[-1]), strides, self.norm)(h, train)
+        raise ValueError(f"unknown genotype op {name!r}")
+
+    @nn.compact
+    def __call__(self, s0, s1, train: bool = False):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.c, self.norm)(s0, train)
+        else:
+            s0 = ReLUConvNorm(self.c, 1, 1, self.norm)(s0, train)
+        s1 = ReLUConvNorm(self.c, 1, 1, self.norm)(s1, train)
+        gene = self.genotype.reduce if self.reduction else self.genotype.normal
+        concat = (self.genotype.reduce_concat if self.reduction
+                  else self.genotype.normal_concat)
+        states = [s0, s1]
+        for i in range(0, len(gene), 2):
+            acc = None
+            for name, src in gene[i:i + 2]:
+                strides = 2 if self.reduction and src < 2 else 1
+                o = self._op(name, states[src], strides, train)
+                acc = o if acc is None else acc + o
+            states.append(acc)
+        return jnp.concatenate([states[k] for k in concat], axis=-1)
+
+
+class GenotypeNetwork(nn.Module):
+    """Retraining network from a fixed genotype (reference darts/model.py
+    NetworkCIFAR: stem → cells with reductions at 1/3 and 2/3 depth →
+    pooled classifier)."""
+
+    genotype: Genotype
+    num_classes: int = 10
+    c: int = 36
+    layers: int = 8
+    stem_multiplier: int = 3
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c_curr = self.stem_multiplier * self.c
+        x = nn.Conv(c_curr, (3, 3), padding="SAME", use_bias=False)(x)
+        x = Norm(self.norm)(x, train)
+        s0 = s1 = x
+        c = self.c
+        reduction_prev = False
+        # Same schedule as DartsNetwork (incl. the -{0} guard for tiny
+        # depths) — the retrain net must match the search net that produced
+        # the genotype.
+        reductions = {self.layers // 3, 2 * self.layers // 3} - {0}
+        for i in range(self.layers):
+            reduction = i in reductions
+            if reduction:
+                c *= 2
+            s0, s1 = s1, GenotypeCell(
+                self.genotype, c, reduction, reduction_prev, self.norm
+            )(s0, s1, train)
+            reduction_prev = reduction
+        x = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
 @register_model("darts")
 def darts(num_classes: int = 10, c: int = 16, layers: int = 8,
           steps: int = 4, multiplier: int = 4, norm: str = "gn", **_):
     return DartsNetwork(c=c, layers=layers, steps=steps,
                         multiplier=multiplier, num_classes=num_classes,
                         norm=norm)
+
+
+@register_model("darts_genotype")
+def darts_genotype(genotype: Genotype, num_classes: int = 10, c: int = 16,
+                   layers: int = 8, norm: str = "gn", **_):
+    """Retrain a searched architecture (reference darts/train.py path)."""
+    # Hashable genotype (tuples, not lists) — flax module fields are static.
+    genotype = Genotype(
+        tuple(tuple(e) for e in genotype.normal),
+        tuple(genotype.normal_concat),
+        tuple(tuple(e) for e in genotype.reduce),
+        tuple(genotype.reduce_concat),
+    )
+    return GenotypeNetwork(genotype=genotype, num_classes=num_classes, c=c,
+                           layers=layers, norm=norm)

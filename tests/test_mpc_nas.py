@@ -224,3 +224,48 @@ def test_lcc_alpha_beta_disjoint_privacy():
     for w in range(6):
         for k in range(2):
             assert not np.array_equal(shares[w], chunks[k])
+
+
+def test_genotype_network_search_to_retrain_pipeline():
+    """Full DARTS pipeline: search → derive genotype → build the discrete
+    retraining net → it forwards and trains (reference darts/train.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from fedml_tpu.models.darts import Genotype, darts_genotype, derive_genotype
+    from fedml_tpu.trainer.local import model_fns
+
+    # Derive a genotype from random alphas (search already tested elsewhere).
+    rng = np.random.RandomState(0)
+    steps = 2
+    from fedml_tpu.models.darts import PRIMITIVES, n_edges
+
+    alphas = rng.randn(n_edges(steps), len(PRIMITIVES))
+    gen = derive_genotype(alphas, alphas, steps=steps, multiplier=2)
+    assert isinstance(gen, Genotype) and len(gen.normal) == 2 * steps
+
+    model = darts_genotype(gen, num_classes=4, c=8, layers=3)
+    fns = model_fns(model)
+    x = jnp.asarray(rng.randn(2, 16, 16, 3), jnp.float32)
+    net = fns.init(jax.random.PRNGKey(0), x)
+    logits, _ = fns.apply(net, x)
+    assert logits.shape == (2, 4)
+
+    # One training step reduces loss on a fixed batch.
+    y = jnp.asarray([0, 1])
+    opt = optax.adam(5e-3)
+
+    def loss_fn(p):
+        lo, _ = fns.apply(type(net)(p, net.model_state), x)
+        return -jnp.mean(jnp.take_along_axis(jax.nn.log_softmax(lo), y[:, None], 1))
+
+    state = opt.init(net.params)
+    p = net.params
+    l0 = float(loss_fn(p))
+    for _ in range(10):
+        g = jax.grad(loss_fn)(p)
+        upd, state = opt.update(g, state)
+        p = optax.apply_updates(p, upd)
+    assert float(loss_fn(p)) < l0
