@@ -237,7 +237,7 @@ def FedML_FedAvg_distributed(
     args = Args()
     if backend == "LOOPBACK":
         args.network = LoopbackNetwork(size)
-    elif backend == "TCP":
+    elif backend in ("TCP", "GRPC"):
         # Single-host table on ephemeral ports: bind rank servers first
         # (port 0), then share the resolved table. Multi-host deployments
         # pass an explicit host_table / grpc_ipconfig.csv instead.

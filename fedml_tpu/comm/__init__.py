@@ -6,12 +6,16 @@ federation, where clients are separate OS processes or hosts. It mirrors the
 reference's architecture — a ``Message`` envelope, a pluggable
 ``BaseCommunicationManager``, observer dispatch, and ``ClientManager`` /
 ``ServerManager`` process bases (fedml_core/distributed/communication/
-base_com_manager.py:7, client/client_manager.py:14) — with two backends:
+base_com_manager.py:7, client/client_manager.py:14) — with four backends:
 
 - ``loopback`` — in-memory threaded router for tests and single-host
   multi-worker simulation (the fake backend the reference lacks, SURVEY §4.6)
 - ``tcp`` — native C++ length-prefixed socket transport over DCN, the
   cross-silo role the reference fills with gRPC (grpc_comm_manager.py:23)
+- ``grpc_backend`` — grpcio C-core transport speaking the
+  ``proto/comm.proto`` wire format (direct gRPC parity, one fixed ip table
+  for both listen and send sides)
+- ``mqtt`` — broker pub/sub for device/mobile edges (requires paho-mqtt)
 """
 
 from fedml_tpu.comm.message import Message

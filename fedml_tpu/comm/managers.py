@@ -8,9 +8,10 @@ keyed by message type, and runs a blocking receive loop until ``finish()``.
 Backend selection is a string, as in the reference (client_manager.py:20-36):
 ``LOOPBACK`` (in-memory; needs a shared ``LoopbackNetwork`` in
 ``args.network``), ``TCP`` (native C++ socket transport; ``args.host_table``
-maps rank → (host, port)), or ``MQTT`` (external broker via
-``args.mqtt_host``/``args.mqtt_port`` — the flags fedml_tpu.exp.args
-provides; requires paho-mqtt).
+maps rank → (host, port)), ``GRPC`` (grpcio C-core transport, same
+``args.host_table`` shape — proto/comm.proto wire format), or ``MQTT``
+(external broker via ``args.mqtt_host``/``args.mqtt_port`` — the flags
+fedml_tpu.exp.args provides; requires paho-mqtt).
 """
 
 from __future__ import annotations
@@ -29,6 +30,10 @@ def _build_backend(args, rank: int, size: int, backend: str) -> BaseCommunicatio
         from fedml_tpu.comm.tcp import TcpCommManager
 
         return TcpCommManager(args.host_table, rank)
+    if backend == "GRPC":
+        from fedml_tpu.comm.grpc_backend import GrpcCommManager
+
+        return GrpcCommManager(args.host_table, rank)
     if backend == "MQTT":
         from fedml_tpu.comm.mqtt import MqttCommManager
 

@@ -20,12 +20,12 @@ from __future__ import annotations
 
 import ctypes
 import csv
-import pickle
 import time
 from typing import Dict, List, Tuple
 
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
 from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.wire import WIRE_FORMATS, deserialize_message, serialize_message
 
 DEFAULT_BASE_PORT = 50000
 
@@ -57,13 +57,11 @@ class TcpCommManager(BaseCommunicationManager):
 
     def __init__(self, ip_config: Dict[int, Tuple[str, int]], rank: int,
                  backlog: int = 128, serializer: str = "pickle"):
-        """``serializer``: 'pickle' (fast; assumes TRUSTED silo peers — the
-        same trust model as the reference's pickled MPI dicts) or 'json'
-        (Message.to_json wire format, safe against malicious payloads, used
-        for untrusted/mobile edges like the reference's is_mobile mode)."""
+        """``serializer``: 'pickle' or 'json' — see
+        :mod:`fedml_tpu.comm.wire` for the trust trade-off."""
         from fedml_tpu.native import load_msgnet
 
-        if serializer not in ("pickle", "json"):
+        if serializer not in WIRE_FORMATS:
             raise ValueError(f"unknown serializer {serializer!r}")
         self._serializer = serializer
         self._lib = load_msgnet()
@@ -100,10 +98,7 @@ class TcpCommManager(BaseCommunicationManager):
         in ~0 s, not after a 10 s retry window per message."""
         receiver = int(msg.get_receiver_id())
         host, port = self.ip_config[receiver]
-        if self._serializer == "pickle":
-            blob = pickle.dumps(msg.get_params(), protocol=pickle.HIGHEST_PROTOCOL)
-        else:
-            blob = msg.to_json().encode()
+        blob = serialize_message(msg, self._serializer)
         n_tries = (retries if receiver not in self._contacted else 0) + 1
         # bytes → const uint8* zero-copy (argtype c_char_p).
         for attempt in range(n_tries):
@@ -135,11 +130,7 @@ class TcpCommManager(BaseCommunicationManager):
                 blob = ctypes.string_at(ptr, out_len.value)
             finally:
                 self._lib.mn_free(ptr)
-            if self._serializer == "pickle":
-                msg = Message()
-                msg.init(pickle.loads(blob))
-            else:
-                msg = Message.from_json(blob.decode())
+            msg = deserialize_message(blob, self._serializer)
             for obs in list(self._observers):
                 obs.receive_message(msg.get_type(), msg)
 

@@ -1,0 +1,40 @@
+"""Message ↔ bytes wire serialization shared by the socket-level backends
+(tcp, grpc_backend).
+
+Two formats, selected per manager and auto-detectable per frame:
+
+- ``pickle`` — pickled ``Message`` param dict, the same wire content the
+  reference's MPI backend ships (mpi_send_thread.py:27). Fast; assumes
+  TRUSTED silo peers.
+- ``json`` — ``Message.to_json`` (message.py:5-74 parity), safe against
+  malicious payloads; the format for untrusted/mobile edges (is_mobile
+  nested-list encoding included).
+"""
+
+from __future__ import annotations
+
+from fedml_tpu.comm.message import Message
+
+WIRE_FORMATS = ("pickle", "json")
+
+
+def serialize_message(msg: Message, wire: str) -> bytes:
+    if wire == "pickle":
+        import pickle
+
+        return pickle.dumps(msg.get_params(), protocol=pickle.HIGHEST_PROTOCOL)
+    if wire == "json":
+        return msg.to_json().encode()
+    raise ValueError(f"unknown wire format {wire!r}")
+
+
+def deserialize_message(payload: bytes, wire: str) -> Message:
+    if wire == "pickle":
+        import pickle
+
+        msg = Message()
+        msg.init(pickle.loads(payload))
+        return msg
+    if wire == "json":
+        return Message.from_json(payload.decode())
+    raise ValueError(f"unknown wire format {wire!r}")

@@ -1,5 +1,6 @@
 """Per-rank cross-silo FedAvg entry — the reference's mpirun story with
-separate OS processes over the native TCP transport.
+separate OS processes over the native TCP transport (or gRPC via
+``--comm_backend GRPC``).
 
 The reference launches `mpirun -np W+1 python main_fedavg.py` and every rank
 runs the same program (run_fedavg_distributed_pytorch.sh:21). Here each silo
@@ -61,6 +62,10 @@ def main(argv=None):
     parser.add_argument("--host_table", type=str, default=None,
                         help="grpc_ipconfig.csv-format rank,host[,port] table")
     parser.add_argument("--port_base", type=int, default=DEFAULT_PORT_BASE)
+    parser.add_argument("--comm_backend", type=str, default="TCP",
+                        choices=["TCP", "GRPC"],
+                        help="cross-silo transport: native C++ msgnet TCP "
+                             "or grpcio (proto/comm.proto wire)")
     add_args(parser)
     args = parser.parse_args(argv)
     if not 0 <= args.rank < args.size:
@@ -72,7 +77,10 @@ def main(argv=None):
 
     from fedml_tpu.exp.setup import setup_standard
 
-    fed, arrays, test, model, cfg, _mesh = setup_standard(args)
+    # Client silos never evaluate and shard clients by rank, not by mesh —
+    # skip the global test-set concat (rank 0 only) and mesh build.
+    fed, arrays, test, model, cfg, _ = setup_standard(
+        args, need_test=(args.rank == 0), need_mesh=False)
     worker_num = args.size - 1
     if worker_num > fed.client_num:
         raise SystemExit(
@@ -94,7 +102,7 @@ def main(argv=None):
         eval_fn = jax.jit(make_eval_fn(fns.apply)) if test is not None else None
         aggregator = FedAVGAggregator(net0, worker_num, cfg, eval_fn, test)
         server = FedAVGServerManager(net_args, aggregator, cfg, args.size,
-                                     backend="TCP")
+                                     backend=args.comm_backend)
         server.run()
         final = aggregator.test_history[-1] if aggregator.test_history else {}
         print(json.dumps({"rank": 0, **final}))
@@ -105,7 +113,7 @@ def main(argv=None):
             fns.apply, optimizer, cfg.epochs, loss_fn=softmax_ce,
             remat=cfg.remat))
         client = FedAVGClientManager(net_args, args.rank, args.size, arrays,
-                                     local_train, cfg, backend="TCP")
+                                     local_train, cfg, backend=args.comm_backend)
         client.run()
         print(json.dumps({"rank": args.rank, "status": "done"}))
 

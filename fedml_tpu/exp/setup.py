@@ -75,17 +75,22 @@ def build_mesh(num_devices: int):
     return client_mesh(num_devices)
 
 
-def setup_standard(args):
-    """(arrays, test_global, model, cfg, mesh) for the FedAvg-family mains."""
+def setup_standard(args, need_test: bool = True, need_mesh: bool = True):
+    """(arrays, test_global, model, cfg, mesh) for the FedAvg-family mains.
+
+    ``need_test=False`` skips concatenating the global test set (client
+    ranks of a cross-silo run never evaluate — only rank 0 should pay the
+    test-set memory); ``need_mesh=False`` skips device-mesh construction."""
     from fedml_tpu.exp.args import config_from_args
 
     fed = load_data(args)
     arrays = to_federated_arrays(fed, args.batch_size)
-    test = global_test_batches(fed, args.batch_size)
+    test = global_test_batches(fed, args.batch_size) if need_test else None
     model = create_model_for(args, fed)
     cfg = config_from_args(args)
     # Clamp sampling like the reference (client_sampling takes min,
     # FedAVGAggregator.py:92).
     cfg.client_num_per_round = min(cfg.client_num_per_round, fed.client_num)
     cfg.client_num_in_total = fed.client_num
-    return fed, arrays, test, model, cfg, build_mesh(args.num_devices)
+    mesh = build_mesh(args.num_devices) if need_mesh else None
+    return fed, arrays, test, model, cfg, mesh

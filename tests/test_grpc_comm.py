@@ -1,0 +1,103 @@
+"""gRPC backend: protobuf wire codec (cross-checked against protoc),
+manager round-trips, and the full cross-silo federation over localhost."""
+
+import shutil
+import subprocess
+import threading
+
+import numpy as np
+import pytest
+
+from fedml_tpu.comm import Message
+from fedml_tpu.comm.grpc_backend import (
+    GrpcCommManager,
+    decode_comm_ack,
+    decode_comm_request,
+    encode_comm_ack,
+    encode_comm_request,
+)
+
+
+def test_codec_roundtrip():
+    payload = b"\x00" * 100 + bytes(range(256)) + b"tail"
+    frame = encode_comm_request(300, payload, "json")
+    assert decode_comm_request(frame) == (300, payload, "json")
+    assert decode_comm_ack(encode_comm_ack(0)) == 0
+    assert decode_comm_ack(encode_comm_ack(5)) == 5
+
+
+@pytest.mark.skipif(shutil.which("protoc") is None, reason="protoc not found")
+def test_codec_matches_protoc():
+    """The hand-rolled encoder must produce byte-identical output to stock
+    protoc for proto/comm.proto — the interop guarantee for regenerated
+    peers."""
+    import os
+
+    import fedml_tpu.comm as comm_pkg
+
+    proto_dir = os.path.join(os.path.dirname(comm_pkg.__file__), "proto")
+    text = 'sender: 7 payload: "abc\\x00def" wire: "pickle"'
+    out = subprocess.run(
+        ["protoc", f"-I{proto_dir}", "--encode=fedml.tpu.CommRequest",
+         os.path.join(proto_dir, "comm.proto")],
+        input=text.encode(), capture_output=True, check=True,
+    ).stdout
+    assert out == encode_comm_request(7, b"abc\x00def", "pickle")
+    assert decode_comm_request(out) == (7, b"abc\x00def", "pickle")
+
+
+@pytest.mark.parametrize("serializer", ["pickle", "json"])
+def test_grpc_manager_message_roundtrip(serializer):
+    table = {0: ("127.0.0.1", 0), 1: ("127.0.0.1", 0)}
+    m0 = GrpcCommManager(table, 0, serializer=serializer)
+    m1 = GrpcCommManager(table, 1, serializer=serializer)
+    assert m0.port > 0 and m1.port > 0
+    received = []
+
+    class Obs:
+        def receive_message(self, t, msg):
+            received.append(msg)
+            m1.stop_receive_message()
+
+    m1.add_observer(Obs())
+    t = threading.Thread(target=m1.handle_receive_message)
+    t.start()
+    msg = Message(type=9, sender_id=0, receiver_id=1)
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    msg.add(Message.MSG_ARG_KEY_MODEL_PARAMS, {"w": arr})
+    msg.add(Message.MSG_ARG_KEY_NUM_SAMPLES, 17)
+    m0.send_message(msg)
+    t.join(timeout=15)
+    assert not t.is_alive()
+    got = received[0]
+    assert got.get_type() == 9
+    assert got.get(Message.MSG_ARG_KEY_NUM_SAMPLES) == 17
+    np.testing.assert_array_equal(
+        np.asarray(got.get(Message.MSG_ARG_KEY_MODEL_PARAMS)["w"]), arr)
+    m0.close()
+    m1.close()
+
+
+@pytest.mark.slow
+def test_distributed_fedavg_over_grpc_trains():
+    """Full federation over gRPC — twin of the TCP/loopback federation
+    tests (same config/seeds), asserting the same learning outcome."""
+    from fedml_tpu.algos import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import FedML_FedAvg_distributed
+    from fedml_tpu.data.batching import batch_global, build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+    from fedml_tpu.models.lr import LogisticRegression
+
+    x, y = make_classification(240, n_features=8, n_classes=4, seed=1)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 6), batch_size=16)
+    test = batch_global(x[:64], y[:64], 16)
+    cfg = FedConfig(
+        client_num_in_total=6, client_num_per_round=3, comm_round=4,
+        epochs=2, batch_size=16, lr=0.3, frequency_of_the_test=1,
+    )
+    agg = FedML_FedAvg_distributed(
+        LogisticRegression(num_classes=4), fed, test, cfg, backend="GRPC"
+    )
+    accs = [h["accuracy"] for h in agg.test_history]
+    assert accs[-1] > 0.5
