@@ -132,8 +132,11 @@ class GrpcCommManager(BaseCommunicationManager):
 
     ``serializer``: 'pickle' (fast; TRUSTED silo peers — the reference ships
     pickled dicts over MPI the same way) or 'json' (``Message.to_json``,
-    safe for untrusted/mobile edges). Receivers auto-detect per frame from
-    the CommRequest ``wire`` field, so mixed fleets interoperate.
+    safe for untrusted/mobile edges). The receiver decodes ONLY its
+    configured format: frames whose ``wire`` field disagrees are dropped
+    with a log line. Honoring the frame's field instead would let an
+    untrusted peer force a json-configured edge into ``pickle.loads`` —
+    arbitrary code execution — defeating the point of json mode.
     """
 
     def __init__(self, ip_config: Dict[int, Tuple[str, int]], rank: int,
@@ -239,15 +242,35 @@ class GrpcCommManager(BaseCommunicationManager):
         """Blocking dispatch loop; returns after ``stop_receive_message``.
         Messages are handed off from the rpc thread through a queue so
         observer callbacks run on this (caller's) thread, like every other
-        backend — handlers may block without stalling the gRPC server."""
+        backend — handlers may block without stalling the gRPC server.
+
+        Malformed frames are logged and dropped, not fatal: the gRPC
+        server acks before this loop decodes, so letting a decode error
+        kill the loop would hang the federation silently while senders
+        keep seeing success."""
+        import logging
+
+        log = logging.getLogger(__name__)
         self._running = True
         while self._running:
             try:
                 frame = self._queue.get(timeout=0.2)
             except queue.Empty:
                 continue
-            _, payload, wire = decode_comm_request(frame)
-            msg = deserialize_message(payload, wire)
+            try:
+                _, payload, wire = decode_comm_request(frame)
+                if wire != self._serializer:
+                    log.warning(
+                        "rank %d: dropping frame with wire format %r "
+                        "(this manager is configured for %r)",
+                        self.rank, wire, self._serializer)
+                    continue
+                msg = deserialize_message(payload, self._serializer)
+            except Exception:
+                log.exception(
+                    "rank %d: dropping undecodable frame (%d bytes)",
+                    self.rank, len(frame))
+                continue
             for obs in list(self._observers):
                 obs.receive_message(msg.get_type(), msg)
 

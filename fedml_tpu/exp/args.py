@@ -67,6 +67,10 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --run_dir")
     p.add_argument("--wandb_project", type=str, default=None)
+    p.add_argument("--sweep_pipe", type=str, default=None,
+                   help="named pipe to post a completion line to when the "
+                        "run finishes (sweep orchestrator handshake, "
+                        "reference fedavg/utils.py:19-27)")
     p.add_argument("--synthetic_samples", type=int, default=0,
                    help="override the synthetic-fallback dataset size "
                         "(zero-egress runs); 0 = loader default")

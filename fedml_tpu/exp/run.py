@@ -154,6 +154,11 @@ def run(args, algorithm: str = "FedAvg"):
         if ckpt_mgr is not None:
             ckpt_mgr.close()
         logger.close()
+    if getattr(args, "sweep_pipe", None):
+        from fedml_tpu.utils import post_complete_message_to_sweep_process
+
+        post_complete_message_to_sweep_process(vars(args),
+                                               pipe_path=args.sweep_pipe)
     return api, history
 
 

@@ -381,3 +381,40 @@ def darts_genotype(genotype: Genotype, num_classes: int = 10, c: int = 16,
     )
     return GenotypeNetwork(genotype=genotype, num_classes=num_classes, c=c,
                            layers=layers, norm=norm)
+
+
+def genotype_to_dot(genotype: Genotype, which: str = "normal",
+                    name: str = "cell") -> str:
+    """Render one cell of a genotype as Graphviz DOT text.
+
+    Role parity with the reference's darts visualizer (model/cv/darts/
+    visualize.py), which shells out to the ``graphviz`` package; emitting
+    DOT text keeps the framework dependency-free — pipe the string to any
+    ``dot -Tpdf`` to get the same drawing. Nodes: the two input states
+    ``c_{k-2}``/``c_{k-1}``, the intermediate steps, and ``c_{k}``; one
+    labeled edge per (op, src) genotype entry; concat edges into ``c_{k}``.
+    """
+    if which not in ("normal", "reduce"):
+        raise ValueError(f"which must be 'normal' or 'reduce', got {which!r}")
+    edges = getattr(genotype, which)
+    concat = getattr(genotype, f"{which}_concat")
+    steps = len(edges) // 2
+
+    def node(i: int) -> str:
+        return {0: '"c_{k-2}"', 1: '"c_{k-1}"'}.get(i, f'"{i - 2}"')
+
+    lines = [
+        f'digraph "{name}_{which}" {{',
+        "  rankdir=LR;",
+        '  node [shape=box style=rounded];',
+        '  "c_{k-2}" [shape=oval];',
+        '  "c_{k-1}" [shape=oval];',
+        '  "c_{k}" [shape=oval];',
+    ]
+    for step in range(steps):
+        for op, src in edges[2 * step: 2 * step + 2]:
+            lines.append(f'  {node(src)} -> "{step}" [label="{op}"];')
+    for src in concat:
+        lines.append(f'  {node(src)} -> "c_{{k}}";')
+    lines.append("}")
+    return "\n".join(lines)

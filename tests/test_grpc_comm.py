@@ -101,3 +101,40 @@ def test_distributed_fedavg_over_grpc_trains():
     )
     accs = [h["accuracy"] for h in agg.test_history]
     assert accs[-1] > 0.5
+
+
+def test_receiver_drops_mismatched_and_malformed_frames():
+    """A json-configured manager must never unpickle a frame claiming
+    wire=pickle (hostile-peer RCE vector), and undecodable frames must not
+    kill the dispatch loop — later valid messages still arrive."""
+    import pickle
+
+    table = {0: ("127.0.0.1", 0), 1: ("127.0.0.1", 0)}
+    m0 = GrpcCommManager(table, 0, serializer="json")
+    m1 = GrpcCommManager(table, 1, serializer="json")
+    received = []
+
+    class Obs:
+        def receive_message(self, t, msg):
+            received.append(msg)
+            m1.stop_receive_message()
+
+    m1.add_observer(Obs())
+    t = threading.Thread(target=m1.handle_receive_message)
+    t.start()
+
+    call = m0._stub(1)
+    # wire says pickle on a json-configured receiver → dropped, not loaded.
+    hostile = encode_comm_request(0, pickle.dumps({"x": 1}), "pickle")
+    call(hostile, timeout=30.0)
+    # truncated garbage → dropped, loop survives.
+    call(b"\x12\x03ab", timeout=30.0)
+
+    good = Message(type=3, sender_id=0, receiver_id=1)
+    good.add(Message.MSG_ARG_KEY_NUM_SAMPLES, 5)
+    m0.send_message(good)
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert len(received) == 1 and received[0].get_type() == 3
+    m0.close()
+    m1.close()

@@ -269,3 +269,30 @@ def test_genotype_network_search_to_retrain_pipeline():
         upd, state = opt.update(g, state)
         p = optax.apply_updates(p, upd)
     assert float(loss_fn(p)) < l0
+
+
+def test_genotype_to_dot():
+    """DOT text for a searched cell: every (op, src) edge appears, concat
+    feeds c_{k}, and the digraph is structurally well-formed."""
+    from fedml_tpu.models.darts import Genotype, genotype_to_dot
+
+    g = Genotype(
+        normal=(("sep_conv_3x3", 0), ("skip_connect", 1),
+                ("max_pool_3x3", 1), ("sep_conv_3x3", 2)),
+        normal_concat=(2, 3),
+        reduce=(("dil_conv_3x3", 0), ("avg_pool_3x3", 1),
+                ("skip_connect", 0), ("sep_conv_5x5", 2)),
+        reduce_concat=(2, 3),
+    )
+    dot = genotype_to_dot(g, "normal")
+    assert dot.startswith('digraph "cell_normal" {') and dot.endswith("}")
+    assert '"c_{k-2}" -> "0" [label="sep_conv_3x3"];' in dot
+    assert '"c_{k-1}" -> "1" [label="max_pool_3x3"];' in dot
+    assert '"0" -> "1" [label="sep_conv_3x3"];' in dot  # src 2 = step 0
+    assert dot.count('-> "c_{k}"') == 2
+    red = genotype_to_dot(g, "reduce")
+    assert '[label="dil_conv_3x3"]' in red
+    import pytest
+
+    with pytest.raises(ValueError):
+        genotype_to_dot(g, "both")

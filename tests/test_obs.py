@@ -121,3 +121,35 @@ def test_model_cost_analysis():
     assert cost["flops"] >= 8 * 16 * 4 * 2  # at least the matmul
     s = flops_str(cost)
     assert "M params" in s
+
+
+def test_post_complete_message_fifo(tmp_path):
+    """Reader attached → the completion line arrives; no reader →
+    returns without blocking (the reference's blocking open would hang)."""
+    import os
+    import threading
+
+    from fedml_tpu.utils import post_complete_message_to_sweep_process
+
+    pipe = str(tmp_path / "sweep_fifo")
+    os.mkfifo(pipe)
+    got = []
+
+    def reader():
+        with open(pipe) as f:
+            got.append(f.readline())
+
+    t = threading.Thread(target=reader)
+    t.start()
+    # Give the reader a moment to block on open() so the writer sees it.
+    import time
+
+    time.sleep(0.2)
+    post_complete_message_to_sweep_process({"model": "lr"}, pipe_path=pipe)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert "finished" in got[0]
+
+    # No reader: must not hang, must not raise.
+    post_complete_message_to_sweep_process(
+        {"model": "lr"}, pipe_path=str(tmp_path / "sub" / "nobody"))
