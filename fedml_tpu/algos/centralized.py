@@ -15,7 +15,7 @@ import numpy as np
 from fedml_tpu.trainer.local import (
     make_client_optimizer,
     make_eval_fn,
-    make_local_train_fn,
+    make_local_train_fn_from_cfg,
     model_fns,
     softmax_ce,
 )
@@ -27,8 +27,7 @@ class CentralizedTrainer:
         self.fns = model_fns(model)
         optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
         self.train_fn = jax.jit(
-            make_local_train_fn(self.fns.apply, optimizer, cfg.epochs, loss_fn,
-                                remat=cfg.remat)
+            make_local_train_fn_from_cfg(self.fns.apply, optimizer, cfg, loss_fn)
         )
         self.eval_fn = jax.jit(make_eval_fn(self.fns.apply, loss_fn))
         self.rng, init_rng = jax.random.split(jax.random.PRNGKey(cfg.seed))

@@ -44,3 +44,10 @@ class FedConfig:
     # Rematerialize forward activations during backprop (jax.checkpoint):
     # trades ~1.3x FLOPs for depth-independent peak HBM.
     remat: bool = False
+    # Example-level DP-SGD on clients (new capability — the reference only
+    # has server-side weak DP, robust_aggregation.py:49-53): per-example
+    # gradient clipping at this L2 norm (0 disables) and Gaussian noise of
+    # std dp_noise_multiplier * dp_clip added to each summed batch gradient.
+    # Account the privacy cost with fedml_tpu.core.privacy.PrivacyAccountant.
+    dp_clip: float = 0.0
+    dp_noise_multiplier: float = 0.0

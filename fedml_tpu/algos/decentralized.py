@@ -30,7 +30,7 @@ from fedml_tpu.parallel.shard import client_rngs
 from fedml_tpu.trainer.local import (
     make_client_optimizer,
     make_eval_fn,
-    make_local_train_fn,
+    make_local_train_fn_from_cfg,
     model_fns,
     softmax_ce,
 )
@@ -81,8 +81,8 @@ class DecentralizedAPI(FederatedLoop):
         )
 
         optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
-        local_train = make_local_train_fn(self.fns.apply, optimizer, cfg.epochs,
-                                          loss_fn, remat=cfg.remat)
+        local_train = make_local_train_fn_from_cfg(self.fns.apply, optimizer,
+                                                   cfg, loss_fn)
 
         def mix(stacked):
             return jax.tree.map(

@@ -25,7 +25,7 @@ from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
 from fedml_tpu.trainer.local import (
     make_client_optimizer,
     make_eval_fn,
-    make_local_train_fn,
+    make_local_train_fn_from_cfg,
     model_fns,
     softmax_ce,
 )
@@ -125,8 +125,8 @@ class FedAvgAPI(FederatedLoop):
 
     # --- hooks subclasses override (FedOpt/FedProx/...) -------------------
     def _build_local_train(self, optimizer, loss_fn):
-        return make_local_train_fn(self.fns.apply, optimizer, self.cfg.epochs,
-                                   loss_fn, remat=self.cfg.remat)
+        return make_local_train_fn_from_cfg(self.fns.apply, optimizer,
+                                            self.cfg, loss_fn)
 
     def _server_update(self, old_net, avg_net):
         """FedAvg: the new global model is the client average."""

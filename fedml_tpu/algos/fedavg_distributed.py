@@ -31,7 +31,7 @@ from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.trainer.local import (
     make_client_optimizer,
     make_eval_fn,
-    make_local_train_fn,
+    make_local_train_fn_from_cfg,
     model_fns,
     softmax_ce,
 )
@@ -226,8 +226,7 @@ def FedML_FedAvg_distributed(
     net0 = fns.init(jax.random.PRNGKey(cfg.seed), sample_x)
     optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
     local_train = jax.jit(
-        make_local_train_fn(fns.apply, optimizer, cfg.epochs, loss_fn=loss_fn,
-                            remat=cfg.remat)
+        make_local_train_fn_from_cfg(fns.apply, optimizer, cfg, loss_fn=loss_fn)
     )
     eval_fn = jax.jit(make_eval_fn(fns.apply, loss_fn=loss_fn)) if test_global else None
 

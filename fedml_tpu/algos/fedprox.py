@@ -14,7 +14,7 @@ from __future__ import annotations
 import jax
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
-from fedml_tpu.trainer.local import make_local_train_fn
+from fedml_tpu.trainer.local import make_local_train_fn_from_cfg
 
 
 class FedProxAPI(FedAvgAPI):
@@ -24,11 +24,10 @@ class FedProxAPI(FedAvgAPI):
         def prox_grad(params, global_params):
             return jax.tree.map(lambda p, g: mu * (p - g), params, global_params)
 
-        return make_local_train_fn(
+        return make_local_train_fn_from_cfg(
             self.fns.apply,
             optimizer,
-            self.cfg.epochs,
+            self.cfg,
             loss_fn,
             extra_grad_fn=prox_grad if mu > 0 else None,
-            remat=self.cfg.remat,
         )

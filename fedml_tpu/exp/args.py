@@ -67,6 +67,11 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --run_dir")
     p.add_argument("--wandb_project", type=str, default=None)
+    p.add_argument("--dp_clip", type=float, default=0.0,
+                   help="example-level DP-SGD: per-example grad L2 clip "
+                        "(0 disables DP)")
+    p.add_argument("--dp_noise_multiplier", type=float, default=0.0,
+                   help="DP-SGD Gaussian noise std = multiplier * dp_clip")
     p.add_argument("--sweep_pipe", type=str, default=None,
                    help="named pipe to post a completion line to when the "
                         "run finishes (sweep orchestrator handshake, "
@@ -110,4 +115,6 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         lr_decay_rate=args.lr_decay_rate,
         grad_clip=args.grad_clip,
         remat=args.remat,
+        dp_clip=args.dp_clip,
+        dp_noise_multiplier=args.dp_noise_multiplier,
     )

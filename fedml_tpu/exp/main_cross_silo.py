@@ -38,7 +38,7 @@ from fedml_tpu.exp.args import add_args
 from fedml_tpu.trainer.local import (
     make_client_optimizer,
     make_eval_fn,
-    make_local_train_fn,
+    make_local_train_fn_from_cfg,
     model_fns,
     softmax_ce,
 )
@@ -109,9 +109,8 @@ def main(argv=None):
     else:
         optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd,
                                           cfg.grad_clip)
-        local_train = jax.jit(make_local_train_fn(
-            fns.apply, optimizer, cfg.epochs, loss_fn=softmax_ce,
-            remat=cfg.remat))
+        local_train = jax.jit(make_local_train_fn_from_cfg(
+            fns.apply, optimizer, cfg, loss_fn=softmax_ce))
         client = FedAVGClientManager(net_args, args.rank, args.size, arrays,
                                      local_train, cfg, backend=args.comm_backend)
         client.run()

@@ -101,8 +101,11 @@ class ModelTrainer(abc.ABC):
         )
         epochs = getattr(args, "epochs", 1)
         self._local = jax.jit(
-            make_local_train_fn(self.fns.apply, opt, epochs, loss_fn,
-                                remat=getattr(args, "remat", False)))
+            make_local_train_fn(
+                self.fns.apply, opt, epochs, loss_fn,
+                remat=getattr(args, "remat", False),
+                dp_clip=getattr(args, "dp_clip", 0.0),
+                dp_noise_multiplier=getattr(args, "dp_noise_multiplier", 0.0)))
         self._eval = jax.jit(make_eval_fn(self.fns.apply, loss_fn, pad_id=pad_id))
         self._rng = jax.random.PRNGKey(getattr(args, "seed", 0) + self.id)
 

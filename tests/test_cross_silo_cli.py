@@ -15,9 +15,11 @@ def test_cross_silo_three_processes(tmp_path):
            "PALLAS_AXON_POOL_IPS": "",
            "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    # pid-derived base so concurrent suite runs don't fight over rank ports
+    port_base = 42000 + (os.getpid() % 4000) * 4
     common = [
         sys.executable, "-m", "fedml_tpu.exp.main_cross_silo",
-        "--size", "3", "--port_base", "47310",
+        "--size", "3", "--port_base", str(port_base),
         "--model", "lr", "--dataset", "synthetic_1_1",
         "--client_num_in_total", "6", "--batch_size", "8",
         "--comm_round", "3", "--epochs", "1", "--lr", "0.2",
