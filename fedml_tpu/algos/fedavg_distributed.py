@@ -25,8 +25,9 @@ from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.comm.loopback import LoopbackNetwork, run_workers
 from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message
+from fedml_tpu.core.compression import make_compressor, tree_spec
 from fedml_tpu.core.sampling import sample_clients
-from fedml_tpu.core.tree import tree_scale, tree_add
+from fedml_tpu.core.tree import tree_scale, tree_add, tree_sub
 from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.trainer.local import (
     make_client_optimizer,
@@ -103,11 +104,17 @@ class FedAVGAggregator:
 
 class FedAVGServerManager(ServerManager):
     def __init__(self, args, aggregator: FedAVGAggregator, cfg: FedConfig,
-                 size: int, backend: str = "LOOPBACK"):
+                 size: int, backend: str = "LOOPBACK", compress: str = "none"):
         super().__init__(args, rank=0, size=size, backend=backend)
         self.aggregator = aggregator
         self.cfg = cfg
         self.round_idx = 0
+        self._decoders = {}  # codec name → compressor (built lazily)
+        self._spec = tree_spec(aggregator.net)
+        # The net broadcast this round — compressed uploads are deltas
+        # against it, so reconstruction must use the same anchor.
+        self._broadcast_net = aggregator.net
+        del compress  # server decodes by each frame's self-described codec
 
     def run(self) -> None:
         self.register_message_receive_handlers()
@@ -130,12 +137,23 @@ class FedAVGServerManager(ServerManager):
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
+        payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        codec = msg.get("compression")
+        if codec:
+            # Dispatch on the frame's self-described codec, not a server
+            # flag: per-rank launches may configure compression on the
+            # clients only, and ranks could even mix schemes.
+            if codec not in self._decoders:
+                self._decoders[codec] = make_compressor(codec)
+            delta = self._decoders[codec].decode(payload, self._spec)
+            payload = tree_add(self._broadcast_net, delta)
         self.aggregator.add_local_trained_result(
-            sender - 1, msg.get(MSG_ARG_KEY_MODEL_PARAMS), msg.get(MSG_ARG_KEY_NUM_SAMPLES)
+            sender - 1, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES)
         )
         if not self.aggregator.check_whether_all_receive():
             return
         global_net = self.aggregator.aggregate()
+        self._broadcast_net = global_net
         if (
             self.round_idx % self.cfg.frequency_of_the_test == 0
             or self.round_idx == self.cfg.comm_round - 1
@@ -164,12 +182,20 @@ class FedAVGClientManager(ClientManager):
     (FedAvgClientManager.py:34-79)."""
 
     def __init__(self, args, rank: int, size: int, train_fed: FederatedArrays,
-                 local_train, cfg: FedConfig, backend: str = "LOOPBACK"):
+                 local_train, cfg: FedConfig, backend: str = "LOOPBACK",
+                 compress: str = "none"):
         super().__init__(args, rank=rank, size=size, backend=backend)
         self.train_fed = train_fed
         self.local_train = local_train
         self.cfg = cfg
         self.round_idx = 0
+        self._compressor = make_compressor(compress)
+        # Top-k error-feedback residuals, keyed by CLIENT index: a rank
+        # trains a different sampled client each round, and EF theory
+        # requires the residual to stay with its own data stream — mixing
+        # one client's untransmitted signal into another's update would
+        # bias the weighted average.
+        self._ef_state: Dict[int, object] = {}
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -202,9 +228,21 @@ class FedAVGClientManager(ClientManager):
             rng,
         )
         out = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
-        out.add(MSG_ARG_KEY_MODEL_PARAMS, jax.device_get(net))
+        if self._compressor.name != "none":
+            delta = tree_sub(net, global_net)
+            rng_c = jax.random.fold_in(rng, 0xC0)
+            payload, self._ef_state[c] = self._compressor.encode(
+                delta, self._ef_state.get(c), rng_c)
+            out.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
+            out.add("compression", self._compressor.name)
+        else:
+            out.add(MSG_ARG_KEY_MODEL_PARAMS, jax.device_get(net))
         out.add(MSG_ARG_KEY_NUM_SAMPLES, int(self.train_fed.counts[c]))
-        out.add("train_loss", float(loss))
+        if not (self.cfg.dp_clip and self.cfg.dp_clip > 0):
+            # Under DP-SGD the exact train loss is an un-noised function of
+            # the private examples; releasing it would void the accounted
+            # (eps, delta). Only the noised model leaves the silo.
+            out.add("train_loss", float(loss))
         self.send_message(out)
 
 
@@ -215,10 +253,15 @@ def FedML_FedAvg_distributed(
     cfg: FedConfig,
     backend: str = "LOOPBACK",
     loss_fn=softmax_ce,
+    compress: str = "none",
 ):
     """Build server + ``client_num_per_round`` workers on the chosen backend
     and run the full federation (FedAvgAPI.py:20 analogue). Returns the
-    aggregator (global model + test history)."""
+    aggregator (global model + test history).
+
+    ``compress``: update compression for the client→server uploads —
+    ``none`` | ``topk<ratio>`` (error feedback) | ``q<bits>`` (stochastic
+    quantization); see fedml_tpu.core.compression."""
     worker_num = cfg.client_num_per_round
     size = worker_num + 1
     fns = model_fns(model)
@@ -242,10 +285,11 @@ def FedML_FedAvg_distributed(
         # pass an explicit host_table / grpc_ipconfig.csv instead.
         args.host_table = {r: ("127.0.0.1", 0) for r in range(size)}
     aggregator = FedAVGAggregator(net0, worker_num, cfg, eval_fn, test_global)
-    server = FedAVGServerManager(args, aggregator, cfg, size, backend=backend)
+    server = FedAVGServerManager(args, aggregator, cfg, size, backend=backend,
+                                 compress=compress)
     clients = [
         FedAVGClientManager(args, rank, size, train_fed, local_train, cfg,
-                            backend=backend)
+                            backend=backend, compress=compress)
         for rank in range(1, size)
     ]
     run_workers([server.run] + [c.run for c in clients])

@@ -1,0 +1,202 @@
+"""Update compression for cross-silo communication.
+
+New capability relative to the reference, which ships full pickled
+state_dicts over MPI/gRPC every round (mpi_send_thread.py:27,
+grpc_comm_manager.py:54 — and raises the gRPC cap to 1000 MB to make the
+full payloads fit). Two standard schemes, both jit-able on device so the
+TPU compresses before anything crosses the PCIe/DCN boundary:
+
+- **Top-k sparsification with error feedback** (Deep Gradient Compression /
+  EF-SGD): send only the k largest-|.|-entries of the flattened update,
+  carry the residual forward in a client-local accumulator so the error is
+  corrected on later rounds rather than lost.
+- **Stochastic uniform quantization** (QSGD-style): map each entry to
+  ``2^bits`` levels with stochastic rounding, so the quantizer is
+  unbiased: ``E[deq(q(x))] = x``. The codec quantizes **per leaf** (one
+  scale per tensor) — a single global scale would flush small-magnitude
+  layers to zero at low bit widths with no error feedback to recover them.
+
+Top-k operates on the flattened update vector (``tree_to_vector`` /
+``vector_to_tree``); the wire payload is one values ndarray + int32
+indices — ``k * (4 + 4)`` bytes instead of ``4 * n``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TreeSpec(NamedTuple):
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    sizes: Tuple[int, ...]
+
+
+def tree_spec(tree) -> TreeSpec:
+    leaves, treedef = jax.tree.flatten(tree)
+    return TreeSpec(
+        treedef,
+        tuple(l.shape for l in leaves),
+        tuple(l.dtype for l in leaves),
+        tuple(int(np.prod(l.shape)) if l.shape else 1 for l in leaves),
+    )
+
+
+def tree_to_vector(tree) -> jnp.ndarray:
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((0,), jnp.float32)
+    return jnp.concatenate(
+        [jnp.ravel(l).astype(jnp.float32) for l in leaves])
+
+
+def vector_to_tree(vec, spec: TreeSpec):
+    out, off = [], 0
+    for shape, dtype, size in zip(spec.shapes, spec.dtypes, spec.sizes):
+        out.append(jnp.reshape(vec[off:off + size], shape).astype(dtype))
+        off += size
+    return jax.tree.unflatten(spec.treedef, out)
+
+
+# --------------------------------------------------------------------------
+# Top-k sparsification with error feedback
+
+
+def topk_compress(vec, k: int):
+    """Keep the k largest-magnitude entries: returns (values[k], idx[k],
+    residual) where residual = vec - scatter(values) is the error-feedback
+    carry for the next round."""
+    k = max(1, min(int(k), vec.shape[0]))
+    _, idx = jax.lax.top_k(jnp.abs(vec), k)
+    values = vec[idx]
+    residual = vec.at[idx].set(0.0)
+    return values, idx, residual
+
+
+def topk_decompress(values, idx, n: int):
+    return jnp.zeros((n,), values.dtype).at[idx].set(values)
+
+
+# --------------------------------------------------------------------------
+# Stochastic uniform quantization (unbiased)
+
+
+def _check_bits(bits: int) -> None:
+    if not 2 <= bits <= 16:
+        raise ValueError(f"bits must be in [2, 16], got {bits}")
+
+
+def quantize_stochastic(vec, bits: int, rng):
+    """Symmetric uniform quantizer over one tensor with stochastic
+    rounding. Returns (int levels in [-L, L] as int8/int16, fp32 scale)."""
+    _check_bits(bits)
+    levels = (1 << (bits - 1)) - 1  # e.g. 127 for 8 bits
+    scale = jnp.maximum(jnp.max(jnp.abs(vec)), 1e-12) / levels
+    scaled = vec / scale
+    low = jnp.floor(scaled)
+    p_up = scaled - low  # P(round up) = fractional part → unbiased
+    up = jax.random.bernoulli(rng, p_up).astype(jnp.float32)
+    q = jnp.clip(low + up, -levels, levels)
+    dtype = jnp.int8 if bits <= 8 else jnp.int16
+    return q.astype(dtype), scale
+
+
+def dequantize(q, scale):
+    return q.astype(jnp.float32) * scale
+
+
+# jit wrappers hoisted to module level: constructing jax.jit inside
+# encode() would discard the trace cache and re-trace every round.
+_topk_jit = jax.jit(topk_compress, static_argnums=1)
+_quantize_jit = jax.jit(quantize_stochastic, static_argnums=1)
+
+
+# --------------------------------------------------------------------------
+# Codec objects the cross-silo managers plug in (host-side frame shaping;
+# the math above runs jitted on device).
+
+
+class NoCompression:
+    name = "none"
+
+    def encode(self, update_tree, state, rng):
+        return update_tree, state
+
+    def decode(self, payload, spec: TreeSpec):
+        return payload
+
+
+class TopKCompression:
+    """``ratio`` = fraction of entries kept (e.g. 0.01 → 100x sparser).
+    ``state`` is the client's error-feedback residual vector (or None)."""
+
+    def __init__(self, ratio: float):
+        if not 0 < ratio <= 1:
+            raise ValueError(f"ratio must be in (0, 1], got {ratio}")
+        self.ratio = ratio
+        self.name = f"topk{ratio}"
+
+    def encode(self, update_tree, state, rng):
+        vec = tree_to_vector(update_tree)
+        if state is not None:
+            vec = vec + state
+        k = max(1, int(round(self.ratio * vec.shape[0])))
+        values, idx, residual = _topk_jit(vec, k)
+        payload = {
+            "kind": "topk",
+            "n": int(vec.shape[0]),
+            "values": np.asarray(values),
+            "idx": np.asarray(idx),
+        }
+        return payload, residual
+
+    def decode(self, payload, spec: TreeSpec):
+        vec = topk_decompress(
+            jnp.asarray(payload["values"]), jnp.asarray(payload["idx"]),
+            payload["n"])
+        return vector_to_tree(vec, spec)
+
+
+class QuantizeCompression:
+    """QSGD-style ``bits``-bit stochastic quantization, one scale per leaf
+    tensor (stateless)."""
+
+    def __init__(self, bits: int):
+        _check_bits(int(bits))  # fail at construction, not first upload
+        self.bits = int(bits)
+        self.name = f"q{bits}"
+
+    def encode(self, update_tree, state, rng):
+        leaves = jax.tree.leaves(update_tree)
+        qs, scales = [], []
+        for leaf, key in zip(leaves, jax.random.split(rng, max(len(leaves), 1))):
+            q, scale = _quantize_jit(
+                jnp.ravel(leaf).astype(jnp.float32), self.bits, key)
+            qs.append(np.asarray(q))
+            scales.append(float(scale))
+        payload = {"kind": "quant", "qs": qs, "scales": scales}
+        return payload, state
+
+    def decode(self, payload, spec: TreeSpec):
+        vec = jnp.concatenate([
+            dequantize(jnp.asarray(q), s)
+            for q, s in zip(payload["qs"], payload["scales"])
+        ]) if payload["qs"] else jnp.zeros((0,), jnp.float32)
+        return vector_to_tree(vec, spec)
+
+
+def make_compressor(name: str):
+    """``none`` | ``topk<ratio>`` (e.g. topk0.05) | ``q<bits>`` (e.g. q8)."""
+    if name in (None, "", "none"):
+        return NoCompression()
+    if name.startswith("topk"):
+        return TopKCompression(float(name[4:]))
+    if name.startswith("q"):
+        return QuantizeCompression(int(name[1:]))
+    raise ValueError(
+        f"unknown compressor {name!r}; use none | topk<ratio> | q<bits>")

@@ -66,6 +66,10 @@ def main(argv=None):
                         choices=["TCP", "GRPC"],
                         help="cross-silo transport: native C++ msgnet TCP "
                              "or grpcio (proto/comm.proto wire)")
+    parser.add_argument("--compress", type=str, default="none",
+                        help="client->server update compression: none | "
+                             "topk<ratio> (error feedback) | q<bits> "
+                             "(stochastic quantization)")
     add_args(parser)
     args = parser.parse_args(argv)
     if not 0 <= args.rank < args.size:
@@ -102,7 +106,8 @@ def main(argv=None):
         eval_fn = jax.jit(make_eval_fn(fns.apply)) if test is not None else None
         aggregator = FedAVGAggregator(net0, worker_num, cfg, eval_fn, test)
         server = FedAVGServerManager(net_args, aggregator, cfg, args.size,
-                                     backend=args.comm_backend)
+                                     backend=args.comm_backend,
+                                     compress=args.compress)
         server.run()
         final = aggregator.test_history[-1] if aggregator.test_history else {}
         print(json.dumps({"rank": 0, **final}))
@@ -112,7 +117,9 @@ def main(argv=None):
         local_train = jax.jit(make_local_train_fn_from_cfg(
             fns.apply, optimizer, cfg, loss_fn=softmax_ce))
         client = FedAVGClientManager(net_args, args.rank, args.size, arrays,
-                                     local_train, cfg, backend=args.comm_backend)
+                                     local_train, cfg,
+                                     backend=args.comm_backend,
+                                     compress=args.compress)
         client.run()
         print(json.dumps({"rank": args.rank, "status": "done"}))
 
