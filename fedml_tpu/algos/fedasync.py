@@ -1,0 +1,229 @@
+"""Asynchronous federated learning (FedAsync-style) over the comm layer.
+
+New capability: the reference's server blocks until EVERY sampled worker
+has uploaded before it aggregates (check_whether_all_receive,
+fedml_api/distributed/fedavg/FedAVGAggregator.py:50-57), so one straggler
+stalls the round for the whole fleet. Here the server updates the global
+model on EVERY arrival (Xie et al. 2019, "Asynchronous Federated
+Optimization"):
+
+    alpha_eff = alpha / (1 + staleness)^a
+    global <- (1 - alpha_eff) * global + alpha_eff * client_net
+
+where staleness = server_version - version_the_client_trained_on. Each
+worker gets the fresh global back immediately and keeps training — no
+barrier, no idle time. With one worker (or zero staleness and alpha = 1)
+this degenerates to sequential SGD on shuffled client shards.
+
+Message flow per worker is strictly request/response (upload -> new model
+or done), which makes shutdown deterministic: the server answers every
+in-flight upload, so no rank can block on a model that never comes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg_distributed import (
+    MSG_ARG_KEY_CLIENT_INDEX,
+    MSG_ARG_KEY_MODEL_PARAMS,
+    MSG_ARG_KEY_NUM_SAMPLES,
+    MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+    MSG_TYPE_S2C_INIT_CONFIG,
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+)
+from fedml_tpu.comm.loopback import LoopbackNetwork, run_workers
+from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.data.batching import FederatedArrays
+from fedml_tpu.trainer.local import (
+    make_client_optimizer,
+    make_eval_fn,
+    make_local_train_fn_from_cfg,
+    model_fns,
+    softmax_ce,
+)
+
+MSG_ARG_KEY_MODEL_VERSION = "model_version"
+
+
+def staleness_weight(alpha: float, staleness: int, a: float = 0.5) -> float:
+    """Polynomial staleness discount: alpha / (1 + s)^a."""
+    return alpha / float((1 + max(staleness, 0)) ** a)
+
+
+class FedAsyncServerManager(ServerManager):
+    """Mixes every arriving model into the global immediately; the model
+    version counts server updates (the async analogue of the round index).
+    """
+
+    def __init__(self, args, net, cfg: FedConfig, size: int,
+                 backend: str = "LOOPBACK", alpha: float = 0.6,
+                 staleness_exp: float = 0.5, eval_fn=None, test_data=None):
+        super().__init__(args, rank=0, size=size, backend=backend)
+        self.net = net
+        self.cfg = cfg
+        self.alpha = alpha
+        self.staleness_exp = staleness_exp
+        self.eval_fn = eval_fn
+        self.test_data = test_data
+        self.version = 0
+        self.done_workers = 0
+        self.staleness_history: List[int] = []
+        self.test_history: List[dict] = []
+        self._mix = jax.jit(
+            lambda g, c, w: jax.tree.map(
+                lambda a_, b_: ((1.0 - w) * a_.astype(jnp.float32)
+                                + w * b_.astype(jnp.float32)).astype(a_.dtype),
+                g, c))
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.send_init_msg()
+        self.com_manager.handle_receive_message()
+
+    def _assign_client(self, worker: int) -> int:
+        """Deterministic per-(version, worker) client assignment — the
+        async analogue of the reference's seeded per-round sampling."""
+        idx = sample_clients(self.version, self.cfg.client_num_in_total,
+                             min(self.size - 1, self.cfg.client_num_in_total))
+        return int(idx[(worker - 1) % len(idx)])
+
+    def send_init_msg(self) -> None:
+        for worker in range(1, self.size):
+            msg = Message(MSG_TYPE_S2C_INIT_CONFIG, 0, worker)
+            msg.add(MSG_ARG_KEY_MODEL_PARAMS, self.net)
+            msg.add(MSG_ARG_KEY_CLIENT_INDEX, self._assign_client(worker))
+            msg.add(MSG_ARG_KEY_MODEL_VERSION, 0)
+            self.send_message(msg)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.handle_upload)
+
+    def handle_upload(self, msg: Message) -> None:
+        worker = msg.get_sender_id()
+        if self.version >= self.cfg.comm_round:
+            # Target version reached while this upload was in flight:
+            # discard it (mixing would overshoot comm_round) and release
+            # the worker.
+            out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
+            out.add("done", True)
+            self.send_message(out)
+            self.done_workers += 1
+            if self.done_workers == self.size - 1:
+                self.finish()
+            return
+        staleness = self.version - int(msg.get(MSG_ARG_KEY_MODEL_VERSION))
+        w = staleness_weight(self.alpha, staleness, self.staleness_exp)
+        self.net = self._mix(self.net, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
+                             jnp.float32(w))
+        self.version += 1
+        self.staleness_history.append(staleness)
+        if (self.eval_fn is not None and self.test_data is not None and
+                (self.version % self.cfg.frequency_of_the_test == 0
+                 or self.version >= self.cfg.comm_round)):
+            m = self.eval_fn(self.net, *self.test_data)
+            self.test_history.append(
+                {"version": self.version, "staleness": staleness,
+                 **{k: float(v) for k, v in m.items()}})
+        out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
+        if self.version >= self.cfg.comm_round:
+            out.add("done", True)
+            self.send_message(out)
+            self.done_workers += 1
+            if self.done_workers == self.size - 1:
+                self.finish()
+            return
+        out.add("done", False)
+        out.add(MSG_ARG_KEY_MODEL_PARAMS, self.net)
+        out.add(MSG_ARG_KEY_CLIENT_INDEX, self._assign_client(worker))
+        out.add(MSG_ARG_KEY_MODEL_VERSION, self.version)
+        self.send_message(out)
+
+
+class FedAsyncClientManager(ClientManager):
+    """Train on the latest received model, upload tagged with the model
+    version it was based on, wait for the next model (or done)."""
+
+    def __init__(self, args, rank: int, size: int, train_fed: FederatedArrays,
+                 local_train, cfg: FedConfig, backend: str = "LOOPBACK"):
+        super().__init__(args, rank=rank, size=size, backend=backend)
+        self.train_fed = train_fed
+        self.local_train = local_train
+        self.cfg = cfg
+        self.steps = 0
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_INIT_CONFIG, self.handle_model)
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_model)
+
+    def handle_model(self, msg: Message) -> None:
+        if msg.get("done"):
+            self.finish()
+            return
+        c = int(msg.get(MSG_ARG_KEY_CLIENT_INDEX))
+        version = int(msg.get(MSG_ARG_KEY_MODEL_VERSION))
+        rng = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), self.steps),
+            self.rank)
+        self.steps += 1
+        net, loss = self.local_train(
+            msg.get(MSG_ARG_KEY_MODEL_PARAMS),
+            self.train_fed.x[c], self.train_fed.y[c], self.train_fed.mask[c],
+            rng)
+        out = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        out.add(MSG_ARG_KEY_MODEL_PARAMS, jax.device_get(net))
+        out.add(MSG_ARG_KEY_NUM_SAMPLES, int(self.train_fed.counts[c]))
+        out.add(MSG_ARG_KEY_MODEL_VERSION, version)
+        self.send_message(out)
+
+
+def FedML_FedAsync_distributed(
+    model,
+    train_fed: FederatedArrays,
+    test_global,
+    cfg: FedConfig,
+    backend: str = "LOOPBACK",
+    loss_fn=softmax_ce,
+    alpha: float = 0.6,
+    staleness_exp: float = 0.5,
+):
+    """Run the async federation: ``cfg.comm_round`` server model updates
+    (arrivals, not barrier rounds) across ``cfg.client_num_per_round``
+    workers. Returns the server manager (net, staleness/test history)."""
+    worker_num = cfg.client_num_per_round
+    size = worker_num + 1
+    fns = model_fns(model)
+    sample_x = jnp.zeros((1,) + train_fed.x.shape[3:], train_fed.x.dtype)
+    net0 = fns.init(jax.random.PRNGKey(cfg.seed), sample_x)
+    optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
+    local_train = jax.jit(
+        make_local_train_fn_from_cfg(fns.apply, optimizer, cfg, loss_fn=loss_fn))
+    eval_fn = jax.jit(make_eval_fn(fns.apply, loss_fn=loss_fn)) if test_global else None
+
+    class Args:
+        pass
+
+    args = Args()
+    if backend == "LOOPBACK":
+        args.network = LoopbackNetwork(size)
+    elif backend in ("TCP", "GRPC"):
+        args.host_table = {r: ("127.0.0.1", 0) for r in range(size)}
+    server = FedAsyncServerManager(args, net0, cfg, size, backend=backend,
+                                   alpha=alpha, staleness_exp=staleness_exp,
+                                   eval_fn=eval_fn, test_data=test_global)
+    clients = [
+        FedAsyncClientManager(args, rank, size, train_fed, local_train, cfg,
+                              backend=backend)
+        for rank in range(1, size)
+    ]
+    run_workers([server.run] + [c.run for c in clients])
+    return server

@@ -190,12 +190,18 @@ class FedAVGClientManager(ClientManager):
         self.cfg = cfg
         self.round_idx = 0
         self._compressor = make_compressor(compress)
-        # Top-k error-feedback residuals, keyed by CLIENT index: a rank
-        # trains a different sampled client each round, and EF theory
-        # requires the residual to stay with its own data stream — mixing
-        # one client's untransmitted signal into another's update would
-        # bias the weighted average.
-        self._ef_state: Dict[int, object] = {}
+        # Top-k error-feedback residuals, keyed by CLIENT index and tagged
+        # with the round that produced them. EF theory requires the
+        # residual to stay with its own data stream, so (a) a residual is
+        # only applied when this rank trained the same client in the
+        # IMMEDIATELY previous round — a client that migrated to another
+        # rank and back would otherwise get a stale residual spike against
+        # a much-evolved model — and (b) one client's carry is never mixed
+        # into another client's update. Under full participation
+        # (worker_num == client_num_in_total) assignments are stable and
+        # EF is exact; under subsampling the carry is conservatively
+        # dropped at migrations.
+        self._ef_state: Dict[int, tuple] = {}  # client → (round, residual)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -231,8 +237,10 @@ class FedAVGClientManager(ClientManager):
         if self._compressor.name != "none":
             delta = tree_sub(net, global_net)
             rng_c = jax.random.fold_in(rng, 0xC0)
-            payload, self._ef_state[c] = self._compressor.encode(
-                delta, self._ef_state.get(c), rng_c)
+            prev = self._ef_state.get(c)
+            carry = prev[1] if prev and prev[0] == self.round_idx - 1 else None
+            payload, residual = self._compressor.encode(delta, carry, rng_c)
+            self._ef_state[c] = (self.round_idx, residual)
             out.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
             out.add("compression", self._compressor.name)
         else:

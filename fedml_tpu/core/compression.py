@@ -23,11 +23,14 @@ indices — ``k * (4 + 4)`` bytes instead of ``4 * n``.
 
 from __future__ import annotations
 
+import re
 from typing import Any, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from fedml_tpu.core.tree import tree_cast, tree_vectorize
 
 
 class TreeSpec(NamedTuple):
@@ -48,11 +51,10 @@ def tree_spec(tree) -> TreeSpec:
 
 
 def tree_to_vector(tree) -> jnp.ndarray:
-    leaves = jax.tree.leaves(tree)
-    if not leaves:
-        return jnp.zeros((0,), jnp.float32)
-    return jnp.concatenate(
-        [jnp.ravel(l).astype(jnp.float32) for l in leaves])
+    """Flatten to one fp32 vector (``core.tree.tree_vectorize`` plus the
+    cast the compression math needs)."""
+    vec = tree_vectorize(tree_cast(tree, jnp.float32))
+    return vec if vec.size else jnp.zeros((0,), jnp.float32)
 
 
 def vector_to_tree(vec, spec: TreeSpec):
@@ -177,8 +179,13 @@ class QuantizeCompression:
         for leaf, key in zip(leaves, jax.random.split(rng, max(len(leaves), 1))):
             q, scale = _quantize_jit(
                 jnp.ravel(leaf).astype(jnp.float32), self.bits, key)
-            qs.append(np.asarray(q))
-            scales.append(float(scale))
+            qs.append(q)
+            scales.append(scale)
+        # ONE device→host sync for the whole update; per-leaf float()/
+        # np.asarray() would serialize hundreds of blocking transfers on
+        # the hot communication path.
+        qs = jax.device_get(qs)
+        scales = [float(s) for s in jax.device_get(scales)]
         payload = {"kind": "quant", "qs": qs, "scales": scales}
         return payload, state
 
@@ -194,9 +201,9 @@ def make_compressor(name: str):
     """``none`` | ``topk<ratio>`` (e.g. topk0.05) | ``q<bits>`` (e.g. q8)."""
     if name in (None, "", "none"):
         return NoCompression()
-    if name.startswith("topk"):
+    if re.fullmatch(r"topk(0?\.\d+|1(\.0*)?)", name):
         return TopKCompression(float(name[4:]))
-    if name.startswith("q"):
+    if re.fullmatch(r"q\d+", name):
         return QuantizeCompression(int(name[1:]))
     raise ValueError(
         f"unknown compressor {name!r}; use none | topk<ratio> | q<bits>")

@@ -127,6 +127,19 @@ def run_decentralized(args):
     return _loop(api, cfg)
 
 
+def run_fedasync(args):
+    """Asynchronous FL (no barrier; staleness-weighted mixing) over the
+    loopback message-passing backend — new capability, fedasync.py."""
+    from fedml_tpu.algos.fedasync import FedML_FedAsync_distributed
+    from fedml_tpu.exp.setup import create_model_for
+
+    fed, arrays, test, cfg = _setup(args)
+    model = create_model_for(args, fed)
+    srv = FedML_FedAsync_distributed(model, arrays, test, cfg)
+    logging.info("fedasync staleness history: %s", srv.staleness_history)
+    return srv.test_history or [{"version": srv.version}]
+
+
 def run_base_framework(args):
     """main_base.py parity: the didactic scalar-sum message-passing demo over
     the loopback backend (local result = rank + round)."""
@@ -155,6 +168,7 @@ def _loop(api, cfg):
 
 
 RUNNERS = {
+    "FedAsync": run_fedasync,
     "FedGAN": run_fedgan,
     "FedGKT": run_fedgkt,
     "FedNAS": run_fednas,
