@@ -1,0 +1,147 @@
+"""Ditto — personalized federated learning (Li et al. 2021).
+
+New capability: the reference trains ONE global model; every client ends
+with the same weights regardless of how skewed its local distribution is.
+Ditto keeps a personal model v_k per client alongside the FedAvg global w:
+
+    w   <- FedAvg round (unchanged)
+    v_k <- v_k - lr * (grad f_k(v_k) + lam * (v_k - w))
+
+The proximal pull lam*(v_k - w) interpolates between purely-local training
+(lam = 0) and following the global model (lam -> inf), so each client
+trades personalization against federation strength.
+
+TPU design: the N personal models live as ONE client-stacked pytree
+``[N, ...]`` on device; a round gathers the sampled clients' models,
+vmaps the proximal local update (the same ``extra_grad_fn`` hook FedProx
+uses, but anchored at the GLOBAL params instead of the entry params), and
+scatters them back — no per-client Python state.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.data.batching import gather_clients
+from fedml_tpu.trainer.local import (
+    make_client_optimizer,
+    make_local_train_fn_from_cfg,
+)
+
+
+def _gather_stacked(stacked, idx):
+    return jax.tree.map(lambda p: jnp.take(p, idx, axis=0), stacked)
+
+
+def _scatter_stacked(stacked, idx, values, wmask):
+    """Write back sampled-client models. Shard padding repeats idx[0] with
+    wmask 0; routing padded slots to an out-of-bounds index with
+    ``mode='drop'`` discards those writes entirely — a gated merge would
+    leave duplicate indices in the scatter, whose write order XLA leaves
+    undefined, letting a padded slot's stale model clobber the real one."""
+
+    def put(old, new):
+        dustbin = old.shape[0]  # out of bounds → dropped
+        idx_eff = jnp.where(wmask > 0, idx, dustbin)
+        return old.at[idx_eff].set(new, mode="drop")
+
+    return jax.tree.map(put, stacked, values)
+
+
+class DittoAPI(FedAvgAPI):
+    """FedAvg for the global model + per-client personal models with a
+    proximal pull of strength ``lam`` toward the current global."""
+
+    def __init__(self, *args, lam: float = 0.1, **kw):
+        self.lam = lam
+        super().__init__(*args, **kw)
+        n = int(self.train_fed.num_clients)
+        # All personal models start from the same init as the global.
+        self.personal_nets = jax.tree.map(
+            lambda p: jnp.broadcast_to(p[None], (n,) + p.shape), self.net
+        )
+        self._personal_jit = None
+
+    def _personal_round_fn(self):
+        """vmapped proximal personal update, prox anchored at the global
+        params (``make_local_train_fn`` anchors ``extra_grad_fn`` at the
+        ENTRY params — here v_k — so the global anchor w is bound in
+        explicitly per call)."""
+        if self._personal_jit is not None:
+            return self._personal_jit
+        lam = self.lam
+        optimizer = make_client_optimizer(
+            self.cfg.client_optimizer, self.cfg.lr, self.cfg.wd,
+            self.cfg.grad_clip)
+
+        def prox(params, _entry_anchor, w_global):
+            return jax.tree.map(lambda v, w: lam * (v - w), params, w_global)
+
+        def one(v_net, w_global_params, xb, yb, mb, rng):
+            train = make_local_train_fn_from_cfg(
+                self.fns.apply, optimizer, self.cfg, self._loss_fn,
+                extra_grad_fn=partial(prox, w_global=w_global_params),
+            )
+            return train(v_net, xb, yb, mb, rng)
+
+        def rounds(personal_sub, global_params, x, y, mask, rngs):
+            return jax.vmap(one, in_axes=(0, None, 0, 0, 0, 0))(
+                personal_sub, global_params, x, y, mask, rngs)
+
+        self._personal_jit = jax.jit(rounds)
+        return self._personal_jit
+
+    def train_one_round(self, round_idx: int) -> Dict[str, float]:
+        # 1) ordinary FedAvg round for the global model
+        metrics = super().train_one_round(round_idx)
+        # 2) proximal personal updates for the sampled clients
+        idx, wmask = self.sample_round(round_idx)
+        idx = jnp.asarray(idx)
+        wmask_a = jnp.asarray(wmask, jnp.float32)
+        sub = gather_clients(self.train_fed, idx)
+        personal_sub = _gather_stacked(self.personal_nets, idx)
+        self.rng, rnd = jax.random.split(self.rng)
+        rngs = jax.vmap(lambda i: jax.random.fold_in(rnd, i))(
+            jnp.arange(idx.shape[0]))
+        trained, losses = self._personal_round_fn()(
+            personal_sub, self.net.params, sub.x, sub.y, sub.mask, rngs)
+        self.personal_nets = _scatter_stacked(
+            self.personal_nets, idx, trained, wmask_a)
+        metrics["personal_loss"] = float(
+            jnp.sum(losses * wmask_a) / jnp.maximum(jnp.sum(wmask_a), 1.0))
+        return metrics
+
+    def evaluate_personalized(self) -> Dict[str, float]:
+        """Sample-weighted mean per-client accuracy of each personal model
+        on its OWN local shard — the quantity personalization optimizes
+        (the global model's global-test eval remains ``evaluate()``)."""
+        f = self.train_fed
+
+        def one(net, x, y, mask):
+            return self.eval_fn(net, x, y, mask)
+
+        m = jax.vmap(one)(self.personal_nets, f.x, f.y, f.mask)
+        n = jnp.maximum(jnp.sum(m["num"]), 1.0)
+        return {
+            "personal_accuracy": float(jnp.sum(m["accuracy"] * m["num"]) / n),
+            "personal_loss_eval": float(jnp.sum(m["loss"] * m["num"]) / n),
+        }
+
+    def evaluate_global_on_local(self) -> Dict[str, float]:
+        """The comparison baseline: the single global model evaluated the
+        same way (per-client local shards, sample-weighted)."""
+        f = self.train_fed
+
+        def one(x, y, mask):
+            return self.eval_fn(self.net, x, y, mask)
+
+        m = jax.vmap(one)(f.x, f.y, f.mask)
+        n = jnp.maximum(jnp.sum(m["num"]), 1.0)
+        return {
+            "global_local_accuracy": float(jnp.sum(m["accuracy"] * m["num"]) / n),
+        }

@@ -22,7 +22,7 @@ in-flight upload, so no rank can block on a model that never comes.
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import List
 
 import jax
 import jax.numpy as jnp
@@ -36,18 +36,12 @@ from fedml_tpu.algos.fedavg_distributed import (
     MSG_TYPE_S2C_INIT_CONFIG,
     MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
 )
-from fedml_tpu.comm.loopback import LoopbackNetwork, run_workers
+from fedml_tpu.comm.loopback import run_workers
 from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.data.batching import FederatedArrays
-from fedml_tpu.trainer.local import (
-    make_client_optimizer,
-    make_eval_fn,
-    make_local_train_fn_from_cfg,
-    model_fns,
-    softmax_ce,
-)
+from fedml_tpu.trainer.local import softmax_ce
 
 MSG_ARG_KEY_MODEL_VERSION = "model_version"
 
@@ -199,24 +193,10 @@ def FedML_FedAsync_distributed(
     """Run the async federation: ``cfg.comm_round`` server model updates
     (arrivals, not barrier rounds) across ``cfg.client_num_per_round``
     workers. Returns the server manager (net, staleness/test history)."""
-    worker_num = cfg.client_num_per_round
-    size = worker_num + 1
-    fns = model_fns(model)
-    sample_x = jnp.zeros((1,) + train_fed.x.shape[3:], train_fed.x.dtype)
-    net0 = fns.init(jax.random.PRNGKey(cfg.seed), sample_x)
-    optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
-    local_train = jax.jit(
-        make_local_train_fn_from_cfg(fns.apply, optimizer, cfg, loss_fn=loss_fn))
-    eval_fn = jax.jit(make_eval_fn(fns.apply, loss_fn=loss_fn)) if test_global else None
+    from fedml_tpu.algos.fedavg_distributed import build_federation_setup
 
-    class Args:
-        pass
-
-    args = Args()
-    if backend == "LOOPBACK":
-        args.network = LoopbackNetwork(size)
-    elif backend in ("TCP", "GRPC"):
-        args.host_table = {r: ("127.0.0.1", 0) for r in range(size)}
+    size, net0, local_train, eval_fn, args = build_federation_setup(
+        model, train_fed, test_global, cfg, backend, loss_fn)
     server = FedAsyncServerManager(args, net0, cfg, size, backend=backend,
                                    alpha=alpha, staleness_exp=staleness_exp,
                                    eval_fn=eval_fn, test_data=test_global)

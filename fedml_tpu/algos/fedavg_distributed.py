@@ -190,18 +190,17 @@ class FedAVGClientManager(ClientManager):
         self.cfg = cfg
         self.round_idx = 0
         self._compressor = make_compressor(compress)
-        # Top-k error-feedback residuals, keyed by CLIENT index and tagged
-        # with the round that produced them. EF theory requires the
-        # residual to stay with its own data stream, so (a) a residual is
-        # only applied when this rank trained the same client in the
-        # IMMEDIATELY previous round — a client that migrated to another
-        # rank and back would otherwise get a stale residual spike against
-        # a much-evolved model — and (b) one client's carry is never mixed
-        # into another client's update. Under full participation
-        # (worker_num == client_num_in_total) assignments are stable and
-        # EF is exact; under subsampling the carry is conservatively
-        # dropped at migrations.
-        self._ef_state: Dict[int, tuple] = {}  # client → (round, residual)
+        # Latest top-k error-feedback residual: (round, client, residual).
+        # EF theory requires the residual to stay with its own data
+        # stream, so it is applied only when this rank trains the SAME
+        # client in the IMMEDIATELY next round — a stale carry would
+        # otherwise spike against a much-evolved model, and one client's
+        # carry must never leak into another's update. A rank trains one
+        # client per round, so a single triple suffices (a per-client dict
+        # would pin one dead model-sized residual per migrated-away client
+        # forever). Under full participation assignments are stable and EF
+        # is exact; under subsampling the carry drops at migrations.
+        self._ef_state: Optional[tuple] = None
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -237,10 +236,11 @@ class FedAVGClientManager(ClientManager):
         if self._compressor.name != "none":
             delta = tree_sub(net, global_net)
             rng_c = jax.random.fold_in(rng, 0xC0)
-            prev = self._ef_state.get(c)
-            carry = prev[1] if prev and prev[0] == self.round_idx - 1 else None
+            prev = self._ef_state
+            carry = (prev[2] if prev and prev[0] == self.round_idx - 1
+                     and prev[1] == c else None)
             payload, residual = self._compressor.encode(delta, carry, rng_c)
-            self._ef_state[c] = (self.round_idx, residual)
+            self._ef_state = (self.round_idx, c, residual)
             out.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
             out.add("compression", self._compressor.name)
         else:
@@ -254,24 +254,13 @@ class FedAVGClientManager(ClientManager):
         self.send_message(out)
 
 
-def FedML_FedAvg_distributed(
-    model,
-    train_fed: FederatedArrays,
-    test_global,
-    cfg: FedConfig,
-    backend: str = "LOOPBACK",
-    loss_fn=softmax_ce,
-    compress: str = "none",
-):
-    """Build server + ``client_num_per_round`` workers on the chosen backend
-    and run the full federation (FedAvgAPI.py:20 analogue). Returns the
-    aggregator (global model + test history).
-
-    ``compress``: update compression for the client→server uploads —
-    ``none`` | ``topk<ratio>`` (error feedback) | ``q<bits>`` (stochastic
-    quantization); see fedml_tpu.core.compression."""
-    worker_num = cfg.client_num_per_round
-    size = worker_num + 1
+def build_federation_setup(model, train_fed: FederatedArrays, test_global,
+                           cfg: FedConfig, backend: str, loss_fn):
+    """Shared worker-process scaffolding for the message-passing
+    federations (sync FedAvg here, async in fedasync.py): model fns +
+    initial net, jitted local trainer / eval, and the backend ``args``
+    shim. Returns ``(size, net0, local_train, eval_fn, args)``."""
+    size = cfg.client_num_per_round + 1
     fns = model_fns(model)
     sample_x = jnp.zeros((1,) + train_fed.x.shape[3:], train_fed.x.dtype)
     net0 = fns.init(jax.random.PRNGKey(cfg.seed), sample_x)
@@ -292,7 +281,28 @@ def FedML_FedAvg_distributed(
         # (port 0), then share the resolved table. Multi-host deployments
         # pass an explicit host_table / grpc_ipconfig.csv instead.
         args.host_table = {r: ("127.0.0.1", 0) for r in range(size)}
-    aggregator = FedAVGAggregator(net0, worker_num, cfg, eval_fn, test_global)
+    return size, net0, local_train, eval_fn, args
+
+
+def FedML_FedAvg_distributed(
+    model,
+    train_fed: FederatedArrays,
+    test_global,
+    cfg: FedConfig,
+    backend: str = "LOOPBACK",
+    loss_fn=softmax_ce,
+    compress: str = "none",
+):
+    """Build server + ``client_num_per_round`` workers on the chosen backend
+    and run the full federation (FedAvgAPI.py:20 analogue). Returns the
+    aggregator (global model + test history).
+
+    ``compress``: update compression for the client→server uploads —
+    ``none`` | ``topk<ratio>`` (error feedback) | ``q<bits>`` (stochastic
+    quantization); see fedml_tpu.core.compression."""
+    size, net0, local_train, eval_fn, args = build_federation_setup(
+        model, train_fed, test_global, cfg, backend, loss_fn)
+    aggregator = FedAVGAggregator(net0, size - 1, cfg, eval_fn, test_global)
     server = FedAVGServerManager(args, aggregator, cfg, size, backend=backend,
                                  compress=compress)
     clients = [

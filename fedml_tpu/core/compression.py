@@ -198,12 +198,22 @@ class QuantizeCompression:
 
 
 def make_compressor(name: str):
-    """``none`` | ``topk<ratio>`` (e.g. topk0.05) | ``q<bits>`` (e.g. q8)."""
+    """``none`` | ``topk<ratio>`` (e.g. topk0.05) | ``q<bits>`` (e.g. q8).
+
+    Must accept every name a compressor can generate for itself (frames
+    carry ``self.name`` and the server rebuilds the codec from it), so the
+    ratio is parsed with ``float`` — including scientific notation like
+    ``topk1e-05`` — rather than a decimal-only regex."""
     if name in (None, "", "none"):
         return NoCompression()
-    if re.fullmatch(r"topk(0?\.\d+|1(\.0*)?)", name):
-        return TopKCompression(float(name[4:]))
+    guidance = (
+        f"unknown compressor {name!r}; use none | topk<ratio> | q<bits>")
+    if name.startswith("topk"):
+        try:
+            ratio = float(name[4:])
+        except ValueError:
+            raise ValueError(guidance) from None
+        return TopKCompression(ratio)
     if re.fullmatch(r"q\d+", name):
         return QuantizeCompression(int(name[1:]))
-    raise ValueError(
-        f"unknown compressor {name!r}; use none | topk<ratio> | q<bits>")
+    raise ValueError(guidance)

@@ -58,6 +58,7 @@ def make_api(algorithm: str, args, model, arrays, test, cfg, mesh,
         "FedNova": algos.FedNovaAPI,
         "FedAvgRobust": algos.FedAvgRobustAPI,
         "TurboAggregate": algos.TurboAggregateAPI,
+        "Ditto": algos.DittoAPI,
     }
     if algorithm in table:
         return table[algorithm](model, arrays, test, cfg, **common)
