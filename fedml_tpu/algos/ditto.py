@@ -67,6 +67,13 @@ class DittoAPI(FedAvgAPI):
         )
         self._personal_jit = None
 
+    def set_client_lr(self, lr: float):
+        """LR schedules must reach the personal trainer too — its cached
+        jit bakes in the optimizer, so a changed lr invalidates it."""
+        if lr != getattr(self, "_client_lr", None):
+            self._personal_jit = None
+        super().set_client_lr(lr)
+
     def _personal_round_fn(self):
         """vmapped proximal personal update, prox anchored at the global
         params (``make_local_train_fn`` anchors ``extra_grad_fn`` at the
@@ -75,8 +82,9 @@ class DittoAPI(FedAvgAPI):
         if self._personal_jit is not None:
             return self._personal_jit
         lam = self.lam
+        # The LIVE (possibly schedule-decayed) lr, not the cfg base lr.
         optimizer = make_client_optimizer(
-            self.cfg.client_optimizer, self.cfg.lr, self.cfg.wd,
+            self.cfg.client_optimizer, self._client_lr, self.cfg.wd,
             self.cfg.grad_clip)
 
         def prox(params, _entry_anchor, w_global):
@@ -115,6 +123,13 @@ class DittoAPI(FedAvgAPI):
         metrics["personal_loss"] = float(
             jnp.sum(losses * wmask_a) / jnp.maximum(jnp.sum(wmask_a), 1.0))
         return metrics
+
+    # -- checkpoint/resume: personal models are run state too -------------
+    def checkpoint_extra_state(self):
+        return {"personal_nets": self.personal_nets}
+
+    def load_checkpoint_extra_state(self, extra) -> None:
+        self.personal_nets = extra["personal_nets"]
 
     def evaluate_personalized(self) -> Dict[str, float]:
         """Sample-weighted mean per-client accuracy of each personal model

@@ -35,6 +35,7 @@ from fedml_tpu.algos.fedavg_distributed import (
     MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
     MSG_TYPE_S2C_INIT_CONFIG,
     MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+    build_federation_setup,
 )
 from fedml_tpu.comm.loopback import run_workers
 from fedml_tpu.comm.managers import ClientManager, ServerManager
@@ -193,8 +194,6 @@ def FedML_FedAsync_distributed(
     """Run the async federation: ``cfg.comm_round`` server model updates
     (arrivals, not barrier rounds) across ``cfg.client_num_per_round``
     workers. Returns the server manager (net, staleness/test history)."""
-    from fedml_tpu.algos.fedavg_distributed import build_federation_setup
-
     size, net0, local_train, eval_fn, args = build_federation_setup(
         model, train_fed, test_global, cfg, backend, loss_fn)
     server = FedAsyncServerManager(args, net0, cfg, size, backend=backend,

@@ -95,8 +95,8 @@ class FedAvgAPI(FederatedLoop):
         transform = self._client_transform()
         guard = self._nan_guard
         if mesh is None:
-            round_fn = make_vmap_round(
-                self.local_train, client_transform=transform, nan_guard=guard
+            round_fn = self._make_vmap_round(
+                self.local_train, transform, guard
             )
 
             # Single-device: fuse the client gather + weight computation
@@ -117,13 +117,23 @@ class FedAvgAPI(FederatedLoop):
             # model axis does not multiply the client shards). Gather stays
             # outside the jit: arbitrary sampled indices cross client
             # shards, so the resharding take must run before shard_map.
-            round_fn = make_sharded_round(
-                self.local_train, mesh, mesh.axis_names[0],
-                client_transform=transform, nan_guard=guard,
+            round_fn = self._make_sharded_round(
+                self.local_train, mesh, transform, guard
             )
         self.round_fn = jax.jit(round_fn)
 
     # --- hooks subclasses override (FedOpt/FedProx/...) -------------------
+    def _make_vmap_round(self, local_train, transform, guard):
+        """Single-device round construction; q-FedAvg swaps in a
+        loss-reweighted aggregation here."""
+        return make_vmap_round(
+            local_train, client_transform=transform, nan_guard=guard)
+
+    def _make_sharded_round(self, local_train, mesh, transform, guard):
+        return make_sharded_round(
+            local_train, mesh, mesh.axis_names[0],
+            client_transform=transform, nan_guard=guard)
+
     def _build_local_train(self, optimizer, loss_fn):
         return make_local_train_fn_from_cfg(self.fns.apply, optimizer,
                                             self.cfg, loss_fn)

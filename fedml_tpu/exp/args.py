@@ -67,6 +67,12 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --run_dir")
     p.add_argument("--wandb_project", type=str, default=None)
+    p.add_argument("--ditto_lam", type=float, default=0.1,
+                   help="Ditto proximal strength λ (personal ↔ global "
+                        "trade-off; --algorithm Ditto)")
+    p.add_argument("--qffl_q", type=float, default=1.0,
+                   help="q-FedAvg fairness exponent (0 = equal-weight "
+                        "FedAvg; --algorithm QFedAvg)")
     p.add_argument("--dp_clip", type=float, default=0.0,
                    help="example-level DP-SGD: per-example grad L2 clip "
                         "(0 disables DP)")

@@ -59,7 +59,12 @@ def make_api(algorithm: str, args, model, arrays, test, cfg, mesh,
         "FedAvgRobust": algos.FedAvgRobustAPI,
         "TurboAggregate": algos.TurboAggregateAPI,
         "Ditto": algos.DittoAPI,
+        "QFedAvg": algos.QFedAvgAPI,
     }
+    if algorithm == "Ditto":
+        common["lam"] = args.ditto_lam
+    elif algorithm == "QFedAvg":
+        common["q"] = args.qffl_q
     if algorithm in table:
         return table[algorithm](model, arrays, test, cfg, **common)
     if algorithm == "FedSeg":

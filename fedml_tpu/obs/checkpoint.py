@@ -27,6 +27,9 @@ class RunState:
     net: Any                      # NetState pytree
     rng: Any                      # jax PRNG key
     server_opt_state: Any = None  # FedOpt family; None for plain FedAvg
+    extra: Any = None             # algorithm-specific state (Ditto's
+                                  # personal models etc.) via the
+                                  # checkpoint_extra_state hooks
 
     def to_pytree(self) -> Dict:
         return {
@@ -36,6 +39,7 @@ class RunState:
                 self.rng, "dtype") and jax.dtypes.issubdtype(
                     self.rng.dtype, jax.dtypes.prng_key) else self.rng,
             "server_opt_state": self.server_opt_state,
+            "extra": self.extra,
         }
 
 
@@ -80,12 +84,18 @@ class CheckpointManager:
 
 def save_run(mgr: CheckpointManager, api, round_idx: int):
     """Checkpoint a ``FederatedLoop`` API (FedAvg family) after
-    ``round_idx`` completed rounds."""
+    ``round_idx`` completed rounds. APIs with state beyond
+    (net, rng, server opt) — e.g. Ditto's personal models — expose it via
+    ``checkpoint_extra_state() -> pytree`` and
+    ``load_checkpoint_extra_state(pytree)``; forgetting the hook would
+    silently reset that state on resume."""
+    extra_fn = getattr(api, "checkpoint_extra_state", None)
     state = RunState(
         round_idx=round_idx,
         net=api.net,
         rng=api.rng,
         server_opt_state=getattr(api, "server_opt_state", None),
+        extra=extra_fn() if extra_fn is not None else None,
     )
     mgr.save(round_idx, state.to_pytree())
 
@@ -93,11 +103,13 @@ def save_run(mgr: CheckpointManager, api, round_idx: int):
 def restore_run(mgr: CheckpointManager, api) -> int:
     """Restore the latest checkpoint into ``api`` (in place). Returns the
     next round index to run (0 when no checkpoint exists)."""
+    extra_fn = getattr(api, "checkpoint_extra_state", None)
     template = RunState(
         round_idx=0,
         net=api.net,
         rng=api.rng,
         server_opt_state=getattr(api, "server_opt_state", None),
+        extra=extra_fn() if extra_fn is not None else None,
     ).to_pytree()
     restored = mgr.restore(like=template)
     if restored is None:
@@ -108,4 +120,6 @@ def restore_run(mgr: CheckpointManager, api) -> int:
     api.rng = jax.random.wrap_key_data(np.asarray(rng))
     if restored.get("server_opt_state") is not None and hasattr(api, "server_opt_state"):
         api.server_opt_state = restored["server_opt_state"]
+    if restored.get("extra") is not None:
+        api.load_checkpoint_extra_state(restored["extra"])
     return int(restored["round_idx"]) + 1

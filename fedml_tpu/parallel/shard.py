@@ -35,6 +35,34 @@ def client_finite_mask(client_params) -> jnp.ndarray:
     return jnp.all(jnp.stack(flags, axis=0), axis=0).astype(jnp.float32)
 
 
+def run_clients_guarded(local_train, client_transform, nan_guard,
+                        net, x, y, mask, rngs):
+    """Shared per-round client-training prelude: vmapped local training,
+    optional post-transform (robust clipping etc.), and the NaN-guard
+    zeroing. Returns ``(client_nets, losses, finite)`` where ``finite [C]``
+    is 1.0 for clients whose trained model is wholly finite (all-ones when
+    the guard is off) — callers fold it into their aggregation weights.
+    Used by the vmap round, the sharded round, and q-FedAvg's fair round
+    so the guard semantics can never drift between them."""
+    client_nets, losses = jax.vmap(
+        local_train, in_axes=(None, 0, 0, 0, 0)
+    )(net, x, y, mask, rngs)
+    if client_transform is not None:
+        client_nets = jax.vmap(client_transform, in_axes=(None, 0))(
+            net, client_nets)
+    if not nan_guard:
+        return client_nets, losses, jnp.ones_like(losses)
+    finite = client_finite_mask(client_nets)
+    # Zero via where — NaN * 0 is still NaN.
+    client_nets = jax.tree.map(
+        lambda p: jnp.where(
+            finite.reshape((-1,) + (1,) * (p.ndim - 1)).astype(bool),
+            p, jnp.zeros((), p.dtype)),
+        client_nets)
+    losses = jnp.where(jnp.isfinite(losses), losses, 0.0)
+    return client_nets, losses, finite
+
+
 def make_vmap_round(local_train, client_transform=None, nan_guard: bool = False):
     """``round_fn(params, x, y, mask, weights, loss_weights, rng) ->
     (avg_params, mean_loss)`` with client-stacked inputs ``[C, S, B, ...]``.
@@ -54,24 +82,11 @@ def make_vmap_round(local_train, client_transform=None, nan_guard: bool = False)
 
     def round_fn(params, x, y, mask, weights, loss_weights, rng):
         rngs = client_rngs(rng, x.shape[0], 0)
-        client_params, losses = jax.vmap(
-            local_train, in_axes=(None, 0, 0, 0, 0)
-        )(params, x, y, mask, rngs)
-        if client_transform is not None:
-            client_params = jax.vmap(client_transform, in_axes=(None, 0))(
-                params, client_params
-            )
-        if nan_guard:
-            finite = client_finite_mask(client_params)
-            weights = weights * finite
-            loss_weights = loss_weights * finite
-            # Zero via where — NaN * 0 is still NaN.
-            client_params = jax.tree.map(
-                lambda p: jnp.where(
-                    finite.reshape((-1,) + (1,) * (p.ndim - 1)).astype(bool),
-                    p, jnp.zeros((), p.dtype)),
-                client_params,
-            )
+        client_params, losses, finite = run_clients_guarded(
+            local_train, client_transform, nan_guard,
+            params, x, y, mask, rngs)
+        weights = weights * finite
+        loss_weights = loss_weights * finite
         avg = tree_weighted_mean(client_params, weights)
         if nan_guard:
             # Every sampled client diverged → keep the previous global model
@@ -80,7 +95,6 @@ def make_vmap_round(local_train, client_transform=None, nan_guard: bool = False)
             avg = jax.tree.map(
                 lambda a, p: jnp.where(any_ok, a, p), avg, params)
         lw = loss_weights / jnp.maximum(jnp.sum(loss_weights), 1e-12)
-        losses = jnp.where(jnp.isfinite(losses), losses, 0.0) if nan_guard else losses
         return avg, jnp.sum(losses * lw)
 
     return round_fn
@@ -113,24 +127,11 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
         # Same global-slot-keyed streams as the vmap path.
         shard_idx = jax.lax.axis_index(axis)
         rngs = client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
-        client_params, losses = jax.vmap(
-            local_train, in_axes=(None, 0, 0, 0, 0)
-        )(params, x, y, mask, rngs)
-        if client_transform is not None:
-            client_params = jax.vmap(client_transform, in_axes=(None, 0))(
-                params, client_params
-            )
-        if nan_guard:
-            finite = client_finite_mask(client_params)
-            weights = weights * finite
-            loss_weights = loss_weights * finite
-            client_params = jax.tree.map(
-                lambda p: jnp.where(
-                    finite.reshape((-1,) + (1,) * (p.ndim - 1)).astype(bool),
-                    p, jnp.zeros((), p.dtype)),
-                client_params,
-            )
-            losses = jnp.where(jnp.isfinite(losses), losses, 0.0)
+        client_params, losses, finite = run_clients_guarded(
+            local_train, client_transform, nan_guard,
+            params, x, y, mask, rngs)
+        weights = weights * finite
+        loss_weights = loss_weights * finite
         w = weights.astype(jnp.float32)
         total = jax.lax.psum(jnp.sum(w), axis)
         wn = w / jnp.maximum(total, 1e-12)

@@ -109,3 +109,26 @@ def test_scatter_padded_duplicate_does_not_clobber():
     np.testing.assert_allclose(np.asarray(out["w"][0]), 101.0)
     np.testing.assert_allclose(np.asarray(out["w"][1]), 102.0)
     np.testing.assert_allclose(np.asarray(out["w"][3]), 3.0)  # untouched
+
+
+def test_ditto_checkpoint_roundtrip(tmp_path):
+    """Resume must restore personal models, not reset them to init."""
+    from fedml_tpu.obs import CheckpointManager, restore_run, save_run
+
+    fed = _conflicting_clients()
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=4, epochs=1, batch_size=16, lr=0.5,
+                    frequency_of_the_test=100)
+    api = DittoAPI(LogisticRegression(num_classes=2), fed, None, cfg, lam=0.1)
+    for r in range(3):
+        api.train_one_round(r)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    save_run(mgr, api, 2)
+
+    api2 = DittoAPI(LogisticRegression(num_classes=2), fed, None, cfg, lam=0.1)
+    next_round = restore_run(mgr, api2)
+    mgr.close()
+    assert next_round == 3
+    for a, b in zip(jax.tree.leaves(api.personal_nets),
+                    jax.tree.leaves(api2.personal_nets)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
