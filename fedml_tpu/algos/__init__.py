@@ -16,12 +16,14 @@ from fedml_tpu.algos.turboaggregate import TurboAggregateAPI
 from fedml_tpu.algos.ditto import DittoAPI
 from fedml_tpu.algos.fedasync import FedML_FedAsync_distributed
 from fedml_tpu.algos.qfedavg import QFedAvgAPI
+from fedml_tpu.algos.scaffold import ScaffoldAPI
 from fedml_tpu.algos.vertical_fl import VflAPI
 
 __all__ = [
     "DittoAPI",
     "FedML_FedAsync_distributed",
     "QFedAvgAPI",
+    "ScaffoldAPI",
     "FedConfig",
     "CentralizedTrainer",
     "DecentralizedAPI",

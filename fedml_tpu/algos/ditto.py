@@ -67,12 +67,9 @@ class DittoAPI(FedAvgAPI):
         )
         self._personal_jit = None
 
-    def set_client_lr(self, lr: float):
-        """LR schedules must reach the personal trainer too — its cached
-        jit bakes in the optimizer, so a changed lr invalidates it."""
-        if lr != getattr(self, "_client_lr", None):
-            self._personal_jit = None
-        super().set_client_lr(lr)
+    def _on_client_lr_change(self):
+        """The personal trainer's cached jit bakes in the optimizer."""
+        self._personal_jit = None
 
     def _personal_round_fn(self):
         """vmapped proximal personal update, prox anchored at the global

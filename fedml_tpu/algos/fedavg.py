@@ -87,6 +87,7 @@ class FedAvgAPI(FederatedLoop):
             return
         self._client_lr = lr
         self._rounds_scan_fn = None  # round_fn changes → cached scan stale
+        self._on_client_lr_change()  # subclasses drop their own cached jits
         cfg, mesh = self.cfg, self.mesh
         optimizer = make_client_optimizer(
             cfg.client_optimizer, lr, cfg.wd, cfg.grad_clip
@@ -123,6 +124,13 @@ class FedAvgAPI(FederatedLoop):
         self.round_fn = jax.jit(round_fn)
 
     # --- hooks subclasses override (FedOpt/FedProx/...) -------------------
+    def _on_client_lr_change(self):
+        """Called whenever the client lr actually changes (lr schedules).
+        Subclasses holding their OWN lr-dependent jitted functions (Ditto's
+        personal trainer, SCAFFOLD's corrected round) invalidate them here
+        — forgetting this is how a subclass silently trains at a stale lr
+        under --lr_schedule."""
+
     def _make_vmap_round(self, local_train, transform, guard):
         """Single-device round construction; q-FedAvg swaps in a
         loss-reweighted aggregation here."""

@@ -28,20 +28,37 @@ from fedml_tpu.parallel.shard import client_rngs, run_clients_guarded
 from fedml_tpu.trainer.local import NetState
 
 
-def make_qffl_round(local_train, q: float, lr: float,
+def make_qffl_round(local_train, q: float, lr: float, apply_fn, loss_fn,
                     client_transform=None, nan_guard: bool = False):
     """Same signature as ``make_vmap_round`` so FedAvgAPI's fused-gather
-    and scan paths work unchanged."""
+    and scan paths work unchanged. ``apply_fn``/``loss_fn`` evaluate
+    F_k(w^t) — the q-FFL weights must be the clients' losses AT THE
+    BROADCAST MODEL, not their post-adaptation training losses (a
+    disadvantaged client whose local task is easy to fit would otherwise
+    report a LOW mean training loss and get underweighted, inverting the
+    fairness objective)."""
     L = 1.0 / lr
+
+    def loss_at_global(net, xc, yc, mc):
+        def step(_, inp):
+            xb, yb, mb = inp
+            logits, _ = apply_fn(net, xb, train=False)
+            per = loss_fn(logits, yb)
+            return None, (jnp.sum(per * mb), jnp.sum(mb))
+
+        _, (ls, ns) = jax.lax.scan(step, None, (xc, yc, mc))
+        return jnp.sum(ls) / jnp.maximum(jnp.sum(ns), 1.0)
 
     def round_fn(net, x, y, mask, weights, loss_weights, rng):
         rngs = client_rngs(rng, x.shape[0], 0)
+        F_global = jax.vmap(loss_at_global, in_axes=(None, 0, 0, 0))(
+            net, x, y, mask)
         client_nets, losses, finite = run_clients_guarded(
             local_train, client_transform, nan_guard,
             net, x, y, mask, rngs)
         active = (weights > 0).astype(jnp.float32) * finite
 
-        F = jnp.maximum(losses, 1e-12)
+        F = jnp.maximum(F_global, 1e-12)
         Fq = jnp.where(active > 0, F ** q, 0.0)
         Fq_m1 = jnp.where(active > 0, F ** (q - 1.0), 0.0)
 
@@ -63,12 +80,18 @@ def make_qffl_round(local_train, q: float, lr: float,
 
         # Non-trainable collections (BN stats): plain active-weighted mean,
         # as in FedAvg — the q-update math applies to parameters only.
+        # All-diverged rounds (sum(active)==0) keep the PREVIOUS stats: a
+        # zero-weight einsum would silently zero the running mean/var and
+        # corrupt every later eval.
+        any_ok = jnp.sum(active) > 0
         wn = active / jnp.maximum(jnp.sum(active), 1e-12)
         new_state = jax.tree.map(
-            lambda s: jnp.einsum(
-                "c,c...->...", wn,
-                s.astype(jnp.float32)).astype(s.dtype),
-            client_nets.model_state)
+            lambda s, old: jnp.where(
+                any_ok,
+                jnp.einsum("c,c...->...", wn,
+                           s.astype(jnp.float32)).astype(s.dtype),
+                old),
+            client_nets.model_state, net.model_state)
 
         lw = loss_weights * active
         lw = lw / jnp.maximum(jnp.sum(lw), 1e-12)
@@ -87,6 +110,7 @@ class QFedAvgAPI(FedAvgAPI):
 
     def _make_vmap_round(self, local_train, transform, guard):
         return make_qffl_round(local_train, self.q, self._client_lr,
+                               self.fns.apply, self._loss_fn,
                                client_transform=transform, nan_guard=guard)
 
     def _make_sharded_round(self, local_train, mesh, transform, guard):

@@ -60,6 +60,7 @@ def make_api(algorithm: str, args, model, arrays, test, cfg, mesh,
         "TurboAggregate": algos.TurboAggregateAPI,
         "Ditto": algos.DittoAPI,
         "QFedAvg": algos.QFedAvgAPI,
+        "Scaffold": algos.ScaffoldAPI,
     }
     if algorithm == "Ditto":
         common["lam"] = args.ditto_lam

@@ -1,0 +1,142 @@
+"""SCAFFOLD: zero-control round equals FedAvg, the server control tracks
+the mean client control under full participation, and drift correction
+beats FedAvg under heterogeneous clients with many local epochs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.algos.scaffold import ScaffoldAPI
+from fedml_tpu.data.batching import batch_global, build_federated_arrays
+from fedml_tpu.models.lr import LogisticRegression
+
+
+def _shifted_clients(n_clients=4, per_client=64, d=8, shift=4.0, seed=0):
+    """Same true decision rule, strongly shifted per-client covariate
+    means — the classic client-drift regime for many local epochs."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d)
+    xs, ys = [], []
+    for c in range(n_clients):
+        mu = shift * rng.randn(d)
+        x = (rng.randn(per_client, d) + mu).astype(np.float32)
+        ys.append((x @ w > 0).astype(np.int32))
+        xs.append(x)
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    parts = {c: np.arange(c * per_client, (c + 1) * per_client)
+             for c in range(n_clients)}
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+    return fed, batch_global(x, y, 16)
+
+
+def _cfg(rounds, epochs, lr=0.3):
+    return FedConfig(client_num_in_total=4, client_num_per_round=4,
+                     comm_round=rounds, epochs=epochs, batch_size=16, lr=lr,
+                     frequency_of_the_test=1000)
+
+
+def test_first_round_with_zero_controls_equals_fedavg():
+    """All controls start at zero, so round 0's corrections vanish and
+    SCAFFOLD must match plain FedAvg (same seed, same rng chain)."""
+    fed, test = _shifted_clients()
+    sc = ScaffoldAPI(LogisticRegression(num_classes=2), fed, test,
+                     _cfg(1, epochs=2))
+    fa = FedAvgAPI(LogisticRegression(num_classes=2), fed, test,
+                   _cfg(1, epochs=2))
+    sc.train_one_round(0)
+    fa.train_one_round(0)
+    for a, b in zip(jax.tree.leaves(sc.net.params),
+                    jax.tree.leaves(fa.net.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_server_control_tracks_mean_client_control():
+    """Full participation: c_{t+1} = c_t + mean(Δc_k), and c_0 = mean(c_k,0)
+    = 0, so c must equal mean_k c_k after every round."""
+    fed, test = _shifted_clients()
+    sc = ScaffoldAPI(LogisticRegression(num_classes=2), fed, test,
+                     _cfg(3, epochs=2))
+    for r in range(3):
+        sc.train_one_round(r)
+        mean_ck = jax.tree.map(lambda p: jnp.mean(p, axis=0),
+                               sc.client_controls)
+        for c, m in zip(jax.tree.leaves(sc.server_control),
+                        jax.tree.leaves(mean_ck)):
+            np.testing.assert_allclose(np.asarray(c), np.asarray(m),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def _drift_clients(per=64, d=8, seed=0):
+    """The regime SCAFFOLD is built for: clients with very different
+    covariate SCALES (different local Hessians) and label noise, so each
+    client has a distinct finite optimum and many local epochs drift
+    FedAvg toward the mean of client optima instead of the global one.
+    (Noise matters: on separable data the optimum is at infinity and
+    stale controls only hold the model back.)"""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d)
+    scales = [4.0, 0.25, 3.0, 0.2]
+    xs, ys = [], []
+    for s in scales:
+        wc = w + 1.5 * rng.randn(d)
+        x = (s * rng.randn(per, d)).astype(np.float32)
+        y = (x @ wc > 0).astype(np.int32)
+        flip = rng.rand(per) < 0.15
+        ys.append(np.where(flip, 1 - y, y).astype(np.int32))
+        xs.append(x)
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    parts = {c: np.arange(c * per, (c + 1) * per)
+             for c in range(len(scales))}
+    return build_federated_arrays(x, y, parts, 16), batch_global(x, y, 16)
+
+
+def test_scaffold_reduces_client_drift():
+    """Many local epochs on heterogeneous-Hessian clients: SCAFFOLD's
+    corrected steps must reach a better pooled-data fit than FedAvg
+    (measured gap in this fixed-seed config: ~0.62 vs ~0.69)."""
+    fed, test = _drift_clients()
+    rounds, epochs = 20, 10
+    sc = ScaffoldAPI(LogisticRegression(num_classes=2), fed, test,
+                     _cfg(rounds, epochs, lr=0.2))
+    fa = FedAvgAPI(LogisticRegression(num_classes=2), fed, test,
+                   _cfg(rounds, epochs, lr=0.2))
+    for r in range(rounds):
+        sc.train_one_round(r)
+        fa.train_one_round(r)
+    sc_m = sc.evaluate()
+    fa_m = fa.evaluate()
+    assert np.isfinite(sc_m["loss"]) and np.isfinite(fa_m["loss"])
+    assert sc_m["loss"] < fa_m["loss"] - 0.02
+
+
+def test_scaffold_rejects_non_sgd():
+    fed, test = _shifted_clients()
+    cfg = _cfg(1, 1)
+    cfg.client_optimizer = "adam"
+    with pytest.raises(ValueError):
+        ScaffoldAPI(LogisticRegression(num_classes=2), fed, test, cfg)
+
+
+def test_scaffold_checkpoint_roundtrip(tmp_path):
+    from fedml_tpu.obs import CheckpointManager, restore_run, save_run
+
+    fed, test = _shifted_clients()
+    sc = ScaffoldAPI(LogisticRegression(num_classes=2), fed, test,
+                     _cfg(3, 2))
+    for r in range(2):
+        sc.train_one_round(r)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    save_run(mgr, sc, 1)
+    sc2 = ScaffoldAPI(LogisticRegression(num_classes=2), fed, test,
+                      _cfg(3, 2))
+    assert restore_run(mgr, sc2) == 2
+    mgr.close()
+    for a, b in zip(jax.tree.leaves(sc.client_controls),
+                    jax.tree.leaves(sc2.client_controls)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
