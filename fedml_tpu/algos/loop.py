@@ -72,6 +72,38 @@ class FederatedLoop:
         m = self.eval_fn(self._eval_net(), x, y, mask)
         return {k: float(v) for k, v in m.items()}
 
+    def evaluate_on_clients(self) -> Dict[str, float]:
+        """Per-client evaluation of the current global model on every
+        client's LOCAL training shard — the reference's
+        ``_local_test_on_all_clients`` / ``test_on_server_for_all_clients``
+        cadence (fedavg_api.py:117, FedAVGAggregator.py:110-161), which it
+        runs as a host-side Python loop over clients each eval round; here
+        it is one vmapped on-device pass (SURVEY.md §7 hard part #5).
+        Returns the sample-weighted mean plus worst-client stats (the
+        quantity fairness methods optimize)."""
+        f = self.train_fed
+        net = self._eval_net()
+        # Cache the jitted vmapped eval — vmapping the jit-wrapped eval_fn
+        # inline would re-trace the whole N-client pass on every call.
+        fn = getattr(self, "_clients_eval_fn", None)
+        if fn is None:
+            fn = jax.jit(jax.vmap(
+                lambda n, x, y, mask: self.eval_fn(n, x, y, mask),
+                in_axes=(None, 0, 0, 0)))
+            self._clients_eval_fn = fn
+        m = fn(net, f.x, f.y, f.mask)
+        num = m["num"]
+        n = jnp.maximum(jnp.sum(num), 1.0)
+        present = num > 0
+        worst_acc = jnp.min(jnp.where(present, m["accuracy"], jnp.inf))
+        worst_loss = jnp.max(jnp.where(present, m["loss"], -jnp.inf))
+        return {
+            "clients_train_acc": float(jnp.sum(m["accuracy"] * num) / n),
+            "clients_train_loss": float(jnp.sum(m["loss"] * num) / n),
+            "worst_client_acc": float(worst_acc),
+            "worst_client_loss": float(worst_loss),
+        }
+
     def train(self) -> List[Dict[str, float]]:
         history = []
         for round_idx in range(self.cfg.comm_round):

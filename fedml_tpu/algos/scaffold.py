@@ -106,12 +106,15 @@ class ScaffoldAPI(FedAvgAPI):
             "dp_clip": self.cfg.dp_clip,
             "dp_noise_multiplier": self.cfg.dp_noise_multiplier,
         }
+        # self._nan_guard is what FedAvgAPI actually stored, however the
+        # caller passed it (positionally or by keyword).
         bad = [k for k, v in unsupported.items() if v]
-        if bad or kw.get("nan_guard"):
+        if self._nan_guard:
+            bad.append("nan_guard")
+        if bad:
             raise ValueError(
                 "ScaffoldAPI's corrected SGD step does not support: "
-                + ", ".join(bad + (["nan_guard"] if kw.get("nan_guard") else []))
-            )
+                + ", ".join(bad))
         if self.mesh is not None:
             raise NotImplementedError(
                 "ScaffoldAPI currently targets the single-device vmap "
@@ -187,8 +190,14 @@ class ScaffoldAPI(FedAvgAPI):
         self.net, self.server_control, ck_new, loss = self._scaffold_round_fn()(
             self.net, self.server_control, ck_sub,
             sub.x, sub.y, sub.mask, weights, rnd)
+        # Only clients that actually trained update their control: a
+        # sampled EMPTY client runs zero real steps, so writing its
+        # ck - c + 0 "update" would drift its stored control by -c each
+        # time it is sampled (the paper updates controls only for clients
+        # that computed updates).
+        trained_mask = wmask_a * (sub.counts > 0).astype(jnp.float32)
         self.client_controls = _scatter_stacked(
-            self.client_controls, idx, ck_new, wmask_a)
+            self.client_controls, idx, ck_new, trained_mask)
         return {"round": round_idx, "train_loss": float(loss)}
 
     # -- checkpoint/resume: controls are run state ------------------------

@@ -67,6 +67,10 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --run_dir")
     p.add_argument("--wandb_project", type=str, default=None)
+    p.add_argument("--eval_on_clients", action="store_true",
+                   help="per-client eval of the global model each eval "
+                        "round (reference _local_test_on_all_clients "
+                        "cadence; adds worst-client metrics)")
     p.add_argument("--ditto_lam", type=float, default=0.1,
                    help="Ditto proximal strength λ (personal ↔ global "
                         "trade-off; --algorithm Ditto)")

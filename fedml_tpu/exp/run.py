@@ -148,6 +148,8 @@ def run(args, algorithm: str = "FedAvg"):
             if do_eval:
                 with timer.phase("eval"):
                     metrics.update(api.evaluate())
+                    if getattr(args, "eval_on_clients", False):
+                        metrics.update(api.evaluate_on_clients())
             metrics.update(timer.flat_metrics())
             logger.log(metrics, step=r)
             history.append(metrics)

@@ -25,6 +25,7 @@ def create_model(name: str, **kwargs):
         # Import side-effect registration of the full zoo. Keep this list in
         # sync with the modules that exist — import errors must propagate.
         import fedml_tpu.models.cnn  # noqa: F401
+        import fedml_tpu.models.darts  # noqa: F401
         import fedml_tpu.models.efficientnet  # noqa: F401
         import fedml_tpu.models.gan  # noqa: F401
         import fedml_tpu.models.lr  # noqa: F401
@@ -37,6 +38,7 @@ def create_model(name: str, **kwargs):
         import fedml_tpu.models.unet  # noqa: F401
         import fedml_tpu.models.vfl  # noqa: F401
         import fedml_tpu.models.vgg  # noqa: F401
+        import fedml_tpu.models.vit  # noqa: F401
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
     return _REGISTRY[name](**kwargs)

@@ -1,0 +1,66 @@
+"""Vision Transformer classifier (flax), reusing the transformer encoder
+blocks with non-causal attention.
+
+New TPU-era capability — the reference's vision zoo tops out at CNNs
+(ResNet/VGG/EfficientNet, fedml_api/model/cv/). A ViT is the natural
+MXU-friendly image model: patch embedding is one big matmul and the
+encoder is the same Block as the transformer LM, so the pluggable
+``attn_fn`` (pallas flash attention on chip) carries over unchanged.
+Mean-pooled (GAP) head rather than a class token — simpler and just as
+standard for small ViTs; no BatchNorm anywhere, so the model is
+federated-safe by construction (no running stats to average).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.registry import register_model
+from fedml_tpu.models.transformer import Block
+
+
+class ViT(nn.Module):
+    num_classes: int
+    patch: int = 4
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 4
+    dropout: float = 0.0
+    attn_fn: Optional[Callable] = None  # e.g. pallas flash attention
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, h, w, c = x.shape
+        if h % self.patch or w % self.patch:
+            raise ValueError(
+                f"image {h}x{w} not divisible by patch size {self.patch}")
+        # Patchify: one conv with stride=patch — a single strided matmul
+        # on the MXU, no im2col on the host.
+        x = nn.Conv(self.d_model, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), name="patch_embed")(x)
+        x = x.reshape(b, -1, self.d_model)  # [B, T=h*w/p^2, D]
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, x.shape[1], self.d_model))
+        x = x + pos
+        if self.dropout and train:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for _ in range(self.n_layers):
+            x = Block(self.n_heads, self.d_model, attn_fn=self.attn_fn,
+                      causal=False)(x, train)
+        x = nn.LayerNorm()(x)
+        x = jnp.mean(x, axis=1)  # GAP head
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+@register_model("vit")
+def vit(num_classes: int = 10, patch: int = 4, d_model: int = 128,
+        n_heads: int = 4, n_layers: int = 4, dropout: float = 0.0,
+        attn_fn: Optional[Callable] = None, **_):
+    """ViT-Tiny-ish default sized for CIFAR (32x32/4 → 64 tokens)."""
+    return ViT(num_classes=num_classes, patch=patch, d_model=d_model,
+               n_heads=n_heads, n_layers=n_layers, dropout=dropout,
+               attn_fn=attn_fn)

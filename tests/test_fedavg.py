@@ -176,3 +176,39 @@ def test_train_rounds_on_device_rejects_custom_round_subclasses():
     ):
         with pytest.raises(NotImplementedError):
             api.train_rounds_on_device(2)
+
+
+def test_evaluate_on_clients_matches_manual():
+    """Per-client eval: sample-weighted mean must equal a hand-computed
+    per-client loop, and worst-client stats bound the mean."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+    from fedml_tpu.models.lr import LogisticRegression
+
+    x, y = make_classification(120, n_features=6, n_classes=3, seed=5)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 4), batch_size=8)
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=2, epochs=1, batch_size=8, lr=0.3)
+    api = FedAvgAPI(LogisticRegression(num_classes=3), fed, None, cfg)
+    api.train_one_round(0)
+    got = api.evaluate_on_clients()
+
+    accs, losses, nums = [], [], []
+    for c in range(4):
+        m = api.eval_fn(api.net, fed.x[c], fed.y[c], fed.mask[c])
+        accs.append(float(m["accuracy"]))
+        losses.append(float(m["loss"]))
+        nums.append(float(m["num"]))
+    nums = np.asarray(nums)
+    want_acc = float(np.sum(np.asarray(accs) * nums) / nums.sum())
+    np.testing.assert_allclose(got["clients_train_acc"], want_acc, rtol=1e-5)
+    np.testing.assert_allclose(got["worst_client_acc"], min(accs), rtol=1e-5)
+    np.testing.assert_allclose(got["worst_client_loss"], max(losses), rtol=1e-5)
+    assert got["worst_client_acc"] <= got["clients_train_acc"] + 1e-6

@@ -140,3 +140,27 @@ def test_scaffold_checkpoint_roundtrip(tmp_path):
     for a, b in zip(jax.tree.leaves(sc.client_controls),
                     jax.tree.leaves(sc2.client_controls)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_empty_client_control_not_corrupted():
+    """A sampled client with zero samples performs no training, so its
+    control variate must stay EXACTLY as it was — writing ck - c would
+    drift it every time it is sampled."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(96, 8).astype(np.float32)
+    y = (x @ rng.randn(8) > 0).astype(np.int32)
+    parts = {0: np.arange(48), 1: np.arange(48, 96),
+             2: np.array([], dtype=np.int64)}  # client 2 empty
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+    cfg = FedConfig(client_num_in_total=3, client_num_per_round=3,
+                    comm_round=4, epochs=2, batch_size=16, lr=0.3,
+                    frequency_of_the_test=1000)
+    sc = ScaffoldAPI(LogisticRegression(num_classes=2), fed, None, cfg)
+    for r in range(3):
+        sc.train_one_round(r)
+    empty_ctrl = jax.tree.map(lambda p: np.asarray(p)[2], sc.client_controls)
+    for leaf in jax.tree.leaves(empty_ctrl):
+        np.testing.assert_array_equal(leaf, 0.0)
+    # the trained clients' controls did move
+    moved = jax.tree.map(lambda p: np.asarray(p)[0], sc.client_controls)
+    assert any(np.abs(l).max() > 0 for l in jax.tree.leaves(moved))
