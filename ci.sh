@@ -26,6 +26,22 @@ echo "== FedAvg sharded over 4 devices =="
 python -m fedml_tpu.exp.main_fedavg --model lr --dataset synthetic_1_1 \
     --num_devices 4 $common
 
+echo "== SCAFFOLD / q-FedAvg / Ditto (drift, fairness, personalization) =="
+python -m fedml_tpu.exp.run --algorithm Scaffold \
+    --model lr --dataset synthetic_1_1 $common
+python -m fedml_tpu.exp.run --algorithm QFedAvg --qffl_q 2.0 \
+    --model lr --dataset synthetic_1_1 $common
+python -m fedml_tpu.exp.run --algorithm Ditto --ditto_lam 0.1 \
+    --model lr --dataset synthetic_1_1 $common
+
+echo "== DP-SGD clients (example-level privacy) =="
+python -m fedml_tpu.exp.main_fedavg --model lr --dataset synthetic_1_1 \
+    --dp_clip 1.0 --dp_noise_multiplier 0.5 $common
+
+echo "== async FL (no-barrier staleness-weighted) =="
+python -m fedml_tpu.exp.main_extra --algorithm FedAsync \
+    --model lr --dataset synthetic_1_1 $common
+
 echo "== message-passing framework templates =="
 python -m fedml_tpu.exp.main_extra --algorithm BaseFramework $common
 

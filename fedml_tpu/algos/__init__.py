@@ -15,12 +15,14 @@ from fedml_tpu.algos.split_nn import SplitNNAPI
 from fedml_tpu.algos.turboaggregate import TurboAggregateAPI
 from fedml_tpu.algos.ditto import DittoAPI
 from fedml_tpu.algos.fedasync import FedML_FedAsync_distributed
+from fedml_tpu.algos.fedbn import FedBNAPI
 from fedml_tpu.algos.qfedavg import QFedAvgAPI
 from fedml_tpu.algos.scaffold import ScaffoldAPI
 from fedml_tpu.algos.vertical_fl import VflAPI
 
 __all__ = [
     "DittoAPI",
+    "FedBNAPI",
     "FedML_FedAsync_distributed",
     "QFedAvgAPI",
     "ScaffoldAPI",

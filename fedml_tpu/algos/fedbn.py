@@ -1,0 +1,206 @@
+"""FedBN — keep normalization layers client-local (Li et al., ICLR 2021).
+
+New capability: under feature-shift heterogeneity (each client's inputs
+differently scaled/distributed), averaging normalization parameters mixes
+incompatible per-client statistics. FedBN excludes every normalization
+layer from aggregation: each client keeps its own norm scale/bias (and
+BN running stats), while the rest of the model federates as usual.
+
+TPU design: norm parameters are identified by parameter PATH (flax
+module auto-names — GroupNorm/BatchNorm/LayerNorm), the per-client
+copies live as one client-stacked pytree (non-norm leaves hold a 0-size
+placeholder so the tree structure matches), and a round:
+
+1. grafts each sampled client's norm leaves into the broadcast global,
+2. vmaps local training over per-client initial models (in_axes=0),
+3. averages ONLY non-norm leaves into the new global,
+4. scatters trained norm leaves (and the whole model_state — running
+   stats are also per-client) back into the local store.
+
+Evaluation is per-client by construction (a FedBN model is only complete
+with a client's own norms): ``evaluate_personalized`` grafts and vmaps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+
+from fedml_tpu.algos.ditto import _scatter_stacked
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.data.batching import gather_clients
+from fedml_tpu.trainer.local import NetState
+
+_NORM_PREFIXES = ("GroupNorm", "BatchNorm", "LayerNorm", "Norm_")
+
+
+def _path_is_norm(path) -> bool:
+    for k in path:
+        name = getattr(k, "key", None) or getattr(k, "name", "")
+        if str(name).startswith(_NORM_PREFIXES):
+            return True
+    return False
+
+
+def norm_mask(params):
+    """Pytree of Python bools: True on leaves belonging to a norm layer."""
+    return jtu.tree_map_with_path(lambda p, _: _path_is_norm(p), params)
+
+
+class FedBNAPI(FedAvgAPI):
+    """FedAvg with client-local normalization layers. Requires a model
+    that HAS norm layers (raises otherwise — running FedBN on a norm-free
+    model is indistinguishable from FedAvg and almost certainly a
+    misconfiguration)."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "FedBNAPI currently targets the single-device vmap "
+                "simulator (its round bypasses the sharded path, so "
+                "accepting a mesh would silently not shard)")
+        if self._nan_guard:
+            raise ValueError(
+                "FedBNAPI's round does not implement nan_guard; "
+                "rejecting rather than silently averaging diverged clients")
+        self._norm_mask = norm_mask(self.net.params)
+        if not any(jax.tree.leaves(self._norm_mask)):
+            raise ValueError(
+                "FedBN needs a model with normalization layers "
+                "(GroupNorm/BatchNorm/LayerNorm); none found in the "
+                "parameter tree")
+        n = int(self.train_fed.num_clients)
+        # Per-client stores: norm leaves stacked [N, ...]; non-norm leaves
+        # a 0-size placeholder (never read — the Python-bool mask picks
+        # the branch at trace time).
+        self.local_norms = jax.tree.map(
+            lambda p, m: (jnp.broadcast_to(p[None], (n,) + p.shape)
+                          if m else jnp.zeros((0,), p.dtype)),
+            self.net.params, self._norm_mask)
+        self.local_state = jax.tree.map(
+            lambda s: jnp.broadcast_to(s[None], (n,) + s.shape),
+            self.net.model_state)
+        self._fedbn_jit = None
+        self._eval_clients_jit = None
+
+    def _on_client_lr_change(self):
+        self._fedbn_jit = None
+
+    def _graft(self, global_params, norms_sub):
+        """Per-client initial params: client norms over the global rest.
+        The client count comes from a NORM leaf — non-norm leaves hold the
+        0-size placeholder."""
+        n_sub = next(
+            l.shape[0]
+            for l, m in zip(jax.tree.leaves(norms_sub),
+                            jax.tree.leaves(self._norm_mask)) if m)
+
+        def leaf(g, l, m):
+            if m:
+                return l
+            return jnp.broadcast_to(g[None], (n_sub,) + g.shape)
+
+        return jax.tree.map(leaf, global_params, norms_sub, self._norm_mask)
+
+    def _fedbn_round_fn(self):
+        if self._fedbn_jit is not None:
+            return self._fedbn_jit
+        local_train = self.local_train
+        mask_tree = self._norm_mask
+
+        def round_fn(net, norms_sub, state_sub, x, y, mask, weights, rng):
+            from fedml_tpu.parallel.shard import client_rngs
+
+            rngs = client_rngs(rng, x.shape[0], 0)
+            init_params = self._graft(net.params, norms_sub)
+            init_nets = NetState(init_params, state_sub)
+            trained, losses = jax.vmap(local_train)(init_nets, x, y, mask, rngs)
+
+            # Global update: weighted mean over NON-norm leaves only; the
+            # global's norm leaves stay at their init (they exist solely to
+            # initialize brand-new clients).
+            w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
+
+            def agg(g, t, m):
+                if m:
+                    return g
+                return jnp.einsum(
+                    "c,c...->...", w, t.astype(jnp.float32)).astype(g.dtype)
+
+            new_params = jax.tree.map(agg, net.params, trained.params, mask_tree)
+            # Trained norm leaves (client-stacked) to write back; non-norm
+            # keep the placeholder shape.
+            new_norms = jax.tree.map(
+                lambda t, l, m: t if m else l,
+                trained.params, norms_sub, mask_tree)
+            return (NetState(new_params, net.model_state), new_norms,
+                    trained.model_state, jnp.sum(losses * w))
+
+        self._fedbn_jit = jax.jit(round_fn)
+        return self._fedbn_jit
+
+    def train_one_round(self, round_idx: int) -> Dict[str, float]:
+        idx, wmask = self.sample_round(round_idx)
+        idx = jnp.asarray(idx)
+        wmask_a = jnp.asarray(wmask, jnp.float32)
+        sub = gather_clients(self.train_fed, idx)
+        norms_sub = jax.tree.map(
+            lambda l, m: jnp.take(l, idx, axis=0) if m else l,
+            self.local_norms, self._norm_mask)
+        state_sub = jax.tree.map(
+            lambda s: jnp.take(s, idx, axis=0), self.local_state)
+        self.rng, rnd = jax.random.split(self.rng)
+        weights = sub.counts.astype(jnp.float32) * wmask_a
+        self.net, new_norms, new_state, loss = self._fedbn_round_fn()(
+            self.net, norms_sub, state_sub,
+            sub.x, sub.y, sub.mask, weights, rnd)
+        self.local_norms = jax.tree.map(
+            lambda store, new, m: (_scatter_stacked(store, idx, new, wmask_a)
+                                   if m else store),
+            self.local_norms, new_norms, self._norm_mask)
+        self.local_state = _scatter_stacked(
+            self.local_state, idx, new_state, wmask_a)
+        return {"round": round_idx, "train_loss": float(loss)}
+
+    def evaluate_personalized(self) -> Dict[str, float]:
+        """Per-client eval with each client's OWN norms grafted in — the
+        only semantically complete evaluation of a FedBN model."""
+        f = self.train_fed
+        fn = self._eval_clients_jit
+        if fn is None:
+            def run(net, norms, state, x, y, mask):
+                params = self._graft(net.params, norms)
+                return jax.vmap(
+                    lambda p, s, xc, yc, mc: self.eval_fn(
+                        NetState(p, s), xc, yc, mc)
+                )(params, state, x, y, mask)
+
+            fn = jax.jit(run)
+            self._eval_clients_jit = fn
+        m = fn(self.net, self.local_norms, self.local_state, f.x, f.y, f.mask)
+        num = m["num"]
+        n = jnp.maximum(jnp.sum(num), 1.0)
+        return {
+            "personal_accuracy": float(jnp.sum(m["accuracy"] * num) / n),
+            "personal_loss_eval": float(jnp.sum(m["loss"] * num) / n),
+        }
+
+    # -- checkpoint/resume: local norms are run state ---------------------
+    def checkpoint_extra_state(self):
+        # orbax refuses zero-size arrays; swap the non-norm placeholders
+        # for (1,)-zeros in the saved tree (restored to placeholders on
+        # load — their values are never read).
+        norms = jax.tree.map(
+            lambda l, m: l if m else jnp.zeros((1,), l.dtype),
+            self.local_norms, self._norm_mask)
+        return {"local_norms": norms, "local_state": self.local_state}
+
+    def load_checkpoint_extra_state(self, extra) -> None:
+        self.local_norms = jax.tree.map(
+            lambda cur, saved, m: saved if m else cur,
+            self.local_norms, extra["local_norms"], self._norm_mask)
+        self.local_state = extra["local_state"]

@@ -61,6 +61,7 @@ def make_api(algorithm: str, args, model, arrays, test, cfg, mesh,
         "Ditto": algos.DittoAPI,
         "QFedAvg": algos.QFedAvgAPI,
         "Scaffold": algos.ScaffoldAPI,
+        "FedBN": algos.FedBNAPI,
     }
     if algorithm == "Ditto":
         common["lam"] = args.ditto_lam
@@ -150,6 +151,11 @@ def run(args, algorithm: str = "FedAvg"):
                     metrics.update(api.evaluate())
                     if getattr(args, "eval_on_clients", False):
                         metrics.update(api.evaluate_on_clients())
+                        # Same flag gates the personalized fleet eval —
+                        # both are full per-client passes whose cost
+                        # scales with N.
+                        if hasattr(api, "evaluate_personalized"):
+                            metrics.update(api.evaluate_personalized())
             metrics.update(timer.flat_metrics())
             logger.log(metrics, step=r)
             history.append(metrics)

@@ -1,0 +1,119 @@
+"""FedBN: norm-path detection, client-local norms excluded from
+aggregation, per-client benefit under feature shift, checkpoint state."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.algos.fedbn import FedBNAPI, norm_mask
+from fedml_tpu.data.batching import build_federated_arrays
+from fedml_tpu.models import create_model
+from fedml_tpu.models.lr import LogisticRegression
+
+
+def _model():
+    # ViT has LayerNorms throughout — a compact norm-bearing model.
+    return create_model("vit", num_classes=2, patch=4, d_model=16,
+                        n_heads=2, n_layers=1)
+
+
+def _scale_shifted_clients(n_clients=4, per=64, seed=0):
+    """Feature-shift heterogeneity (FedBN's setting): same labeling rule,
+    wildly different per-client input scales."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(8 * 8 * 3)
+    scales = [1.0, 8.0, 0.2, 4.0]
+    xs, ys = [], []
+    for c in range(n_clients):
+        base = rng.randn(per, 8, 8, 3).astype(np.float32)
+        ys.append((base.reshape(per, -1) @ w > 0).astype(np.int32))
+        xs.append(base * scales[c])
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    parts = {c: np.arange(c * per, (c + 1) * per) for c in range(n_clients)}
+    return build_federated_arrays(x, y, parts, batch_size=16)
+
+
+def _cfg(rounds=8, epochs=2):
+    return FedConfig(client_num_in_total=4, client_num_per_round=4,
+                     comm_round=rounds, epochs=epochs, batch_size=16,
+                     lr=0.003, client_optimizer="adam",
+                     frequency_of_the_test=1000)
+
+
+def test_norm_mask_detects_norm_layers():
+    from fedml_tpu.trainer.local import model_fns
+
+    fns = model_fns(_model())
+    net = fns.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)))
+    mask = norm_mask(net.params)
+    leaves = list(zip(jax.tree.leaves(mask), jax.tree.leaves(net.params)))
+    assert any(m for m, _ in leaves)      # LayerNorms found
+    assert not all(m for m, _ in leaves)  # Dense kernels are not norms
+
+
+def test_fedbn_rejects_norm_free_model():
+    fed = _scale_shifted_clients()
+    with pytest.raises(ValueError):
+        FedBNAPI(LogisticRegression(num_classes=2), fed, None, _cfg())
+
+
+def test_global_norm_leaves_stay_at_init_and_locals_specialize():
+    fed = _scale_shifted_clients()
+    api = FedBNAPI(_model(), fed, None, _cfg(rounds=3))
+    init_params = jax.device_get(api.net.params)
+    for r in range(3):
+        api.train_one_round(r)
+    mask = api._norm_mask
+    for g0, g1, m in zip(jax.tree.leaves(init_params),
+                         jax.tree.leaves(api.net.params),
+                         jax.tree.leaves(mask)):
+        if m:  # global norm leaves never aggregated
+            np.testing.assert_array_equal(np.asarray(g0), np.asarray(g1))
+    # non-norm leaves did move
+    moved = [not np.allclose(np.asarray(a), np.asarray(b))
+             for a, b, m in zip(jax.tree.leaves(init_params),
+                                jax.tree.leaves(api.net.params),
+                                jax.tree.leaves(mask)) if not m]
+    assert any(moved)
+    # per-client norms diverged from each other (clients specialize)
+    for l, m in zip(jax.tree.leaves(api.local_norms), jax.tree.leaves(mask)):
+        if m and l.ndim >= 2:
+            spread = np.asarray(l).std(axis=0).max()
+            if spread > 1e-6:
+                break
+    else:
+        pytest.fail("no per-client norm divergence found")
+
+
+def test_fedbn_beats_fedavg_under_feature_shift():
+    fed = _scale_shifted_clients()
+    rounds = 8
+    bn = FedBNAPI(_model(), fed, None, _cfg(rounds))
+    fa = FedAvgAPI(_model(), fed, None, _cfg(rounds))
+    for r in range(rounds):
+        bn.train_one_round(r)
+        fa.train_one_round(r)
+    bn_acc = bn.evaluate_personalized()["personal_accuracy"]
+    fa_acc = fa.evaluate_on_clients()["clients_train_acc"]
+    assert bn_acc > fa_acc
+
+
+def test_fedbn_checkpoint_roundtrip(tmp_path):
+    from fedml_tpu.obs import CheckpointManager, restore_run, save_run
+
+    fed = _scale_shifted_clients()
+    api = FedBNAPI(_model(), fed, None, _cfg(3))
+    for r in range(2):
+        api.train_one_round(r)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    save_run(mgr, api, 1)
+    api2 = FedBNAPI(_model(), fed, None, _cfg(3))
+    assert restore_run(mgr, api2) == 2
+    mgr.close()
+    for a, b in zip(jax.tree.leaves(api.local_norms),
+                    jax.tree.leaves(api2.local_norms)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
