@@ -133,11 +133,12 @@ class DittoAPI(FedAvgAPI):
         on its OWN local shard — the quantity personalization optimizes
         (the global model's global-test eval remains ``evaluate()``)."""
         f = self.train_fed
-
-        def one(net, x, y, mask):
-            return self.eval_fn(net, x, y, mask)
-
-        m = jax.vmap(one)(self.personal_nets, f.x, f.y, f.mask)
+        fn = getattr(self, "_personal_eval_jit", None)
+        if fn is None:  # cache: an inline vmap would re-trace every call
+            fn = jax.jit(jax.vmap(
+                lambda net, x, y, mask: self.eval_fn(net, x, y, mask)))
+            self._personal_eval_jit = fn
+        m = fn(self.personal_nets, f.x, f.y, f.mask)
         n = jnp.maximum(jnp.sum(m["num"]), 1.0)
         return {
             "personal_accuracy": float(jnp.sum(m["accuracy"] * m["num"]) / n),
@@ -148,11 +149,13 @@ class DittoAPI(FedAvgAPI):
         """The comparison baseline: the single global model evaluated the
         same way (per-client local shards, sample-weighted)."""
         f = self.train_fed
-
-        def one(x, y, mask):
-            return self.eval_fn(self.net, x, y, mask)
-
-        m = jax.vmap(one)(f.x, f.y, f.mask)
+        fn = getattr(self, "_global_local_eval_jit", None)
+        if fn is None:
+            fn = jax.jit(jax.vmap(
+                lambda net, x, y, mask: self.eval_fn(net, x, y, mask),
+                in_axes=(None, 0, 0, 0)))
+            self._global_local_eval_jit = fn
+        m = fn(self.net, f.x, f.y, f.mask)
         n = jnp.maximum(jnp.sum(m["num"]), 1.0)
         return {
             "global_local_accuracy": float(jnp.sum(m["accuracy"] * m["num"]) / n),

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import jax.tree_util as jtu
 
-from fedml_tpu.algos.ditto import _scatter_stacked
+from fedml_tpu.algos.ditto import _gather_stacked, _scatter_stacked
 from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.data.batching import gather_clients
 from fedml_tpu.trainer.local import NetState
@@ -151,8 +151,7 @@ class FedBNAPI(FedAvgAPI):
         norms_sub = jax.tree.map(
             lambda l, m: jnp.take(l, idx, axis=0) if m else l,
             self.local_norms, self._norm_mask)
-        state_sub = jax.tree.map(
-            lambda s: jnp.take(s, idx, axis=0), self.local_state)
+        state_sub = _gather_stacked(self.local_state, idx)
         self.rng, rnd = jax.random.split(self.rng)
         weights = sub.counts.astype(jnp.float32) * wmask_a
         self.net, new_norms, new_state, loss = self._fedbn_round_fn()(
@@ -165,6 +164,14 @@ class FedBNAPI(FedAvgAPI):
         self.local_state = _scatter_stacked(
             self.local_state, idx, new_state, wmask_a)
         return {"round": round_idx, "train_loss": float(loss)}
+
+    def evaluate(self) -> Dict[str, float]:
+        """FedBN's headline metric IS the personalized per-client eval: the
+        global net's norm leaves are frozen at init, so evaluating it on
+        the global test set (the inherited behavior) would measure a model
+        with random-init normalization and silently understate the
+        algorithm."""
+        return self.evaluate_personalized()
 
     def evaluate_personalized(self) -> Dict[str, float]:
         """Per-client eval with each client's OWN norms grafted in — the

@@ -72,16 +72,24 @@ class FederatedLoop:
         m = self.eval_fn(self._eval_net(), x, y, mask)
         return {k: float(v) for k, v in m.items()}
 
-    def evaluate_on_clients(self) -> Dict[str, float]:
+    def evaluate_on_clients(self, arrays=None,
+                            prefix: str = "clients_train") -> Dict[str, float]:
         """Per-client evaluation of the current global model on every
-        client's LOCAL training shard — the reference's
+        client's LOCAL shard — the reference's
         ``_local_test_on_all_clients`` / ``test_on_server_for_all_clients``
         cadence (fedavg_api.py:117, FedAVGAggregator.py:110-161), which it
         runs as a host-side Python loop over clients each eval round; here
         it is one vmapped on-device pass (SURVEY.md §7 hard part #5).
         Returns the sample-weighted mean plus worst-client stats (the
-        quantity fairness methods optimize)."""
-        f = self.train_fed
+        quantity fairness methods optimize).
+
+        ``arrays`` defaults to the training shards; pass the per-client
+        TEST layout (``to_federated_arrays(fed, bs, split="test")`` — the
+        reference's ``test_data_local_dict``) with ``prefix=
+        "clients_test"`` for the local-test leg of the reference cadence.
+        Clients with no samples are excluded from the worst-client stats.
+        """
+        f = arrays if arrays is not None else self.train_fed
         net = self._eval_net()
         # Cache the jitted vmapped eval — vmapping the jit-wrapped eval_fn
         # inline would re-trace the whole N-client pass on every call.
@@ -98,10 +106,10 @@ class FederatedLoop:
         worst_acc = jnp.min(jnp.where(present, m["accuracy"], jnp.inf))
         worst_loss = jnp.max(jnp.where(present, m["loss"], -jnp.inf))
         return {
-            "clients_train_acc": float(jnp.sum(m["accuracy"] * num) / n),
-            "clients_train_loss": float(jnp.sum(m["loss"] * num) / n),
-            "worst_client_acc": float(worst_acc),
-            "worst_client_loss": float(worst_loss),
+            f"{prefix}_acc": float(jnp.sum(m["accuracy"] * num) / n),
+            f"{prefix}_loss": float(jnp.sum(m["loss"] * num) / n),
+            f"worst_client_{prefix.split('_')[-1]}_acc": float(worst_acc),
+            f"worst_client_{prefix.split('_')[-1]}_loss": float(worst_loss),
         }
 
     def train(self) -> List[Dict[str, float]]:

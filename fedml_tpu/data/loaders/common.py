@@ -129,17 +129,43 @@ def clients_from_partition(
     return {cid: (x[idx], y[idx]) for cid, idx in index_map.items()}
 
 
-def to_federated_arrays(fed: FederatedDataset, batch_size: int):
-    """Rectangular stacked layout for the on-device round functions."""
+def to_federated_arrays(fed: FederatedDataset, batch_size: int,
+                        split: str = "train"):
+    """Rectangular stacked layout for the on-device round functions.
+
+    ``split="test"`` builds the layout from the per-client TEST shards
+    (the reference's ``test_data_local_dict`` leg of the 8-tuple) for
+    on-device per-client test evaluation; clients with no local test data
+    get an empty (all-masked) row so indices stay aligned with the train
+    layout. Returns None if the loader kept no test arrays at all."""
     from fedml_tpu.data.batching import build_federated_arrays
 
     assert fed.train_arrays is not None, "loader did not keep raw arrays"
-    cids = sorted(fed.train_arrays)
-    xs = np.concatenate([fed.train_arrays[c][0] for c in cids])
-    ys = np.concatenate([fed.train_arrays[c][1] for c in cids])
+    if split == "train":
+        arrays = fed.train_arrays
+    elif split == "test":
+        if not fed.test_arrays:
+            return None
+        extra = set(fed.test_arrays) - set(fed.train_arrays)
+        if extra:
+            raise ValueError(
+                "test_arrays contain client ids with no train shard "
+                f"({sorted(extra)[:5]}...): the test layout is indexed by "
+                "train client id, so these shards would be silently "
+                "dropped — use a separate FederatedDataset for held-out "
+                "clients")
+        # Keep the client-index space identical to the train layout.
+        sample = next(iter(fed.test_arrays.values()))
+        empty = (sample[0][:0], sample[1][:0])
+        arrays = {c: fed.test_arrays.get(c, empty) for c in fed.train_arrays}
+    else:
+        raise ValueError(f"split must be 'train' or 'test', got {split!r}")
+    cids = sorted(arrays)
+    xs = np.concatenate([arrays[c][0] for c in cids])
+    ys = np.concatenate([arrays[c][1] for c in cids])
     index_map, pos = {}, 0
     for c in cids:
-        n = len(fed.train_arrays[c][0])
+        n = len(arrays[c][0])
         index_map[c] = np.arange(pos, pos + n)
         pos += n
     return build_federated_arrays(xs, ys, index_map, batch_size)

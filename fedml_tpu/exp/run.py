@@ -107,6 +107,15 @@ def run(args, algorithm: str = "FedAvg"):
     api = make_api(algorithm, args, model, arrays, test, cfg, mesh,
                    class_num=fed.class_num)
 
+    # Per-client TEST shards (the reference's test_data_local_dict leg),
+    # built once when the per-client eval cadence is on and the loader
+    # kept local test arrays.
+    test_fed_arrays = None
+    if getattr(args, "eval_on_clients", False):
+        from fedml_tpu.data.loaders import to_federated_arrays as _tfa
+
+        test_fed_arrays = _tfa(fed, args.batch_size, split="test")
+
     from fedml_tpu.obs import MetricsLogger, RoundTimer
 
     logger = MetricsLogger.for_run(
@@ -151,6 +160,9 @@ def run(args, algorithm: str = "FedAvg"):
                     metrics.update(api.evaluate())
                     if getattr(args, "eval_on_clients", False):
                         metrics.update(api.evaluate_on_clients())
+                        if test_fed_arrays is not None:
+                            metrics.update(api.evaluate_on_clients(
+                                test_fed_arrays, prefix="clients_test"))
                         # Same flag gates the personalized fleet eval —
                         # both are full per-client passes whose cost
                         # scales with N.

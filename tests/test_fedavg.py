@@ -209,6 +209,30 @@ def test_evaluate_on_clients_matches_manual():
     nums = np.asarray(nums)
     want_acc = float(np.sum(np.asarray(accs) * nums) / nums.sum())
     np.testing.assert_allclose(got["clients_train_acc"], want_acc, rtol=1e-5)
-    np.testing.assert_allclose(got["worst_client_acc"], min(accs), rtol=1e-5)
-    np.testing.assert_allclose(got["worst_client_loss"], max(losses), rtol=1e-5)
-    assert got["worst_client_acc"] <= got["clients_train_acc"] + 1e-6
+    np.testing.assert_allclose(got["worst_client_train_acc"], min(accs),
+                               rtol=1e-5)
+    np.testing.assert_allclose(got["worst_client_train_loss"], max(losses),
+                               rtol=1e-5)
+    assert got["worst_client_train_acc"] <= got["clients_train_acc"] + 1e-6
+
+    # Local-TEST leg (the reference's test_data_local_dict): a distinct
+    # arrays layout flows through the same cached eval with test keys.
+    from fedml_tpu.data.loaders.common import (
+        build_federated_dataset,
+        to_federated_arrays,
+    )
+
+    rng2 = np.random.RandomState(7)
+    train_clients = {c: (x[30 * c: 30 * c + 30], y[30 * c: 30 * c + 30])
+                     for c in range(4)}
+    test_clients = {c: (rng2.randn(10, 6).astype(np.float32),
+                        rng2.randint(0, 3, 10).astype(np.int32))
+                    for c in range(3)}  # client 3 has NO local test data
+    ds = build_federated_dataset(train_clients, test_clients, 8, class_num=3)
+    test_arrays = to_federated_arrays(ds, 8, split="test")
+    got_t = api.evaluate_on_clients(test_arrays, prefix="clients_test")
+    assert set(got_t) == {"clients_test_acc", "clients_test_loss",
+                          "worst_client_test_acc", "worst_client_test_loss"}
+    assert np.isfinite(got_t["clients_test_acc"])
+    # the empty client contributed nothing (num=0 row)
+    assert float(np.asarray(test_arrays.counts)[3]) == 0.0
