@@ -48,8 +48,10 @@ MSG_ARG_KEY_NUM_SAMPLES = Message.MSG_ARG_KEY_NUM_SAMPLES
 
 
 class FedAVGAggregator:
-    """Server state: buffer per-worker results, weighted-average when all
-    arrive (FedAVGAggregator.py:44-88)."""
+    """Server state: buffer per-worker results, weighted-average when the
+    round completes (FedAVGAggregator.py:44-88; arrival counting lives in
+    the server manager's ``_arrived`` set, which also covers the first-k
+    straggler-tolerant mode)."""
 
     def __init__(self, net, worker_num: int, cfg: FedConfig, eval_fn=None,
                  test_data=None):
@@ -60,25 +62,23 @@ class FedAVGAggregator:
         self.test_data = test_data
         self.model_dict: Dict[int, object] = {}
         self.sample_num_dict: Dict[int, float] = {}
-        self.flag_client_model_uploaded_dict = {i: False for i in range(worker_num)}
         self.test_history: List[dict] = []
 
     def add_local_trained_result(self, index: int, model_params, sample_num) -> None:
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = float(sample_num)
-        self.flag_client_model_uploaded_dict[index] = True
-
-    def check_whether_all_receive(self) -> bool:
-        if not all(self.flag_client_model_uploaded_dict.values()):
-            return False
-        for i in range(self.worker_num):
-            self.flag_client_model_uploaded_dict[i] = False
-        return True
 
     def aggregate(self):
-        total = sum(self.sample_num_dict[i] for i in range(self.worker_num))
+        return self.aggregate_from(range(self.worker_num))
+
+    def aggregate_from(self, indices):
+        """Weighted average over a subset of worker slots — the first-k
+        straggler-tolerant mode aggregates only the workers that uploaded
+        fresh results this round."""
+        indices = list(indices)
+        total = sum(self.sample_num_dict[i] for i in indices)
         avg = None
-        for i in range(self.worker_num):
+        for i in indices:
             w = self.sample_num_dict[i] / max(total, 1e-12)
             scaled = tree_scale(self.model_dict[i], w)
             avg = scaled if avg is None else tree_add(avg, scaled)
@@ -103,12 +103,29 @@ class FedAVGAggregator:
 
 
 class FedAVGServerManager(ServerManager):
+    """Synchronous server. ``aggregate_k`` (0 = all workers) enables
+    straggler-tolerant first-k rounds: the round aggregates as soon as
+    ``k`` FRESH uploads arrive; a straggler's late upload for an older
+    round is discarded and the worker is immediately reassigned to the
+    current round ("catch-up"), so message flow stays strict
+    request/response — every upload gets exactly one reply and no worker
+    can hold two assignments. The reference has no straggler story at all
+    (check_whether_all_receive blocks on everyone)."""
+
     def __init__(self, args, aggregator: FedAVGAggregator, cfg: FedConfig,
-                 size: int, backend: str = "LOOPBACK", compress: str = "none"):
+                 size: int, backend: str = "LOOPBACK", compress: str = "none",
+                 aggregate_k: int = 0):
         super().__init__(args, rank=0, size=size, backend=backend)
+        if aggregate_k and not 1 <= aggregate_k <= size - 1:
+            raise ValueError(
+                f"aggregate_k={aggregate_k} outside [1, {size - 1}]")
         self.aggregator = aggregator
         self.cfg = cfg
         self.round_idx = 0
+        self.aggregate_k = aggregate_k or (size - 1)
+        self._arrived: set = set()
+        self.straggler_drops = 0
+        self._done_workers = 0
         self._decoders = {}  # codec name → compressor (built lazily)
         self._spec = tree_spec(aggregator.net)
         # The net broadcast this round — compressed uploads are deltas
@@ -127,6 +144,7 @@ class FedAVGServerManager(ServerManager):
             msg = Message(MSG_TYPE_S2C_INIT_CONFIG, 0, worker)
             msg.add(MSG_ARG_KEY_MODEL_PARAMS, self.aggregator.net)
             msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[worker - 1]))
+            msg.add("round", 0)
             self.send_message(msg)
 
     def register_message_receive_handlers(self) -> None:
@@ -135,8 +153,39 @@ class FedAVGServerManager(ServerManager):
             self.handle_message_receive_model_from_client,
         )
 
+    def _send_done(self, worker: int) -> None:
+        out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
+        out.add(MSG_ARG_KEY_MODEL_PARAMS, self.aggregator.net)
+        out.add("done", True)
+        self.send_message(out)
+        self._done_workers += 1
+        if self._done_workers == self.size - 1:
+            self.finish()
+
+    def _send_assignment(self, worker: int, client_indexes=None) -> None:
+        if client_indexes is None:
+            client_indexes = self.aggregator.client_sampling(self.round_idx)
+        out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
+        out.add(MSG_ARG_KEY_MODEL_PARAMS, self._broadcast_net)
+        out.add(MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[worker - 1]))
+        out.add("round", self.round_idx)
+        out.add("done", False)
+        self.send_message(out)
+
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
+        if self.round_idx >= self.cfg.comm_round:
+            # Terminal: a straggler's in-flight upload after the final
+            # aggregation — release it.
+            self._send_done(sender)
+            return
+        tag = msg.get("round")
+        if tag is not None and int(tag) != self.round_idx:
+            # Stale upload from an older round: discard the model, catch
+            # the worker up on the current round.
+            self.straggler_drops += 1
+            self._send_assignment(sender)
+            return
         payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         codec = msg.get("compression")
         if codec:
@@ -150,9 +199,11 @@ class FedAVGServerManager(ServerManager):
         self.aggregator.add_local_trained_result(
             sender - 1, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES)
         )
-        if not self.aggregator.check_whether_all_receive():
+        self._arrived.add(sender)
+        if len(self._arrived) < self.aggregate_k:
             return
-        global_net = self.aggregator.aggregate()
+        global_net = self.aggregator.aggregate_from(
+            sorted(w - 1 for w in self._arrived))
         self._broadcast_net = global_net
         if (
             self.round_idx % self.cfg.frequency_of_the_test == 0
@@ -160,21 +211,14 @@ class FedAVGServerManager(ServerManager):
         ):
             self.aggregator.test_on_server(self.round_idx)
         self.round_idx += 1
+        arrived, self._arrived = self._arrived, set()
         if self.round_idx >= self.cfg.comm_round:
-            for worker in range(1, self.size):
-                out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
-                out.add(MSG_ARG_KEY_MODEL_PARAMS, global_net)
-                out.add("done", True)
-                self.send_message(out)
-            self.finish()
+            for worker in sorted(arrived):
+                self._send_done(worker)
             return
         client_indexes = self.aggregator.client_sampling(self.round_idx)
-        for worker in range(1, self.size):
-            out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
-            out.add(MSG_ARG_KEY_MODEL_PARAMS, global_net)
-            out.add(MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[worker - 1]))
-            out.add("done", False)
-            self.send_message(out)
+        for worker in sorted(arrived):
+            self._send_assignment(worker, client_indexes)
 
 
 class FedAVGClientManager(ClientManager):
@@ -212,13 +256,17 @@ class FedAVGClientManager(ClientManager):
         )
 
     def handle_message_init(self, msg: Message) -> None:
+        self.round_idx = int(msg.get("round") or 0)
         self._train(msg.get(MSG_ARG_KEY_MODEL_PARAMS), msg.get(MSG_ARG_KEY_CLIENT_INDEX))
 
     def handle_message_receive_model_from_server(self, msg: Message) -> None:
         if msg.get("done"):
             self.finish()
             return
-        self.round_idx += 1
+        # The server's round tag, not a local counter: under first-k
+        # aggregation a straggler can be reassigned past skipped rounds.
+        tag = msg.get("round")
+        self.round_idx = int(tag) if tag is not None else self.round_idx + 1
         self._train(msg.get(MSG_ARG_KEY_MODEL_PARAMS), msg.get(MSG_ARG_KEY_CLIENT_INDEX))
 
     def _train(self, global_net, client_index: int) -> None:
@@ -246,6 +294,7 @@ class FedAVGClientManager(ClientManager):
         else:
             out.add(MSG_ARG_KEY_MODEL_PARAMS, jax.device_get(net))
         out.add(MSG_ARG_KEY_NUM_SAMPLES, int(self.train_fed.counts[c]))
+        out.add("round", self.round_idx)
         if not (self.cfg.dp_clip and self.cfg.dp_clip > 0):
             # Under DP-SGD the exact train loss is an un-noised function of
             # the private examples; releasing it would void the accounted
@@ -292,6 +341,7 @@ def FedML_FedAvg_distributed(
     backend: str = "LOOPBACK",
     loss_fn=softmax_ce,
     compress: str = "none",
+    aggregate_k: int = 0,
 ):
     """Build server + ``client_num_per_round`` workers on the chosen backend
     and run the full federation (FedAvgAPI.py:20 analogue). Returns the
@@ -299,12 +349,15 @@ def FedML_FedAvg_distributed(
 
     ``compress``: update compression for the client→server uploads —
     ``none`` | ``topk<ratio>`` (error feedback) | ``q<bits>`` (stochastic
-    quantization); see fedml_tpu.core.compression."""
+    quantization); see fedml_tpu.core.compression.
+
+    ``aggregate_k``: straggler-tolerant first-k rounds (0 = wait for all
+    workers; see FedAVGServerManager)."""
     size, net0, local_train, eval_fn, args = build_federation_setup(
         model, train_fed, test_global, cfg, backend, loss_fn)
     aggregator = FedAVGAggregator(net0, size - 1, cfg, eval_fn, test_global)
     server = FedAVGServerManager(args, aggregator, cfg, size, backend=backend,
-                                 compress=compress)
+                                 compress=compress, aggregate_k=aggregate_k)
     clients = [
         FedAVGClientManager(args, rank, size, train_fed, local_train, cfg,
                             backend=backend, compress=compress)

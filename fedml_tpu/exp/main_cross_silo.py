@@ -70,6 +70,10 @@ def main(argv=None):
                         help="client->server update compression: none | "
                              "topk<ratio> (error feedback) | q<bits> "
                              "(stochastic quantization)")
+    parser.add_argument("--aggregate_k", type=int, default=0,
+                        help="straggler-tolerant first-k rounds: aggregate "
+                             "as soon as k fresh uploads arrive (0 = wait "
+                             "for all silos)")
     add_args(parser)
     args = parser.parse_args(argv)
     if not 0 <= args.rank < args.size:
@@ -107,7 +111,8 @@ def main(argv=None):
         aggregator = FedAVGAggregator(net0, worker_num, cfg, eval_fn, test)
         server = FedAVGServerManager(net_args, aggregator, cfg, args.size,
                                      backend=args.comm_backend,
-                                     compress=args.compress)
+                                     compress=args.compress,
+                                     aggregate_k=args.aggregate_k)
         server.run()
         final = aggregator.test_history[-1] if aggregator.test_history else {}
         print(json.dumps({"rank": 0, **final}))

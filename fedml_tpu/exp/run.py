@@ -165,8 +165,11 @@ def run(args, algorithm: str = "FedAvg"):
                                 test_fed_arrays, prefix="clients_test"))
                         # Same flag gates the personalized fleet eval —
                         # both are full per-client passes whose cost
-                        # scales with N.
-                        if hasattr(api, "evaluate_personalized"):
+                        # scales with N. Skip when evaluate() already
+                        # produced the personal keys (FedBN's headline
+                        # eval IS the personalized pass).
+                        if (hasattr(api, "evaluate_personalized")
+                                and "personal_accuracy" not in metrics):
                             metrics.update(api.evaluate_personalized())
             metrics.update(timer.flat_metrics())
             logger.log(metrics, step=r)
