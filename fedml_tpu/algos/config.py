@@ -44,6 +44,14 @@ class FedConfig:
     # Rematerialize forward activations during backprop (jax.checkpoint):
     # trades ~1.3x FLOPs for depth-independent peak HBM.
     remat: bool = False
+    # Client selection strategy (new capability — the reference only has
+    # uniform seeded sampling, FedAVGAggregator.py:90-99): "random", or
+    # "pow_d" (Power-of-Choice, Cho et al. 2020 — sample pow_d_candidates
+    # uniformly, evaluate the CURRENT global model on each, keep the
+    # client_num_per_round with the highest local loss; biases rounds
+    # toward the worst-served clients for faster convergence).
+    client_selection: str = "random"
+    pow_d_candidates: int = 0  # 0 → 2 * client_num_per_round
     # Example-level DP-SGD on clients (new capability — the reference only
     # has server-side weak DP, robust_aggregation.py:49-53): per-example
     # gradient clipping at this L2 norm (0 disables) and Gaussian noise of

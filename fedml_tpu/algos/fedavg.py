@@ -158,6 +158,57 @@ class FedAvgAPI(FederatedLoop):
     # ----------------------------------------------------------------------
     # sample_round/run_round come from FederatedLoop (shared scaffold).
 
+    def sample_round(self, round_idx: int):
+        """Adds Power-of-Choice selection (cfg.client_selection="pow_d",
+        Cho et al. 2020) on top of the inherited uniform sampling: draw d
+        candidates uniformly, evaluate the current global on their local
+        shards (one vmapped pass), keep the highest-loss
+        ``client_num_per_round``.
+
+        The result is memoized per round: pow_d depends on the CURRENT
+        net, so a subclass that samples again mid-round (Ditto's personal
+        step runs after the global update) must see the same set the
+        global round trained — recomputing would silently select a
+        different cohort."""
+        cached = getattr(self, "_sample_cache", None)
+        if cached is not None and cached[0] == round_idx:
+            return cached[1], cached[2]
+        idx, wmask = self._sample_round_uncached(round_idx)
+        self._sample_cache = (round_idx, idx, wmask)
+        return idx, wmask
+
+    def _sample_round_uncached(self, round_idx: int):
+        if self.cfg.client_selection == "random":
+            return super().sample_round(round_idx)
+        if self.cfg.client_selection != "pow_d":
+            raise ValueError(
+                f"unknown client_selection {self.cfg.client_selection!r}; "
+                "use 'random' or 'pow_d'")
+        from fedml_tpu.core.sampling import pad_to_multiple, sample_clients
+        from fedml_tpu.data.batching import gather_clients
+
+        cfg = self.cfg
+        d = cfg.pow_d_candidates or 2 * cfg.client_num_per_round
+        d = min(d, cfg.client_num_in_total)
+        m = min(cfg.client_num_per_round, cfg.client_num_in_total)
+        if d < m:
+            raise ValueError(
+                f"pow_d needs at least client_num_per_round candidates "
+                f"(d={d} < m={m}); raise --pow_d_candidates")
+        candidates = sample_clients(round_idx, cfg.client_num_in_total, d)
+        fn = getattr(self, "_pow_d_eval_fn", None)
+        if fn is None:
+            fn = jax.jit(jax.vmap(
+                lambda net, x, y, m_: self.eval_fn(net, x, y, m_)["loss"],
+                in_axes=(None, 0, 0, 0)))
+            self._pow_d_eval_fn = fn
+        sub = gather_clients(self.train_fed, jnp.asarray(candidates))
+        losses = np.asarray(fn(self._eval_net(), sub.x, sub.y, sub.mask))
+        order = np.argsort(-losses, kind="stable")[:m]
+        idx = candidates[np.sort(order)]
+        idx, wmask = pad_to_multiple(idx, self.n_shards)
+        return idx, wmask
+
     def train_one_round(self, round_idx: int) -> Dict[str, float]:
         avg, loss = self.run_round(round_idx)
         self.net = self._server_update(self.net, avg)
@@ -190,6 +241,10 @@ class FedAvgAPI(FederatedLoop):
                 "train_rounds_on_device currently targets the single-device "
                 "vmap path (the sharded path's resharding gather must run "
                 "outside shard_map)")
+        if self.cfg.client_selection != "random":
+            raise NotImplementedError(
+                "train_rounds_on_device samples uniformly on device; "
+                "loss-biased selection (pow_d) needs the host loop")
         cfg = self.cfg
         n_total = int(self.train_fed.num_clients)
         cpr = min(cfg.client_num_per_round, n_total)

