@@ -196,14 +196,21 @@ class FedAvgAPI(FederatedLoop):
                 f"pow_d needs at least client_num_per_round candidates "
                 f"(d={d} < m={m}); raise --pow_d_candidates")
         candidates = sample_clients(round_idx, cfg.client_num_in_total, d)
-        fn = getattr(self, "_pow_d_eval_fn", None)
+        fn = getattr(self, "_pow_d_losses_jit", None)
         if fn is None:
-            fn = jax.jit(jax.vmap(
-                lambda net, x, y, m_: self.eval_fn(net, x, y, m_)["loss"],
-                in_axes=(None, 0, 0, 0)))
-            self._pow_d_eval_fn = fn
-        sub = gather_clients(self.train_fed, jnp.asarray(candidates))
-        losses = np.asarray(fn(self._eval_net(), sub.x, sub.y, sub.mask))
+            per_client = self._per_client_eval()  # shared cached kernel
+
+            def losses_fn(net, fed, idx):
+                # Gather traced INSIDE the jit: an eager gather would pay
+                # the multi-dispatch host sync the fused round path exists
+                # to avoid (see round_fn_fused above).
+                sub = gather_clients(fed, idx)
+                return per_client(net, sub.x, sub.y, sub.mask)["loss"]
+
+            fn = jax.jit(losses_fn)
+            self._pow_d_losses_jit = fn
+        losses = np.asarray(
+            fn(self._eval_net(), self.train_fed, jnp.asarray(candidates)))
         order = np.argsort(-losses, kind="stable")[:m]
         idx = candidates[np.sort(order)]
         idx, wmask = pad_to_multiple(idx, self.n_shards)

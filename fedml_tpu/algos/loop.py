@@ -35,6 +35,15 @@ class FederatedLoop:
     def sample_round(self, round_idx: int):
         """Reference-seeded sampling + padding to the shard-count multiple
         (FedAVGAggregator.client_sampling, FedAVGAggregator.py:90-99)."""
+        sel = getattr(self.cfg, "client_selection", "random")
+        if sel != "random":
+            # Loss-biased selection is implemented in FedAvgAPI's override;
+            # algorithms landing here would silently sample uniformly
+            # while the user believes pow_d is active.
+            raise NotImplementedError(
+                f"client_selection={sel!r} is not supported by "
+                f"{type(self).__name__}; only the FedAvg family implements "
+                "loss-biased selection")
         from fedml_tpu.core.sampling import pad_to_multiple, sample_clients
 
         idx = sample_clients(
@@ -65,6 +74,20 @@ class FederatedLoop:
             self.net, sub.x, sub.y, sub.mask, weights, weights, rnd_rng
         )
 
+    def _per_client_eval(self):
+        """Cached jitted vmapped eval over a client-stacked layout —
+        shared by evaluate_on_clients and pow_d selection (vmapping the
+        jit-wrapped eval_fn inline would re-trace the whole N-client pass
+        on every call, and two call sites must not hold two executables
+        of the same kernel)."""
+        fn = getattr(self, "_clients_eval_fn", None)
+        if fn is None:
+            fn = jax.jit(jax.vmap(
+                lambda n, x, y, mask: self.eval_fn(n, x, y, mask),
+                in_axes=(None, 0, 0, 0)))
+            self._clients_eval_fn = fn
+        return fn
+
     def evaluate(self) -> Dict[str, float]:
         if self.test_global is None:
             return {}
@@ -91,15 +114,7 @@ class FederatedLoop:
         """
         f = arrays if arrays is not None else self.train_fed
         net = self._eval_net()
-        # Cache the jitted vmapped eval — vmapping the jit-wrapped eval_fn
-        # inline would re-trace the whole N-client pass on every call.
-        fn = getattr(self, "_clients_eval_fn", None)
-        if fn is None:
-            fn = jax.jit(jax.vmap(
-                lambda n, x, y, mask: self.eval_fn(n, x, y, mask),
-                in_axes=(None, 0, 0, 0)))
-            self._clients_eval_fn = fn
-        m = fn(net, f.x, f.y, f.mask)
+        m = self._per_client_eval()(net, f.x, f.y, f.mask)
         num = m["num"]
         n = jnp.maximum(jnp.sum(num), 1.0)
         present = num > 0

@@ -1,0 +1,17 @@
+#!/bin/bash
+# One-command simulated-federation launch — the reference's
+# run_fedavg_standalone_pytorch.sh role (CI-script-fedavg.sh:32-37 style
+# positional-free invocation) for the on-device simulator.
+#
+# Usage:
+#   scripts/run_simulation.sh <algorithm> [runner args...]
+# Examples:
+#   scripts/run_simulation.sh FedAvg --model resnet56 --dataset cifar10 \
+#       --client_num_in_total 10 --comm_round 100
+#   scripts/run_simulation.sh Scaffold --model lr --dataset mnist
+#   scripts/run_simulation.sh FedOpt --server_optimizer adam --num_devices 8
+set -euo pipefail
+
+ALGO=${1:?usage: run_simulation.sh <algorithm> [args...]}
+shift
+exec python -m fedml_tpu.exp.run --algorithm "$ALGO" "$@"

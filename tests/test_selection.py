@@ -52,7 +52,6 @@ def test_pow_d_picks_highest_loss_candidates():
     chosen = set(int(i) for i, w in zip(idx, wmask) if w)
     assert chosen <= set(int(c) for c in candidates)
     # the chosen two have the highest eval losses among the candidates
-    import jax
 
     losses = {int(c): float(api.eval_fn(
         api.net, fed.x[c], fed.y[c], fed.mask[c])["loss"])
@@ -78,10 +77,27 @@ def test_pow_d_trains_and_guard_scan():
     assert np.isfinite(losses).all()
     with pytest.raises(NotImplementedError):
         api.train_rounds_on_device(2)
-    with pytest.raises(ValueError):
-        bad = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
-                        _cfg("oort", cpr=3))
+    # Construction must succeed; only the sampling call hits the guard —
+    # keeping construction outside pytest.raises pins that.
+    bad = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                    _cfg("oort", cpr=3))
+    with pytest.raises(ValueError, match="client_selection"):
         bad.sample_round(0)
+
+
+def test_non_fedavg_algorithms_reject_pow_d():
+    """Algorithms without loss-biased sampling must refuse the flag
+    loudly instead of silently sampling uniformly."""
+    from fedml_tpu.algos.decentralized import DecentralizedAPI
+    from fedml_tpu.core.topology import SymmetricTopologyManager
+
+    fed = _noisy_clients()
+    cfg = _cfg("pow_d", cpr=8)
+    cfg.client_num_per_round = 8
+    api = DecentralizedAPI(LogisticRegression(num_classes=2), fed, None,
+                           cfg, SymmetricTopologyManager(8, neighbor_num=2))
+    with pytest.raises(NotImplementedError, match="client_selection"):
+        api.sample_round(0)
 
 
 def test_pow_d_requires_enough_candidates():
