@@ -10,34 +10,38 @@ leans toward whoever is currently served worst:
     h_k     = q * F_k^(q-1) * ||Delta_k||^2 + L * F_k^q
     w      <- w - sum_k F_k^q Delta_k / sum_k h_k
 
-``q = 0`` recovers equal-weight FedAvg exactly (F^0 = 1, h = L); larger q
-trades average accuracy for uniformity of per-client performance.
+with F_k the client's loss AT THE BROADCAST MODEL w^t (a post-adaptation
+training loss would underweight disadvantaged clients whose local task is
+easy to fit, inverting the fairness objective). ``q = 0`` recovers
+equal-weight FedAvg exactly (F^0 = 1, h = L); larger q trades average
+accuracy for uniformity of per-client performance.
 
-TPU design: drops into FedAvgAPI's round hook — client training stays the
-same vmapped local_train; only the server combination changes, and it is
-a handful of einsums over the client-stacked pytree.
+TPU design: drops into FedAvgAPI's round hooks — client training stays
+the same vmapped local_train; only the server combination changes, and it
+is a handful of einsums over the client-stacked pytree. One shared core
+(``_qffl_update``) serves both the single-device vmap round and the
+mesh-sharded round; the only difference is the cross-shard reduction
+(identity vs ``lax.psum``), so the fair-update math cannot drift between
+the two paths.
 """
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.parallel.shard import client_rngs, run_clients_guarded
 from fedml_tpu.trainer.local import NetState
 
 
-def make_qffl_round(local_train, q: float, lr: float, apply_fn, loss_fn,
-                    client_transform=None, nan_guard: bool = False):
-    """Same signature as ``make_vmap_round`` so FedAvgAPI's fused-gather
-    and scan paths work unchanged. ``apply_fn``/``loss_fn`` evaluate
-    F_k(w^t) — the q-FFL weights must be the clients' losses AT THE
-    BROADCAST MODEL, not their post-adaptation training losses (a
-    disadvantaged client whose local task is easy to fit would otherwise
-    report a LOW mean training loss and get underweighted, inverting the
-    fairness objective)."""
-    L = 1.0 / lr
+def _make_loss_at_global(apply_fn, loss_fn):
+    """Per-client masked mean loss of the (broadcast) net on one client's
+    packed shard ``[S, B, ...]``."""
 
     def loss_at_global(net, xc, yc, mc):
         def step(_, inp):
@@ -49,6 +53,66 @@ def make_qffl_round(local_train, q: float, lr: float, apply_fn, loss_fn,
         _, (ls, ns) = jax.lax.scan(step, None, (xc, yc, mc))
         return jnp.sum(ls) / jnp.maximum(jnp.sum(ns), 1.0)
 
+    return loss_at_global
+
+
+def _qffl_update(net, client_nets, F_global, losses, loss_weights, active,
+                 q: float, L: float, cross):
+    """The fair server update, shared by the vmap and sharded rounds.
+
+    ``cross(x)`` reduces a locally-summed quantity across shards —
+    identity on a single device, ``lax.psum`` under shard_map. Everything
+    else (F clamp, masking, h/denominator, the all-diverged BN-state
+    fallback, loss weighting) is written once so the two paths cannot
+    silently diverge."""
+    F = jnp.maximum(F_global, 1e-12)
+    Fq = jnp.where(active > 0, F ** q, 0.0)
+    Fq_m1 = jnp.where(active > 0, F ** (q - 1.0), 0.0)
+
+    # Delta_k = L (w - w_k) over trainable params, client-stacked.
+    deltas = jax.tree.map(
+        lambda w_, wk: L * (w_.astype(jnp.float32)[None] -
+                            wk.astype(jnp.float32)),
+        net.params, client_nets.params)
+    delta_sq = sum(
+        jnp.sum(jnp.square(d).reshape(d.shape[0], -1), axis=1)
+        for d in jax.tree.leaves(deltas))
+    h = q * Fq_m1 * delta_sq + L * Fq
+    denom = jnp.maximum(cross(jnp.sum(h * active)), 1e-12)
+    new_params = jax.tree.map(
+        lambda w_, d: (w_.astype(jnp.float32)
+                       - cross(jnp.einsum("c,c...->...", Fq * active, d))
+                       / denom).astype(w_.dtype),
+        net.params, deltas)
+
+    # Non-trainable collections (BN stats): plain active-weighted mean, as
+    # in FedAvg — the q-update math applies to parameters only. An
+    # all-diverged round (total active 0) keeps the PREVIOUS stats: a
+    # zero-weight einsum would silently zero the running mean/var and
+    # corrupt every later eval.
+    total_active = cross(jnp.sum(active))
+    any_ok = total_active > 0
+    wn = active / jnp.maximum(total_active, 1e-12)
+    new_state = jax.tree.map(
+        lambda s, old: jnp.where(
+            any_ok,
+            cross(jnp.einsum("c,c...->...", wn,
+                             s.astype(jnp.float32))).astype(s.dtype),
+            old),
+        client_nets.model_state, net.model_state)
+
+    lw = loss_weights * active
+    lw = lw / jnp.maximum(cross(jnp.sum(lw)), 1e-12)
+    return NetState(new_params, new_state), cross(jnp.sum(losses * lw))
+
+
+def make_qffl_round(local_train, q: float, lr: float, apply_fn, loss_fn,
+                    client_transform=None, nan_guard: bool = False):
+    """Same signature as ``make_vmap_round`` so FedAvgAPI's fused-gather
+    and scan paths work unchanged."""
+    L = 1.0 / lr
+    loss_at_global = _make_loss_at_global(apply_fn, loss_fn)
+
     def round_fn(net, x, y, mask, weights, loss_weights, rng):
         rngs = client_rngs(rng, x.shape[0], 0)
         F_global = jax.vmap(loss_at_global, in_axes=(None, 0, 0, 0))(
@@ -57,52 +121,50 @@ def make_qffl_round(local_train, q: float, lr: float, apply_fn, loss_fn,
             local_train, client_transform, nan_guard,
             net, x, y, mask, rngs)
         active = (weights > 0).astype(jnp.float32) * finite
+        return _qffl_update(net, client_nets, F_global, losses, loss_weights,
+                            active, q, L, cross=lambda v: v)
 
-        F = jnp.maximum(F_global, 1e-12)
-        Fq = jnp.where(active > 0, F ** q, 0.0)
-        Fq_m1 = jnp.where(active > 0, F ** (q - 1.0), 0.0)
+    return round_fn
 
-        # Delta_k = L (w - w_k) over trainable params, client-stacked.
-        deltas = jax.tree.map(
-            lambda w_, wk: L * (w_.astype(jnp.float32)[None] -
-                                wk.astype(jnp.float32)),
-            net.params, client_nets.params)
-        delta_sq = sum(
-            jnp.sum(jnp.square(d).reshape(d.shape[0], -1), axis=1)
-            for d in jax.tree.leaves(deltas))
-        h = q * Fq_m1 * delta_sq + L * Fq
-        denom = jnp.maximum(jnp.sum(h * active), 1e-12)
-        new_params = jax.tree.map(
-            lambda w_, d: (w_.astype(jnp.float32)
-                           - jnp.einsum("c,c...->...", Fq * active, d) / denom
-                           ).astype(w_.dtype),
-            net.params, deltas)
 
-        # Non-trainable collections (BN stats): plain active-weighted mean,
-        # as in FedAvg — the q-update math applies to parameters only.
-        # All-diverged rounds (sum(active)==0) keep the PREVIOUS stats: a
-        # zero-weight einsum would silently zero the running mean/var and
-        # corrupt every later eval.
-        any_ok = jnp.sum(active) > 0
-        wn = active / jnp.maximum(jnp.sum(active), 1e-12)
-        new_state = jax.tree.map(
-            lambda s, old: jnp.where(
-                any_ok,
-                jnp.einsum("c,c...->...", wn,
-                           s.astype(jnp.float32)).astype(s.dtype),
-                old),
-            client_nets.model_state, net.model_state)
+def make_qffl_sharded_round(local_train, q: float, lr: float, apply_fn,
+                            loss_fn, mesh, axis: str = "clients",
+                            client_transform=None, nan_guard: bool = False):
+    """Sharded q-FFL round: clients split over ``mesh[axis]``; the scalar
+    reductions (Σ h_k) and the per-leaf numerators (Σ F_k^q Δ_k) become
+    psums over ICI, so the fair update is exact regardless of how clients
+    land on shards (mirrors make_sharded_round's weighted mean)."""
+    L = 1.0 / lr
+    loss_at_global = _make_loss_at_global(apply_fn, loss_fn)
 
-        lw = loss_weights * active
-        lw = lw / jnp.maximum(jnp.sum(lw), 1e-12)
-        return NetState(new_params, new_state), jnp.sum(losses * lw)
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def round_fn(net, x, y, mask, weights, loss_weights, rng):
+        shard_idx = jax.lax.axis_index(axis)
+        rngs = client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
+        F_global = jax.vmap(loss_at_global, in_axes=(None, 0, 0, 0))(
+            net, x, y, mask)
+        client_nets, losses, finite = run_clients_guarded(
+            local_train, client_transform, nan_guard,
+            net, x, y, mask, rngs)
+        active = (weights > 0).astype(jnp.float32) * finite
+        return _qffl_update(net, client_nets, F_global, losses, loss_weights,
+                            active, q, L,
+                            cross=partial(jax.lax.psum, axis_name=axis))
 
     return round_fn
 
 
 class QFedAvgAPI(FedAvgAPI):
     """FedAvg with the q-FFL fair aggregation. ``q=0`` ≡ equal-weight
-    FedAvg (tested); typical fair settings use q in [0.1, 5]."""
+    FedAvg (tested); typical fair settings use q in [0.1, 5]. Works on the
+    single-device vmap simulator and sharded over a client mesh (tested
+    numerically identical)."""
 
     def __init__(self, *args, q: float = 1.0, **kw):
         self.q = q
@@ -114,6 +176,7 @@ class QFedAvgAPI(FedAvgAPI):
                                client_transform=transform, nan_guard=guard)
 
     def _make_sharded_round(self, local_train, mesh, transform, guard):
-        raise NotImplementedError(
-            "q-FedAvg currently targets the single-device vmap simulator; "
-            "the sharded variant needs psum'd loss/delta reductions")
+        return make_qffl_sharded_round(
+            local_train, self.q, self._client_lr, self.fns.apply,
+            self._loss_fn, mesh, mesh.axis_names[0],
+            client_transform=transform, nan_guard=guard)

@@ -88,3 +88,32 @@ def test_qfedavg_trains():
     hist = [api.train_one_round(r)["train_loss"] for r in range(15)]
     assert hist[-1] < hist[0]
     assert np.isfinite(hist).all()
+
+
+def test_sharded_qfedavg_matches_vmap():
+    """q-FedAvg over a 4-device client mesh must match the single-device
+    vmap round numerically (same seeds → same rng streams; psums reorder
+    float reductions, so allclose not bitwise)."""
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    # 4 equal clients so the mesh divides the client axis evenly.
+    rng = np.random.RandomState(3)
+    xs = rng.randn(4 * 32, 8).astype(np.float32)
+    ys = (xs @ rng.randn(8) > 0).astype(np.int32)
+    parts = {c: np.arange(c * 32, (c + 1) * 32) for c in range(4)}
+    from fedml_tpu.data.batching import build_federated_arrays
+
+    fed4 = build_federated_arrays(xs, ys, parts, batch_size=16)
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=3, epochs=2, batch_size=16, lr=0.1,
+                    frequency_of_the_test=1000)
+    vm = QFedAvgAPI(LogisticRegression(num_classes=2), fed4, None, cfg, q=2.0)
+    sh = QFedAvgAPI(LogisticRegression(num_classes=2), fed4, None, cfg, q=2.0,
+                    mesh=client_mesh(4))
+    for r in range(3):
+        vm.train_one_round(r)
+        sh.train_one_round(r)
+    for a, b in zip(jax.tree.leaves(vm.net.params),
+                    jax.tree.leaves(sh.net.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6)
