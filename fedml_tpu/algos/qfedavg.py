@@ -106,15 +106,15 @@ def _qffl_update(net, client_nets, F_global, losses, loss_weights, active,
     return NetState(new_params, new_state), cross(jnp.sum(losses * lw))
 
 
-def make_qffl_round(local_train, q: float, lr: float, apply_fn, loss_fn,
-                    client_transform=None, nan_guard: bool = False):
-    """Same signature as ``make_vmap_round`` so FedAvgAPI's fused-gather
-    and scan paths work unchanged."""
-    L = 1.0 / lr
+def _make_qffl_body(local_train, q, L, apply_fn, loss_fn, client_transform,
+                    nan_guard):
+    """The whole round given per-client rng streams and a cross-shard
+    reduction — shared verbatim by the vmap and sharded wrappers so no
+    stage (F_global eval, guarded training, masking, fair update) can
+    silently diverge between the two paths."""
     loss_at_global = _make_loss_at_global(apply_fn, loss_fn)
 
-    def round_fn(net, x, y, mask, weights, loss_weights, rng):
-        rngs = client_rngs(rng, x.shape[0], 0)
+    def body(net, x, y, mask, weights, loss_weights, rngs, cross):
         F_global = jax.vmap(loss_at_global, in_axes=(None, 0, 0, 0))(
             net, x, y, mask)
         client_nets, losses, finite = run_clients_guarded(
@@ -122,7 +122,22 @@ def make_qffl_round(local_train, q: float, lr: float, apply_fn, loss_fn,
             net, x, y, mask, rngs)
         active = (weights > 0).astype(jnp.float32) * finite
         return _qffl_update(net, client_nets, F_global, losses, loss_weights,
-                            active, q, L, cross=lambda v: v)
+                            active, q, L, cross)
+
+    return body
+
+
+def make_qffl_round(local_train, q: float, lr: float, apply_fn, loss_fn,
+                    client_transform=None, nan_guard: bool = False):
+    """Same signature as ``make_vmap_round`` so FedAvgAPI's fused-gather
+    and scan paths work unchanged."""
+    body = _make_qffl_body(local_train, q, 1.0 / lr, apply_fn, loss_fn,
+                           client_transform, nan_guard)
+
+    def round_fn(net, x, y, mask, weights, loss_weights, rng):
+        rngs = client_rngs(rng, x.shape[0], 0)
+        return body(net, x, y, mask, weights, loss_weights, rngs,
+                    cross=lambda v: v)
 
     return round_fn
 
@@ -134,8 +149,8 @@ def make_qffl_sharded_round(local_train, q: float, lr: float, apply_fn,
     reductions (Σ h_k) and the per-leaf numerators (Σ F_k^q Δ_k) become
     psums over ICI, so the fair update is exact regardless of how clients
     land on shards (mirrors make_sharded_round's weighted mean)."""
-    L = 1.0 / lr
-    loss_at_global = _make_loss_at_global(apply_fn, loss_fn)
+    body = _make_qffl_body(local_train, q, 1.0 / lr, apply_fn, loss_fn,
+                           client_transform, nan_guard)
 
     @partial(
         shard_map,
@@ -147,15 +162,8 @@ def make_qffl_sharded_round(local_train, q: float, lr: float, apply_fn,
     def round_fn(net, x, y, mask, weights, loss_weights, rng):
         shard_idx = jax.lax.axis_index(axis)
         rngs = client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
-        F_global = jax.vmap(loss_at_global, in_axes=(None, 0, 0, 0))(
-            net, x, y, mask)
-        client_nets, losses, finite = run_clients_guarded(
-            local_train, client_transform, nan_guard,
-            net, x, y, mask, rngs)
-        active = (weights > 0).astype(jnp.float32) * finite
-        return _qffl_update(net, client_nets, F_global, losses, loss_weights,
-                            active, q, L,
-                            cross=partial(jax.lax.psum, axis_name=axis))
+        return body(net, x, y, mask, weights, loss_weights, rngs,
+                    cross=partial(jax.lax.psum, axis_name=axis))
 
     return round_fn
 

@@ -30,7 +30,6 @@ import jax.numpy as jnp
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.algos.ditto import _gather_stacked, _scatter_stacked
-from fedml_tpu.core.tree import tree_weighted_mean
 from fedml_tpu.data.batching import gather_clients
 from fedml_tpu.parallel.shard import client_rngs
 from fedml_tpu.trainer.local import NetState, make_epoch_shuffle, tree_select
@@ -115,10 +114,6 @@ class ScaffoldAPI(FedAvgAPI):
             raise ValueError(
                 "ScaffoldAPI's corrected SGD step does not support: "
                 + ", ".join(bad))
-        if self.mesh is not None:
-            raise NotImplementedError(
-                "ScaffoldAPI currently targets the single-device vmap "
-                "simulator")
         self.server_lr = server_lr
         n = int(self.train_fed.num_clients)
         zeros = jax.tree.map(jnp.zeros_like, self.net.params)
@@ -130,51 +125,94 @@ class ScaffoldAPI(FedAvgAPI):
     def _on_client_lr_change(self):
         self._scaffold_jit = None
 
+    def _scaffold_update(self, net, c_server, ck_sub, trained, losses,
+                         k_steps, weights, cross):
+        """The SCAFFOLD server update, shared by the vmap and sharded
+        rounds. ``cross(x)`` reduces a locally-summed quantity across
+        shards — identity on one device, ``lax.psum`` under shard_map —
+        so the control/averaging math is written once and cannot drift."""
+        lr = self._client_lr
+        server_lr = self.server_lr
+        n_total = float(self.train_fed.num_clients)
+
+        active = (weights > 0).astype(jnp.float32)
+        # Option II client-control update:
+        #   c_k' = c_k - c + (x - y_k) / (K_k * lr)
+        inv_klr = 1.0 / (k_steps * lr)
+        ck_new = jax.tree.map(
+            lambda ck, c, xg, yk: (
+                ck - c[None]
+                + (xg.astype(jnp.float32)[None] - yk.astype(jnp.float32))
+                * inv_klr.reshape((-1,) + (1,) * (xg.ndim))),
+            ck_sub, c_server, net.params, trained.params)
+
+        # Server model: x + server_lr * weighted mean of (y_k - x).
+        w = weights.astype(jnp.float32)
+        wn_w = w / jnp.maximum(cross(jnp.sum(w)), 1e-12)
+        avg = jax.tree.map(
+            lambda p: cross(jnp.einsum(
+                "c,c...->...", wn_w, p.astype(jnp.float32))).astype(p.dtype),
+            trained)
+        new_net = jax.tree.map(
+            lambda xg, a: (xg.astype(jnp.float32) * (1 - server_lr)
+                           + server_lr * a.astype(jnp.float32)
+                           ).astype(xg.dtype),
+            net, avg)
+        # Server control: c + (|S|/N) * mean_k Δc_k (active mean).
+        total_active = cross(jnp.sum(active))
+        wn = active / jnp.maximum(total_active, 1e-12)
+        frac = total_active / n_total
+        c_new = jax.tree.map(
+            lambda c, ckn, ck: c + frac * cross(jnp.einsum(
+                "c,c...->...", wn, ckn - ck)),
+            c_server, ck_new, ck_sub)
+        # wn_w is already the normalized sample weighting — reuse it for
+        # the loss (recomputing would add a redundant psum per round).
+        return new_net, c_new, ck_new, cross(jnp.sum(losses * wn_w))
+
     def _scaffold_round_fn(self):
         if self._scaffold_jit is not None:
             return self._scaffold_jit
-        lr = self._client_lr
         local_train = make_scaffold_local_train(
-            self.fns.apply, lr, self.cfg.epochs, self._loss_fn,
+            self.fns.apply, self._client_lr, self.cfg.epochs, self._loss_fn,
             remat=self.cfg.remat)
-        n_total = float(self.train_fed.num_clients)
-        server_lr = self.server_lr
 
-        def round_fn(net, c_server, ck_sub, x, y, mask, weights, rng):
-            rngs = client_rngs(rng, x.shape[0], 0)
+        def body(net, c_server, ck_sub, x, y, mask, weights, rngs, cross):
             corrections = jax.tree.map(
                 lambda c, ck: c[None] - ck, c_server, ck_sub)
             trained, losses, k_steps = jax.vmap(
                 local_train, in_axes=(None, 0, 0, 0, 0, 0)
             )(net, corrections, x, y, mask, rngs)
+            return self._scaffold_update(net, c_server, ck_sub, trained,
+                                         losses, k_steps, weights, cross)
 
-            active = (weights > 0).astype(jnp.float32)
-            # Option II client-control update:
-            #   c_k' = c_k - c + (x - y_k) / (K_k * lr)
-            inv_klr = 1.0 / (k_steps * lr)
-            ck_new = jax.tree.map(
-                lambda ck, c, xg, yk: (
-                    ck - c[None]
-                    + (xg.astype(jnp.float32)[None] - yk.astype(jnp.float32))
-                    * inv_klr.reshape((-1,) + (1,) * (xg.ndim))),
-                ck_sub, c_server, net.params, trained.params)
+        if self.mesh is None:
+            def round_fn(net, c_server, ck_sub, x, y, mask, weights, rng):
+                rngs = client_rngs(rng, x.shape[0], 0)
+                return body(net, c_server, ck_sub, x, y, mask, weights,
+                            rngs, cross=lambda v: v)
+        else:
+            from functools import partial
 
-            # Server model: x + server_lr * weighted mean of (y_k - x).
-            avg = tree_weighted_mean(trained, weights)
-            new_net = jax.tree.map(
-                lambda xg, a: (xg.astype(jnp.float32) * (1 - server_lr)
-                               + server_lr * a.astype(jnp.float32)
-                               ).astype(xg.dtype),
-                net, avg)
-            # Server control: c + (|S|/N) * mean_k Δc_k (active mean).
-            wn = active / jnp.maximum(jnp.sum(active), 1e-12)
-            frac = jnp.sum(active) / n_total
-            c_new = jax.tree.map(
-                lambda c, ckn, ck: c + frac * jnp.einsum(
-                    "c,c...->...", wn, ckn - ck),
-                c_server, ck_new, ck_sub)
-            lw = weights / jnp.maximum(jnp.sum(weights), 1e-12)
-            return new_net, c_new, ck_new, jnp.sum(losses * lw)
+            from jax.sharding import PartitionSpec as P
+            from jax import shard_map
+
+            axis = self.mesh.axis_names[0]
+
+            @partial(
+                shard_map,
+                mesh=self.mesh,
+                in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis),
+                          P(axis), P()),
+                out_specs=(P(), P(), P(axis), P()),
+                check_vma=False,
+            )
+            def round_fn(net, c_server, ck_sub, x, y, mask, weights, rng):
+                shard_idx = jax.lax.axis_index(axis)
+                rngs = client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
+                return body(net, c_server, ck_sub, x, y, mask, weights,
+                            rngs,
+                            cross=lambda v: jax.lax.psum(v, axis))
 
         self._scaffold_jit = jax.jit(round_fn)
         return self._scaffold_jit

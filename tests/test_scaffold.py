@@ -164,3 +164,26 @@ def test_empty_client_control_not_corrupted():
     # the trained clients' controls did move
     moved = jax.tree.map(lambda p: np.asarray(p)[0], sc.client_controls)
     assert any(np.abs(l).max() > 0 for l in jax.tree.leaves(moved))
+
+
+def test_sharded_scaffold_matches_vmap():
+    """SCAFFOLD over a 4-device client mesh: params, server control, AND
+    client controls must match the single-device round numerically."""
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    fed, test = _shifted_clients()
+    vm = ScaffoldAPI(LogisticRegression(num_classes=2), fed, test,
+                     _cfg(3, epochs=2))
+    sh = ScaffoldAPI(LogisticRegression(num_classes=2), fed, test,
+                     _cfg(3, epochs=2), mesh=client_mesh(4))
+    for r in range(3):
+        vm.train_one_round(r)
+        sh.train_one_round(r)
+    for name, a, b in [
+        ("params", vm.net.params, sh.net.params),
+        ("server_control", vm.server_control, sh.server_control),
+        ("client_controls", vm.client_controls, sh.client_controls),
+    ]:
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=2e-4, atol=1e-6, err_msg=name)
