@@ -245,6 +245,12 @@ class FedAVGClientManager(ClientManager):
         # forever). Under full participation assignments are stable and EF
         # is exact; under subsampling the carry drops at migrations.
         self._ef_state: Optional[tuple] = None
+        # Dropped-carry visibility (like the server's straggler_drops):
+        # each increment is one round whose compression error correction
+        # was discarded — top-k is running as plain biased compression in
+        # exactly the regimes (first-k rounds, client re-assignment) that
+        # cause the drops.
+        self.ef_carry_drops = 0
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -287,6 +293,8 @@ class FedAVGClientManager(ClientManager):
             prev = self._ef_state
             carry = (prev[2] if prev and prev[0] == self.round_idx - 1
                      and prev[1] == c else None)
+            if prev is not None and carry is None and prev[2] is not None:
+                self.ef_carry_drops += 1
             payload, residual = self._compressor.encode(delta, carry, rng_c)
             self._ef_state = (self.round_idx, c, residual)
             out.add(MSG_ARG_KEY_MODEL_PARAMS, payload)

@@ -12,9 +12,12 @@ leans toward whoever is currently served worst:
 
 with F_k the client's loss AT THE BROADCAST MODEL w^t (a post-adaptation
 training loss would underweight disadvantaged clients whose local task is
-easy to fit, inverting the fairness objective). ``q = 0`` recovers
-equal-weight FedAvg exactly (F^0 = 1, h = L); larger q trades average
-accuracy for uniformity of per-client performance.
+easy to fit, inverting the fairness objective). ``q = 0`` recovers the
+equal-weight FedAvg PARAMETER update exactly (F^0 = 1, h = L); larger q
+trades average accuracy for uniformity of per-client performance.
+Non-trainable collections (BN running stats) always aggregate with
+FedAvg's sample-count weighting — so on stateful models with unequal
+counts, q=0 matches FedAvg's state but the equal-weight mean for params.
 
 TPU design: drops into FedAvgAPI's round hooks — client training stays
 the same vmapped local_train; only the server combination changes, and it
@@ -56,8 +59,8 @@ def _make_loss_at_global(apply_fn, loss_fn):
     return loss_at_global
 
 
-def _qffl_update(net, client_nets, F_global, losses, loss_weights, active,
-                 q: float, L: float, cross):
+def _qffl_update(net, client_nets, F_global, losses, weights, loss_weights,
+                 active, q: float, L: float, cross):
     """The fair server update, shared by the vmap and sharded rounds.
 
     ``cross(x)`` reduces a locally-summed quantity across shards —
@@ -85,14 +88,20 @@ def _qffl_update(net, client_nets, F_global, losses, loss_weights, active,
                        / denom).astype(w_.dtype),
         net.params, deltas)
 
-    # Non-trainable collections (BN stats): plain active-weighted mean, as
-    # in FedAvg — the q-update math applies to parameters only. An
-    # all-diverged round (total active 0) keeps the PREVIOUS stats: a
-    # zero-weight einsum would silently zero the running mean/var and
-    # corrupt every later eval.
-    total_active = cross(jnp.sum(active))
-    any_ok = total_active > 0
-    wn = active / jnp.maximum(total_active, 1e-12)
+    # Non-trainable collections (BN stats): sample-count-weighted mean
+    # over active clients — the same weighting FedAvg's tree_weighted_mean
+    # applies to NetState. (Parameters are governed by the q-update, whose
+    # q=0 limit is the UNIFORM client mean — so q=0 equals FedAvg only
+    # under equal counts; the state mean matches FedAvg's count weighting
+    # always.) An all-diverged round (total weight 0) keeps the PREVIOUS
+    # stats: a zero-weight einsum would silently zero the running
+    # mean/var and corrupt every later eval. (The parameter update above
+    # is already safe in that case — its numerator and h-sum both vanish,
+    # leaving w unchanged.)
+    w_state = weights.astype(jnp.float32) * active
+    total_w = cross(jnp.sum(w_state))
+    any_ok = total_w > 0
+    wn = w_state / jnp.maximum(total_w, 1e-12)
     new_state = jax.tree.map(
         lambda s, old: jnp.where(
             any_ok,
@@ -121,8 +130,8 @@ def _make_qffl_body(local_train, q, L, apply_fn, loss_fn, client_transform,
             local_train, client_transform, nan_guard,
             net, x, y, mask, rngs)
         active = (weights > 0).astype(jnp.float32) * finite
-        return _qffl_update(net, client_nets, F_global, losses, loss_weights,
-                            active, q, L, cross)
+        return _qffl_update(net, client_nets, F_global, losses, weights,
+                            loss_weights, active, q, L, cross)
 
     return body
 
@@ -170,9 +179,10 @@ def make_qffl_sharded_round(local_train, q: float, lr: float, apply_fn,
 
 class QFedAvgAPI(FedAvgAPI):
     """FedAvg with the q-FFL fair aggregation. ``q=0`` ≡ equal-weight
-    FedAvg (tested); typical fair settings use q in [0.1, 5]. Works on the
-    single-device vmap simulator and sharded over a client mesh (tested
-    numerically identical)."""
+    FedAvg for the parameters (tested; model_state keeps FedAvg's
+    sample-count weighting — see module docstring); typical fair settings
+    use q in [0.1, 5]. Works on the single-device vmap simulator and
+    sharded over a client mesh (tested numerically identical)."""
 
     def __init__(self, *args, q: float = 1.0, **kw):
         self.q = q

@@ -88,6 +88,8 @@ class ScaffoldAPI(FedAvgAPI):
     correction is defined on the SGD update; cfg.client_optimizer must be
     'sgd'). Sampling/eval/loop scaffolding is inherited."""
 
+    supports_streaming = False  # client controls are a device-resident [C, ...] stack
+
     def __init__(self, *args, server_lr: float = 1.0, **kw):
         super().__init__(*args, **kw)
         if self.cfg.client_optimizer != "sgd":
@@ -146,9 +148,13 @@ class ScaffoldAPI(FedAvgAPI):
                 * inv_klr.reshape((-1,) + (1,) * (xg.ndim))),
             ck_sub, c_server, net.params, trained.params)
 
-        # Server model: x + server_lr * weighted mean of (y_k - x).
+        # Server model: x + server_lr * weighted mean of (y_k - x). An
+        # all-inactive round (every sampled client empty/weight-masked)
+        # keeps the previous model: wn_w would be all-zero, the "average"
+        # the zero tree, and with server_lr=1 the global would be zeroed.
         w = weights.astype(jnp.float32)
-        wn_w = w / jnp.maximum(cross(jnp.sum(w)), 1e-12)
+        total_w = cross(jnp.sum(w))
+        wn_w = w / jnp.maximum(total_w, 1e-12)
         avg = jax.tree.map(
             lambda p: cross(jnp.einsum(
                 "c,c...->...", wn_w, p.astype(jnp.float32))).astype(p.dtype),
@@ -158,6 +164,7 @@ class ScaffoldAPI(FedAvgAPI):
                            + server_lr * a.astype(jnp.float32)
                            ).astype(xg.dtype),
             net, avg)
+        new_net = tree_select(total_w > 0, new_net, net)
         # Server control: c + (|S|/N) * mean_k Δc_k (active mean).
         total_active = cross(jnp.sum(active))
         wn = active / jnp.maximum(total_active, 1e-12)

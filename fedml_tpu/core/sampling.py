@@ -24,6 +24,37 @@ def sample_clients(
     return rng.choice(client_num_in_total, num_clients, replace=False).astype(np.int32)
 
 
+def sample_clients_weighted(
+    round_idx: int,
+    client_num_in_total: int,
+    num: int,
+    counts,
+) -> np.ndarray:
+    """Data-fraction-proportional candidate draw (without replacement).
+
+    Power-of-Choice (Cho et al. 2020, §2 "Power-of-Choice Selection
+    Strategy") draws its d candidates with probability proportional to
+    each client's data fraction — on power-law partitions (LEAF MNIST)
+    this differs materially from a uniform draw. Seeded by ``round_idx``
+    like :func:`sample_clients`; falls back to the uniform reference
+    stream when fewer than ``num`` clients hold data (the weighted draw
+    would be infeasible without replacement).
+    """
+    if client_num_in_total == num:
+        return np.arange(client_num_in_total, dtype=np.int32)
+    num = min(num, client_num_in_total)
+    counts = np.asarray(counts, dtype=np.float64)
+    if counts.shape != (client_num_in_total,):
+        raise ValueError(
+            f"counts shape {counts.shape} != ({client_num_in_total},); "
+            "client_num_in_total must match the federated dataset")
+    if np.count_nonzero(counts > 0) < num:
+        return sample_clients(round_idx, client_num_in_total, num)
+    p = counts / counts.sum()
+    rng = np.random.RandomState(round_idx)
+    return rng.choice(client_num_in_total, num, replace=False, p=p).astype(np.int32)
+
+
 def pad_to_multiple(indices: np.ndarray, multiple: int):
     """Pad a sampled-client index list to a device-count multiple.
 

@@ -55,3 +55,38 @@ def test_pad_to_multiple():
     assert len(padded) == 4 and mask.tolist() == [1, 1, 1, 0]
     same, mask2 = pad_to_multiple(np.arange(8), 4)
     assert len(same) == 8 and mask2.sum() == 8
+
+
+def test_sample_clients_weighted_follows_data_fraction():
+    """Power-of-Choice candidate draw is proportional to data fraction
+    (Cho et al. 2020): a client holding half the data must appear in far
+    more candidate sets than a uniform draw would include it."""
+    from fedml_tpu.core.sampling import sample_clients_weighted
+
+    n, d = 40, 4
+    counts = np.ones(n)
+    counts[7] = float(n)  # client 7 holds ~half the total data
+    hits = sum(7 in sample_clients_weighted(r, n, d, counts)
+               for r in range(200))
+    # uniform draw would include it in d/n = 10% of rounds; proportional
+    # draw in >=50%. Split the difference generously.
+    assert hits > 60, hits
+    # Determinism: same round -> same candidates.
+    np.testing.assert_array_equal(
+        sample_clients_weighted(5, n, d, counts),
+        sample_clients_weighted(5, n, d, counts))
+    # Full participation is the identity regardless of counts.
+    np.testing.assert_array_equal(
+        sample_clients_weighted(0, 6, 6, np.arange(6)), np.arange(6))
+
+
+def test_sample_clients_weighted_degenerate_falls_back_to_uniform():
+    """Fewer data-holding clients than the candidate budget -> the
+    weighted draw is infeasible without replacement; fall back to the
+    reference's uniform stream."""
+    from fedml_tpu.core.sampling import sample_clients_weighted
+
+    counts = np.zeros(20)
+    counts[3] = 5.0  # only one nonzero < d=4
+    np.testing.assert_array_equal(
+        sample_clients_weighted(9, 20, 4, counts), sample_clients(9, 20, 4))

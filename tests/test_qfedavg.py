@@ -117,3 +117,32 @@ def test_sharded_qfedavg_matches_vmap():
                     jax.tree.leaves(sh.net.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=1e-6)
+
+
+def test_q0_state_aggregation_matches_fedavg_sample_weighting():
+    """Non-trainable collections (BN running stats) aggregate with the
+    SAME sample-count weighting FedAvg applies to the whole NetState.
+    One round from a shared init: client states are identical in both
+    runs, so the aggregated batch_stats must match exactly even though
+    the q-update's parameter mean is uniform (counts are unequal here
+    precisely so a uniform state mean would NOT match)."""
+    import flax.linen as nn
+
+    class TinyBN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            h = nn.Dense(8)(x)
+            h = nn.BatchNorm(use_running_average=not train, momentum=0.9)(h)
+            return nn.Dense(2)(nn.relu(h))
+
+    fed = _skewed_clients()  # counts 128 vs 32
+    cfg = _cfg(1)
+    qapi = QFedAvgAPI(TinyBN(), fed, None, cfg, q=0.0)
+    api = FedAvgAPI(TinyBN(), fed, None, cfg)
+    assert jax.tree.leaves(qapi.net.model_state), "model must carry state"
+    qapi.train_one_round(0)
+    api.train_one_round(0)
+    for a, b in zip(jax.tree.leaves(qapi.net.model_state),
+                    jax.tree.leaves(api.net.model_state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)

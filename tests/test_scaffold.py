@@ -187,3 +187,28 @@ def test_sharded_scaffold_matches_vmap():
         for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
             np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
                                        rtol=2e-4, atol=1e-6, err_msg=name)
+
+
+def test_scaffold_all_inactive_round_keeps_model_and_controls():
+    """A round where every sampled client is weight-masked (all weights
+    zero) must be a no-op: without the guard the weighted 'average' is
+    the zero tree and server_lr=1 would zero the global model."""
+    fed, test = _shifted_clients()
+    sc = ScaffoldAPI(LogisticRegression(num_classes=2), fed, test,
+                     _cfg(2, 1), server_lr=1.0)
+    from fedml_tpu.algos.ditto import _gather_stacked
+    from fedml_tpu.data.batching import gather_clients
+
+    idx = jnp.arange(fed.num_clients)
+    sub = gather_clients(fed, idx)
+    ck_sub = _gather_stacked(sc.client_controls, idx)
+    zero_w = jnp.zeros((int(fed.num_clients),), jnp.float32)
+    new_net, c_new, _, loss = sc._scaffold_round_fn()(
+        sc.net, sc.server_control, ck_sub, sub.x, sub.y, sub.mask,
+        zero_w, jax.random.PRNGKey(0))
+    for a, b in zip(jax.tree.leaves(new_net), jax.tree.leaves(sc.net)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(c_new),
+                    jax.tree.leaves(sc.server_control)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.isfinite(float(loss))
