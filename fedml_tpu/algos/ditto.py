@@ -57,6 +57,8 @@ class DittoAPI(FedAvgAPI):
     """FedAvg for the global model + per-client personal models with a
     proximal pull of strength ``lam`` toward the current global."""
 
+    supports_streaming = False  # personal nets are a device-resident [C, ...] stack
+
     def __init__(self, *args, lam: float = 0.1, **kw):
         self.lam = lam
         super().__init__(*args, **kw)

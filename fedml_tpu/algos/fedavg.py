@@ -33,7 +33,19 @@ from fedml_tpu.trainer.local import (
 
 class FedAvgAPI(FederatedLoop):
     """Federated trainer. ``mesh=None`` → single-device vmap simulator;
-    with a mesh, clients are sharded over ``mesh.axis_names[0]``."""
+    with a mesh, clients are sharded over ``mesh.axis_names[0]``.
+
+    ``train_fed`` may be a device-resident ``FederatedArrays`` (small
+    client counts) or a host-resident ``data.store.FederatedStore``
+    (reference-scale client counts — 3,400-writer FEMNIST, 342k-user
+    StackOverflow): the store streams only each round's sampled cohort
+    to the device, double-buffered against the round's compute."""
+
+    #: Subclasses that read client-stacked arrays outside run_round
+    #: (persistent per-client device state, direct gather_clients) set
+    #: this False; FedAvgAPI raises at construction instead of failing
+    #: deep inside their round.
+    supports_streaming = True
 
     def __init__(
         self,
@@ -53,11 +65,20 @@ class FedAvgAPI(FederatedLoop):
 
         ``nan_guard``: zero-weight any client whose local training diverged
         to non-finite params (fedml_tpu.core.faults failure containment)."""
+        from fedml_tpu.data.store import FederatedStore
+
         self.cfg = cfg
         self.mesh = mesh
         self.train_fed = train_fed
         self.test_global = test_global
         self.fns = model_fns(model)
+        self._streaming = isinstance(train_fed, FederatedStore)
+        if self._streaming and not type(self).supports_streaming:
+            raise NotImplementedError(
+                f"{type(self).__name__} keeps per-client state device-"
+                "resident (or gathers clients on device) and does not "
+                "support FederatedStore streaming; use the resident "
+                "FederatedArrays layout")
         if cfg.batch_size != train_fed.batch_size:
             raise ValueError(
                 f"cfg.batch_size={cfg.batch_size} != packed client batch size "
@@ -74,7 +95,8 @@ class FedAvgAPI(FederatedLoop):
 
         rng = jax.random.PRNGKey(cfg.seed)
         self.rng, init_rng = jax.random.split(rng)
-        sample_x = np.asarray(train_fed.x[0, 0])
+        sample_x = (train_fed.example_input() if self._streaming
+                    else np.asarray(train_fed.x[0, 0]))
         self.net = self.fns.init(init_rng, sample_x)
 
     def set_client_lr(self, lr: float):
@@ -100,19 +122,23 @@ class FedAvgAPI(FederatedLoop):
                 self.local_train, transform, guard
             )
 
-            # Single-device: fuse the client gather + weight computation
-            # into the jitted round. Dispatching the takes eagerly costs
-            # ~40% of the round wall-clock on a real chip (4 un-jitted
-            # device ops + host sync per round). FederatedArrays is a
-            # struct.dataclass pytree, so it traces straight through jit.
-            from fedml_tpu.data.batching import gather_clients
+            if not self._streaming:
+                # Single-device: fuse the client gather + weight
+                # computation into the jitted round. Dispatching the takes
+                # eagerly costs ~40% of the round wall-clock on a real chip
+                # (4 un-jitted device ops + host sync per round).
+                # FederatedArrays is a struct.dataclass pytree, so it
+                # traces straight through jit. (The streaming store
+                # gathers on HOST — its cohort arrives pre-gathered, so
+                # the plain round_fn path below is the fast path.)
+                from fedml_tpu.data.batching import gather_clients
 
-            def fused(net, fed, idx, wmask, rng):
-                sub = gather_clients(fed, idx)
-                w = sub.counts.astype(jnp.float32) * wmask
-                return round_fn(net, sub.x, sub.y, sub.mask, w, w, rng)
+                def fused(net, fed, idx, wmask, rng):
+                    sub = gather_clients(fed, idx)
+                    w = sub.counts.astype(jnp.float32) * wmask
+                    return round_fn(net, sub.x, sub.y, sub.mask, w, w, rng)
 
-            self.round_fn_fused = jax.jit(fused)
+                self.round_fn_fused = jax.jit(fused)
         else:
             # Pad the sampled set to the CLIENT axis size only (a 2-D mesh's
             # model axis does not multiply the client shards). Gather stays
@@ -184,7 +210,10 @@ class FedAvgAPI(FederatedLoop):
             raise ValueError(
                 f"unknown client_selection {self.cfg.client_selection!r}; "
                 "use 'random' or 'pow_d'")
-        from fedml_tpu.core.sampling import pad_to_multiple, sample_clients
+        from fedml_tpu.core.sampling import (
+            pad_to_multiple,
+            sample_clients_weighted,
+        )
         from fedml_tpu.data.batching import gather_clients
 
         cfg = self.cfg
@@ -195,7 +224,21 @@ class FedAvgAPI(FederatedLoop):
             raise ValueError(
                 f"pow_d needs at least client_num_per_round candidates "
                 f"(d={d} < m={m}); raise --pow_d_candidates")
-        candidates = sample_clients(round_idx, cfg.client_num_in_total, d)
+        # Cho et al. 2020 draw the candidate set proportional to client
+        # data fraction, not uniformly (matters on power-law partitions).
+        candidates = sample_clients_weighted(
+            round_idx, cfg.client_num_in_total, d, self.train_fed.counts)
+        if self._streaming:
+            # Store path: host-gather the candidate cohort, one vmapped
+            # eval pass (same kernel the resident path jits the gather
+            # into). d is small (~2x clients/round), so the extra H2D is
+            # one cohort's worth.
+            sub = self.train_fed.gather_cohort(candidates)
+            losses = np.asarray(self._per_client_eval()(
+                self._eval_net(), sub.x, sub.y, sub.mask)["loss"])
+            order = np.argsort(-losses, kind="stable")[:m]
+            idx = candidates[np.sort(order)]
+            return pad_to_multiple(idx, self.n_shards)
         fn = getattr(self, "_pow_d_losses_jit", None)
         if fn is None:
             per_client = self._per_client_eval()  # shared cached kernel
@@ -216,6 +259,40 @@ class FedAvgAPI(FederatedLoop):
         idx, wmask = pad_to_multiple(idx, self.n_shards)
         return idx, wmask
 
+    def _stream_cohort(self, round_idx: int, idx):
+        """Fetch the round's cohort from the host store (prefetched when
+        possible) and kick off the NEXT round's gather + H2D transfer so
+        it overlaps this round's compute. Only seeded-random selection can
+        prefetch — pow_d depends on the current net."""
+        from fedml_tpu.data.store import CohortPrefetcher
+
+        pf = getattr(self, "_cohort_prefetcher", None)
+        if pf is None:
+            pf = self._cohort_prefetcher = CohortPrefetcher(self.train_fed)
+        sub = pf.get(round_idx, idx)
+        if (self.cfg.client_selection == "random"
+                and round_idx + 1 < self.cfg.comm_round):
+            from fedml_tpu.core.sampling import pad_to_multiple, sample_clients
+
+            nidx, _ = pad_to_multiple(
+                sample_clients(round_idx + 1, self.cfg.client_num_in_total,
+                               self.cfg.client_num_per_round),
+                self.n_shards)
+            pf.prefetch(round_idx + 1, nidx)
+        return sub
+
+    def _cohort(self, round_idx: int, idx):
+        """The round's sampled clients as a ``FederatedArrays``: device
+        gather on the resident layout, host gather (double-buffered) on
+        the streaming store. Subclasses that materialize the cohort
+        themselves (FedNova's τ algebra, TurboAggregate's MPC) go through
+        this so they stream for free."""
+        if self._streaming:
+            return self._stream_cohort(round_idx, idx)
+        from fedml_tpu.data.batching import gather_clients
+
+        return gather_clients(self.train_fed, jnp.asarray(idx))
+
     def train_one_round(self, round_idx: int) -> Dict[str, float]:
         avg, loss = self.run_round(round_idx)
         self.net = self._server_update(self.net, avg)
@@ -234,7 +311,11 @@ class FedAvgAPI(FederatedLoop):
         bit-equal to the host loop (tested); with subsampling the client
         choice differs from host-loop runs. Only plain FedAvg server
         updates (new = avg) can ride the scan; subclasses with stateful
-        server optimizers must use the host loop."""
+        server optimizers must use the host loop. On a client mesh the
+        scan rides the shard_map round under full participation (the
+        gather is the identity there; client shards stay pinned to their
+        devices across all rounds); subsampled mesh rounds still need the
+        host loop's resharding gather."""
         if (type(self)._server_update is not FedAvgAPI._server_update
                 or type(self).train_one_round is not FedAvgAPI.train_one_round
                 or type(self).run_round is not FederatedLoop.run_round):
@@ -243,11 +324,12 @@ class FedAvgAPI(FederatedLoop):
                 "this subclass customizes the round or server update "
                 "(hierarchical grouping, MPC aggregation, server optimizers "
                 "cannot ride the scan)")
-        if self.mesh is not None:
+        if self._streaming:
             raise NotImplementedError(
-                "train_rounds_on_device currently targets the single-device "
-                "vmap path (the sharded path's resharding gather must run "
-                "outside shard_map)")
+                "train_rounds_on_device needs the whole dataset device-"
+                "resident (the scan gathers clients on device each round); "
+                "FederatedStore streams cohorts from host — use the host "
+                "loop")
         if self.cfg.client_selection != "random":
             raise NotImplementedError(
                 "train_rounds_on_device samples uniformly on device; "
@@ -255,6 +337,18 @@ class FedAvgAPI(FederatedLoop):
         cfg = self.cfg
         n_total = int(self.train_fed.num_clients)
         cpr = min(cfg.client_num_per_round, n_total)
+        if self.mesh is not None and (cpr != n_total
+                                      or n_total % self.n_shards):
+            # Subsampled mesh rounds need a resharding gather (arbitrary
+            # sampled indices cross client shards), which cannot run inside
+            # shard_map; with FULL participation the gather is the
+            # identity, so the sharded round rides the scan directly.
+            raise NotImplementedError(
+                "the sharded scan requires full participation with the "
+                "client count divisible by the mesh "
+                f"(clients_per_round={cpr}, total={n_total}, "
+                f"shards={self.n_shards}); subsampled mesh rounds use the "
+                "host loop")
 
         scan_fn = getattr(self, "_rounds_scan_fn", None)
         if scan_fn is None:
@@ -263,13 +357,13 @@ class FedAvgAPI(FederatedLoop):
             from fedml_tpu.data.batching import gather_clients
 
             def body(fed, net, key):
-                if cpr == n_total:
-                    idx = jnp.arange(n_total)
+                if self.mesh is not None or cpr == n_total:
+                    sub = fed  # full participation: gather is the identity
                 else:
                     idx = jax.random.choice(
                         jax.random.fold_in(key, 0x5A), n_total, (cpr,),
                         replace=False)
-                sub = gather_clients(fed, idx)
+                    sub = gather_clients(fed, idx)
                 w = sub.counts.astype(jnp.float32)
                 # The round key is used AS the host loop uses rnd_rng, so
                 # with full participation this scan is bit-equal to it.
@@ -284,15 +378,36 @@ class FedAvgAPI(FederatedLoop):
                 return jax.lax.scan(
                     lambda n, k: body(fed, n, k), net, keys)
 
-            scan_fn = jax.jit(scan_fn)
+            # Donate the incoming net: the caller always replaces
+            # self.net with the scan result, so XLA may reuse the old
+            # params' buffers instead of holding both copies live.
+            scan_fn = jax.jit(scan_fn, donate_argnums=(0,))
             self._rounds_scan_fn = scan_fn
+
+        fed = self.train_fed
+        if self.mesh is not None:
+            # Pin client shards to their devices for the whole scan (the
+            # host loop re-lays them out every round via the eager gather).
+            # The resharded copy REPLACES self.train_fed so repeat calls
+            # don't pay a full-dataset reshard each time or transiently
+            # hold two device-resident copies.
+            cached = getattr(self, "_mesh_pinned_fed", None)
+            if cached is None or cached is not fed:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                shard = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+                fed = jax.tree.map(lambda a: jax.device_put(a, shard), fed)
+                self.train_fed = self._mesh_pinned_fed = fed
+            else:
+                fed = cached
 
         # Reproduce the host loop's per-round rng chain exactly.
         keys = []
         for _ in range(n_rounds):
             self.rng, rnd = jax.random.split(self.rng)
             keys.append(rnd)
-        self.net, losses = scan_fn(self.net, self.train_fed, jnp.stack(keys))
+        self.net, losses = scan_fn(self.net, fed, jnp.stack(keys))
         return losses
 
     def _eval_net(self):

@@ -56,6 +56,8 @@ class FedBNAPI(FedAvgAPI):
     model is indistinguishable from FedAvg and almost certainly a
     misconfiguration)."""
 
+    supports_streaming = False  # per-client norm params live device-resident
+
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         if self.mesh is not None:

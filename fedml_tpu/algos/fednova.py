@@ -25,7 +25,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
-from fedml_tpu.data.batching import gather_clients
 from fedml_tpu.trainer.local import NetState
 
 
@@ -40,7 +39,7 @@ class FedNovaAPI(FedAvgAPI):
 
     def train_one_round(self, round_idx: int):
         idx, wmask = self.sample_round(round_idx)
-        sub = gather_clients(self.train_fed, idx)
+        sub = self._cohort(round_idx, idx)
         counts = np.asarray(sub.counts, np.float64) * np.asarray(wmask, np.float64)
         tau = self._local_steps(sub.counts)
         n_total = counts.sum()

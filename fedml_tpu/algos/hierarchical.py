@@ -31,6 +31,8 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
     """``group_ids[client] -> group`` assigns every client to a group;
     ``cfg.group_comm_round`` controls the inner loop."""
 
+    supports_streaming = False  # per-group device gathers bypass run_round
+
     def __init__(self, model, train_fed, test_global, cfg, group_ids: Sequence[int],
                  mesh=None, **kwargs):
         super().__init__(model, train_fed, test_global, cfg, mesh=mesh, **kwargs)

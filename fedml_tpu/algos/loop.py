@@ -59,9 +59,17 @@ class FederatedLoop:
 
         When the subclass built a fused single-device round
         (``round_fn_fused``), the gather happens inside the jit — one
-        dispatch per round instead of five."""
+        dispatch per round instead of five. With a host-resident
+        ``FederatedStore`` (``self._streaming``), the cohort was gathered
+        on host (double-buffered) and the round consumes it directly."""
         self.rng, rnd_rng = jax.random.split(self.rng)
         idx, wmask = self.sample_round(round_idx)
+        if getattr(self, "_streaming", False):
+            sub = self._stream_cohort(round_idx, idx)
+            weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
+            return self.round_fn(
+                self.net, sub.x, sub.y, sub.mask, weights, weights, rnd_rng
+            )
         if self.round_fn_fused is not None:
             return self.round_fn_fused(
                 self.net, self.train_fed,
@@ -113,6 +121,8 @@ class FederatedLoop:
         Clients with no samples are excluded from the worst-client stats.
         """
         f = arrays if arrays is not None else self.train_fed
+        if arrays is None and getattr(self, "_streaming", False):
+            return self._evaluate_on_clients_streaming(prefix)
         net = self._eval_net()
         m = self._per_client_eval()(net, f.x, f.y, f.mask)
         num = m["num"]
@@ -125,6 +135,42 @@ class FederatedLoop:
             f"{prefix}_loss": float(jnp.sum(m["loss"] * num) / n),
             f"worst_client_{prefix.split('_')[-1]}_acc": float(worst_acc),
             f"worst_client_{prefix.split('_')[-1]}_loss": float(worst_loss),
+        }
+
+    def _evaluate_on_clients_streaming(
+            self, prefix: str, chunk: int = 256) -> Dict[str, float]:
+        """Store-backed variant of evaluate_on_clients: iterate the client
+        population in host-gathered chunks (device holds one chunk at a
+        time), accumulating the same weighted-mean + worst-client stats.
+        The reference walks all 3400 FEMNIST clients per eval the same
+        way, one at a time (FedAVGAggregator.py:117-133)."""
+        import numpy as np
+
+        store = self.train_fed
+        net = self._eval_net()
+        per = self._per_client_eval()
+        tot_acc = tot_loss = tot_n = 0.0
+        worst_acc, worst_loss = float("inf"), float("-inf")
+        for lo in range(0, store.num_clients, chunk):
+            idx = np.arange(lo, min(lo + chunk, store.num_clients))
+            sub = store.gather_cohort(idx)
+            m = per(net, sub.x, sub.y, sub.mask)
+            num = np.asarray(m["num"])
+            acc = np.asarray(m["accuracy"])
+            loss = np.asarray(m["loss"])
+            present = num > 0
+            tot_acc += float((acc * num).sum())
+            tot_loss += float((loss * num).sum())
+            tot_n += float(num.sum())
+            if present.any():
+                worst_acc = min(worst_acc, float(acc[present].min()))
+                worst_loss = max(worst_loss, float(loss[present].max()))
+        n = max(tot_n, 1.0)
+        return {
+            f"{prefix}_acc": tot_acc / n,
+            f"{prefix}_loss": tot_loss / n,
+            f"worst_client_{prefix.split('_')[-1]}_acc": worst_acc,
+            f"worst_client_{prefix.split('_')[-1]}_loss": worst_loss,
         }
 
     def train(self) -> List[Dict[str, float]]:

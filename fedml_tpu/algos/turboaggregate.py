@@ -34,7 +34,6 @@ import numpy as np
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.core import mpc
-from fedml_tpu.data.batching import gather_clients
 
 
 class TurboAggregateAPI(FedAvgAPI):
@@ -76,7 +75,7 @@ class TurboAggregateAPI(FedAvgAPI):
 
     def train_one_round(self, round_idx: int) -> Dict[str, float]:
         idx, wmask = self.sample_round(round_idx)
-        sub = gather_clients(self.train_fed, idx)
+        sub = self._cohort(round_idx, idx)
         weights = np.asarray(sub.counts, np.float64) * np.asarray(wmask)
         if self.dropout_mask is not None:
             weights[self.dropout_mask] = 0.0

@@ -1,0 +1,176 @@
+"""Host-resident federated dataset with per-round cohort streaming.
+
+The resident ``FederatedArrays`` layout (batching.py) pads EVERY client to
+the size of the largest one and keeps the whole dataset in device memory —
+elegant at 128 clients, impossible at the reference's client scales
+(FederatedEMNIST: 3,400 writers, ``FederatedEMNIST/data_loader.py:15``;
+StackOverflow: 342,477 users, ``stackoverflow_nwp/data_loader.py``), and
+on power-law partitions (LEAF MNIST, ``MNIST/data_loader.py:87``) one
+giant client inflates every client's padded rows.
+
+``FederatedStore`` keeps the dataset as host numpy in CSR form (one flat
+sample array sorted by client + offsets) and materializes only the
+sampled cohort per round:
+
+  - device memory per round = cohort_size x cohort_max_steps x batch —
+    independent of the total client count;
+  - the cohort is padded to ITS OWN max count (bucketed to a power of two
+    so XLA sees a handful of shapes, not one per round), so power-law
+    tails no longer tax every round;
+  - ``gather_cohort`` returns a regular ``FederatedArrays``, so the
+    existing jitted rounds (vmap and shard_map) consume it unchanged;
+  - ``CohortPrefetcher`` overlaps the next round's host gather + H2D
+    transfer with the current round's compute (double buffering): JAX
+    dispatch is async, so ``jnp.asarray`` from the worker thread starts
+    the copy immediately.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.data.batching import FederatedArrays
+
+
+def _bucket_steps(steps: int) -> int:
+    """Round up to a power of two: bounds the number of distinct cohort
+    shapes (→ jit retraces) at log2(max_steps)."""
+    steps = max(int(steps), 1)
+    return 1 << (steps - 1).bit_length()
+
+
+class FederatedStore:
+    """CSR host store over a federated dataset.
+
+    ``client_indices`` maps client id (0..C-1) to index arrays into
+    ``(x, y)`` — the same contract as ``build_federated_arrays``. The
+    store copies samples into client-sorted order once so each client's
+    block is one contiguous slice at gather time.
+    """
+
+    def __init__(
+        self,
+        x: np.ndarray,
+        y: np.ndarray,
+        client_indices: Dict[int, np.ndarray],
+        batch_size: int,
+        max_steps: Optional[int] = None,
+    ):
+        n_clients = len(client_indices)
+        counts = np.array(
+            [len(client_indices[c]) for c in range(n_clients)], np.int64)
+        if max_steps is not None:
+            counts = np.minimum(counts, max_steps * batch_size)
+        order = np.concatenate(
+            [np.asarray(client_indices[c])[: counts[c]]
+             for c in range(n_clients)]) if counts.sum() else \
+            np.zeros((0,), np.int64)
+        self._x = np.ascontiguousarray(x[order])
+        self._y = np.ascontiguousarray(y[order])
+        self.offsets = np.concatenate([[0], np.cumsum(counts)])
+        self.counts = counts.astype(np.int32)
+        self.batch_size = int(batch_size)
+        self.max_steps = max_steps
+        self.num_clients = n_clients
+
+    def example_input(self) -> np.ndarray:
+        """One zero batch with the store's sample shape/dtype — what model
+        init needs (mirrors ``train_fed.x[0, 0]`` on the resident path)."""
+        return np.zeros((self.batch_size,) + self._x.shape[1:], self._x.dtype)
+
+    def nbytes(self) -> int:
+        return self._x.nbytes + self._y.nbytes
+
+    def gather_cohort(self, indices) -> FederatedArrays:
+        """Materialize the sampled clients as a device-resident
+        ``FederatedArrays`` padded to the COHORT max count (power-of-two
+        step bucket). Duplicate indices are fine (pad_to_multiple repeats
+        index 0 with weight 0)."""
+        idx = np.asarray(indices)
+        k = len(idx)
+        ccounts = self.counts[idx]
+        bs = self.batch_size
+        steps = _bucket_steps(int(np.ceil(max(int(ccounts.max()), 1) / bs)))
+        cap = steps * bs
+
+        xs = np.zeros((k, cap) + self._x.shape[1:], self._x.dtype)
+        ys = np.zeros((k, cap) + self._y.shape[1:], self._y.dtype)
+        mask = np.zeros((k, cap), np.float32)
+        for j, c in enumerate(idx):
+            lo, hi = int(self.offsets[c]), int(self.offsets[c + 1])
+            n = hi - lo
+            if n == 0:
+                continue
+            xs[j, :n] = self._x[lo:hi]
+            ys[j, :n] = self._y[lo:hi]
+            mask[j, :n] = 1.0
+            if n < cap:  # pad with the client's own first sample (masked)
+                xs[j, n:] = self._x[lo]
+                ys[j, n:] = self._y[lo]
+
+        def split(a):
+            return a.reshape((k, steps, bs) + a.shape[2:])
+
+        return FederatedArrays(
+            x=jnp.asarray(split(xs)),
+            y=jnp.asarray(split(ys)),
+            mask=jnp.asarray(split(mask)),
+            counts=jnp.asarray(ccounts, jnp.int32),
+        )
+
+
+class CohortPrefetcher:
+    """Double buffer: prepare round r+1's cohort (host gather + async H2D)
+    on a worker thread while round r computes. ``get`` blocks on the
+    in-flight preparation only if it has not finished yet."""
+
+    def __init__(self, store: FederatedStore):
+        self.store = store
+        self._pending: Dict[int, threading.Thread] = {}
+        self._ready: Dict[int, tuple] = {}  # round -> (indices, cohort)
+        self._lock = threading.Lock()
+
+    def prefetch(self, round_idx: int, indices) -> None:
+        indices = np.asarray(indices)
+        with self._lock:
+            if round_idx in self._pending or round_idx in self._ready:
+                return
+
+        def work():
+            try:
+                cohort = self.store.gather_cohort(indices)
+                with self._lock:
+                    self._ready[round_idx] = (indices, cohort)
+            finally:
+                # Always clear pending — a worker failure (host OOM, bad
+                # index) must not permanently block future prefetches for
+                # this round; get() then re-gathers synchronously and the
+                # real exception surfaces in the caller's context.
+                with self._lock:
+                    self._pending.pop(round_idx, None)
+
+        t = threading.Thread(target=work, daemon=True)
+        with self._lock:
+            self._pending[round_idx] = t
+        t.start()
+
+    def get(self, round_idx: int, indices) -> FederatedArrays:
+        with self._lock:
+            t = self._pending.get(round_idx)
+        if t is not None:
+            t.join()
+        with self._lock:
+            hit = self._ready.pop(round_idx, None)
+            # Drop stale buffers (a user skipping rounds must not leak).
+            for r in [r for r in self._ready if r < round_idx]:
+                self._ready.pop(r)
+        # The prefetched cohort is only valid for the EXACT index list the
+        # caller now wants — sampling inputs may have changed between the
+        # prefetch and the round (cfg mutation, subclass overrides).
+        if hit is not None and np.array_equal(hit[0], np.asarray(indices)):
+            return hit[1]
+        return self.store.gather_cohort(indices)

@@ -236,3 +236,58 @@ def test_evaluate_on_clients_matches_manual():
     assert np.isfinite(got_t["clients_test_acc"])
     # the empty client contributed nothing (num=0 row)
     assert float(np.asarray(test_arrays.counts)[3]) == 0.0
+
+
+def test_sharded_scan_bit_equal_to_sharded_host_loop():
+    """Full-participation whole-run scan on a client MESH: the shard_map
+    round rides the lax.scan (the per-round gather is the identity), and
+    must equal the sharded host loop exactly — same rng chain, same
+    round_fn, client shards pinned across rounds."""
+    import jax
+
+    from fedml_tpu.algos import FedAvgAPI, FedConfig
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    x, y = make_classification(16 * 24, n_features=8, n_classes=4)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 16), 8)
+    cfg = FedConfig(client_num_in_total=16, client_num_per_round=16,
+                    comm_round=4, epochs=2, batch_size=8, lr=0.2)
+    mesh = client_mesh(8)
+
+    host = FedAvgAPI(create_model("lr", input_dim=8, num_classes=4), fed,
+                     None, cfg, mesh=mesh)
+    host_losses = [host.train_one_round(r)["train_loss"] for r in range(4)]
+
+    dev = FedAvgAPI(create_model("lr", input_dim=8, num_classes=4), fed,
+                    None, cfg, mesh=mesh)
+    dev_losses = dev.train_rounds_on_device(4)
+
+    np.testing.assert_allclose(np.asarray(dev_losses),
+                               np.asarray(host_losses), rtol=1e-6, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(host.net.params),
+                    jax.tree.leaves(dev.net.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_scan_rejects_subsampling():
+    import pytest
+
+    from fedml_tpu.algos import FedAvgAPI, FedConfig
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    x, y = make_classification(16 * 8, n_features=8, n_classes=4)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 16), 8)
+    cfg = FedConfig(client_num_in_total=16, client_num_per_round=8,
+                    comm_round=2, epochs=1, batch_size=8, lr=0.2)
+    api = FedAvgAPI(create_model("lr", input_dim=8, num_classes=4), fed,
+                    None, cfg, mesh=client_mesh(8))
+    with pytest.raises(NotImplementedError, match="full participation"):
+        api.train_rounds_on_device(2)

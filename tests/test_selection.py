@@ -7,7 +7,7 @@ import pytest
 
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.fedavg import FedAvgAPI
-from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.sampling import sample_clients, sample_clients_weighted
 from fedml_tpu.data.batching import build_federated_arrays
 from fedml_tpu.models.lr import LogisticRegression
 
@@ -48,7 +48,8 @@ def test_pow_d_picks_highest_loss_candidates():
         api.train_one_round(r)
     round_idx = 7
     idx, wmask = api.sample_round(round_idx)
-    candidates = sample_clients(round_idx, 8, 6)
+    # pow_d draws candidates proportional to data fraction (Cho et al.).
+    candidates = sample_clients_weighted(round_idx, 8, 6, np.asarray(fed.counts))
     chosen = set(int(i) for i, w in zip(idx, wmask) if w)
     assert chosen <= set(int(c) for c in candidates)
     # the chosen two have the highest eval losses among the candidates

@@ -1,0 +1,213 @@
+"""Host-resident FederatedStore: cohort streaming equals the resident
+path, power-law bucketing bounds device memory, reference-scale client
+counts are representable, and incompatible algorithms refuse loudly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.data.batching import build_federated_arrays, gather_clients
+from fedml_tpu.data.store import CohortPrefetcher, FederatedStore, _bucket_steps
+from fedml_tpu.models.lr import LogisticRegression
+
+
+def _classification(n_clients, per, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d)
+    x = rng.randn(n_clients * per, d).astype(np.float32)
+    y = (x @ w > 0).astype(np.int32)
+    parts = {c: np.arange(c * per, (c + 1) * per) for c in range(n_clients)}
+    return x, y, parts
+
+
+def _cfg(n, cpr, rounds=3, batch=16, **kw):
+    kw.setdefault("lr", 0.3)
+    return FedConfig(client_num_in_total=n, client_num_per_round=cpr,
+                     comm_round=rounds, epochs=1, batch_size=batch,
+                     frequency_of_the_test=1000, **kw)
+
+
+def test_bucket_steps_powers_of_two():
+    assert [_bucket_steps(s) for s in (0, 1, 2, 3, 4, 5, 9, 64, 65)] == \
+        [1, 1, 2, 4, 4, 8, 16, 64, 128]
+
+
+def test_gather_cohort_matches_resident_gather():
+    """With equal counts on a power-of-two step grid, the store's host
+    gather must produce byte-identical arrays to the resident device
+    gather (same padding rule: client's own first sample, masked)."""
+    x, y, parts = _classification(8, 64)
+    resident = build_federated_arrays(x, y, parts, batch_size=16)
+    store = FederatedStore(x, y, parts, batch_size=16)
+    idx = np.array([5, 1, 6])
+    a = store.gather_cohort(idx)
+    b = gather_clients(resident, jnp.asarray(idx))
+    for lhs, rhs in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(lhs), np.asarray(rhs))
+
+
+def test_streaming_rounds_equal_resident_rounds():
+    """Equal-count clients (steps already a power of two) → the streaming
+    cohort is identical to the resident gather, so whole training rounds
+    must match the resident path exactly (same rng chain, same round_fn)."""
+    x, y, parts = _classification(8, 64)
+    resident = FedAvgAPI(LogisticRegression(num_classes=2),
+                         build_federated_arrays(x, y, parts, batch_size=16),
+                         None, _cfg(8, 4))
+    streaming = FedAvgAPI(LogisticRegression(num_classes=2),
+                          FederatedStore(x, y, parts, batch_size=16),
+                          None, _cfg(8, 4))
+    for r in range(3):
+        lr_ = resident.train_one_round(r)["train_loss"]
+        ls = streaming.train_one_round(r)["train_loss"]
+        assert np.isclose(lr_, ls, rtol=1e-6), (r, lr_, ls)
+    for a, b in zip(jax.tree.leaves(resident.net.params),
+                    jax.tree.leaves(streaming.net.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_streaming_sharded_matches_resident_sharded():
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    x, y, parts = _classification(16, 32)
+    mesh = client_mesh(8)
+    res = FedAvgAPI(LogisticRegression(num_classes=2),
+                    build_federated_arrays(x, y, parts, batch_size=16),
+                    None, _cfg(16, 8, batch=16), mesh=mesh)
+    st = FedAvgAPI(LogisticRegression(num_classes=2),
+                   FederatedStore(x, y, parts, batch_size=16),
+                   None, _cfg(16, 8, batch=16), mesh=mesh)
+    for r in range(2):
+        res.train_one_round(r)
+        st.train_one_round(r)
+    for a, b in zip(jax.tree.leaves(res.net.params),
+                    jax.tree.leaves(st.net.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_power_law_cohorts_do_not_pay_the_giant():
+    """The resident layout pads every client to the max count; the store
+    pads each cohort to ITS OWN max. A round that skips the power-law
+    giant must be ~counts.max()/cohort_max smaller on device."""
+    rng = np.random.RandomState(0)
+    counts = [1024, 17, 9, 30, 12, 25, 8, 21]
+    tot = sum(counts)
+    x = rng.randn(tot, 4).astype(np.float32)
+    y = (rng.rand(tot) > 0.5).astype(np.int32)
+    edges = np.cumsum([0] + counts)
+    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(8)}
+    store = FederatedStore(x, y, parts, batch_size=32)
+
+    small = store.gather_cohort(np.array([1, 3, 5]))  # max count 30
+    assert small.x.shape[1] == 1  # ceil(30/32)=1 step
+    giant = store.gather_cohort(np.array([0, 2]))  # max count 1024
+    assert giant.x.shape[1] == 32  # ceil(1024/32)=32 steps
+    # Training over rounds stays finite and bounded.
+    api = FedAvgAPI(LogisticRegression(num_classes=2), store, None,
+                    _cfg(8, 3, rounds=4, batch=32))
+    for r in range(4):
+        assert np.isfinite(api.train_one_round(r)["train_loss"])
+
+
+def test_50k_client_stackoverflow_shaped_store():
+    """The client axis the reference scales on (stackoverflow_nwp:
+    342,477 users) must be REPRESENTABLE and trainable: 50k synthetic
+    next-word-prediction clients, host-resident, rounds touch only the
+    sampled cohort (device cohort is ~4 orders of magnitude smaller than
+    the dataset)."""
+    from functools import partial
+
+    from fedml_tpu.models.rnn import RNNStackOverflow
+    from fedml_tpu.trainer.local import seq_softmax_ce
+
+    C, T, V = 50_000, 10, 32
+    rng = np.random.RandomState(0)
+    counts = 1 + (rng.pareto(2.0, C) * 3).astype(np.int64).clip(0, 9)
+    tot = int(counts.sum())
+    x = rng.randint(1, V, (tot, T)).astype(np.int32)
+    y = np.roll(x, -1, axis=1)
+    edges = np.concatenate([[0], np.cumsum(counts)])
+    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(C)}
+    store = FederatedStore(x, y, parts, batch_size=5)
+    assert store.num_clients == C
+
+    api = FedAvgAPI(
+        RNNStackOverflow(vocab_size=V, embedding_dim=8, hidden_size=16),
+        store, None,
+        _cfg(C, 10, rounds=3, batch=5, lr=0.1),
+        loss_fn=partial(seq_softmax_ce, pad_id=0), pad_id=0)
+    for r in range(3):
+        assert np.isfinite(api.train_one_round(r)["train_loss"])
+    # Device-side cohort footprint is independent of C.
+    cohort = store.gather_cohort(np.arange(10))
+    cohort_bytes = sum(np.asarray(l).nbytes for l in jax.tree.leaves(cohort))
+    assert store.nbytes() > 50 * cohort_bytes
+
+
+def test_streaming_evaluate_on_clients_matches_resident():
+    x, y, parts = _classification(20, 32)
+    res = FedAvgAPI(LogisticRegression(num_classes=2),
+                    build_federated_arrays(x, y, parts, batch_size=16),
+                    None, _cfg(20, 20, batch=16))
+    st = FedAvgAPI(LogisticRegression(num_classes=2),
+                   FederatedStore(x, y, parts, batch_size=16),
+                   None, _cfg(20, 20, batch=16))
+    a = res.evaluate_on_clients()
+    b = st._evaluate_on_clients_streaming("clients_train", chunk=7)
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6)
+
+
+def test_streaming_pow_d_selection():
+    x, y, parts = _classification(12, 32)
+    api = FedAvgAPI(LogisticRegression(num_classes=2),
+                    FederatedStore(x, y, parts, batch_size=16), None,
+                    _cfg(12, 3, rounds=4, batch=16,
+                         client_selection="pow_d", pow_d_candidates=6))
+    for r in range(4):
+        assert np.isfinite(api.train_one_round(r)["train_loss"])
+
+
+def test_prefetcher_returns_same_cohort():
+    x, y, parts = _classification(8, 48)
+    store = FederatedStore(x, y, parts, batch_size=16)
+    pf = CohortPrefetcher(store)
+    idx = np.array([2, 7, 4])
+    pf.prefetch(3, idx)
+    got = pf.get(3, idx)
+    direct = store.gather_cohort(idx)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(direct)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # get without a prior prefetch falls through to a direct gather
+    got2 = pf.get(9, idx)
+    np.testing.assert_array_equal(np.asarray(got2.counts),
+                                  np.asarray(direct.counts))
+
+
+def test_incompatible_algorithms_reject_store():
+    from fedml_tpu.algos.ditto import DittoAPI
+    from fedml_tpu.algos.scaffold import ScaffoldAPI
+
+    x, y, parts = _classification(8, 32)
+    store = FederatedStore(x, y, parts, batch_size=16)
+    for cls in (ScaffoldAPI, DittoAPI):
+        with pytest.raises(NotImplementedError, match="streaming|resident"):
+            cls(LogisticRegression(num_classes=2), store, None,
+                _cfg(8, 4, batch=16))
+    api = FedAvgAPI(LogisticRegression(num_classes=2), store, None,
+                    _cfg(8, 8, batch=16))
+    with pytest.raises(NotImplementedError, match="resident|host loop"):
+        api.train_rounds_on_device(2)
+
+
+def test_max_steps_truncates_clients():
+    x, y, parts = _classification(4, 100)
+    store = FederatedStore(x, y, parts, batch_size=16, max_steps=2)
+    assert int(store.counts.max()) == 32  # 2 steps x 16
+    sub = store.gather_cohort(np.array([0, 1]))
+    assert sub.x.shape[1] == 2
