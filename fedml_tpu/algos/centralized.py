@@ -5,6 +5,12 @@ dataset conventionally; used as the accuracy reference for the federated ==
 centralized equivalence test (the reference's CI asserts 3-decimal equality,
 CI-script-fedavg.sh:40-45; our pytest asserts it numerically, see
 tests/test_equivalence.py).
+
+Mesh data parallelism (the reference's DistributedDataParallel path,
+fedml_experiments/centralized/main.py:376) is expressed TPU-natively: the
+batch axis of the ``[S, B, ...]`` pack is sharded over the mesh and params
+stay replicated — XLA/GSPMD inserts the gradient all-reduce (the psum DDP
+does by hand), so the training math is the SAME function, just annotated.
 """
 
 from __future__ import annotations
@@ -22,14 +28,42 @@ from fedml_tpu.trainer.local import (
 
 
 class CentralizedTrainer:
-    def __init__(self, model, cfg, loss_fn=softmax_ce):
+    """``mesh=None`` → single device. With a mesh, every global batch is
+    split over ``mesh.axis_names[0]`` (``cfg.batch_size`` must divide by
+    the mesh size); results are bit-for-bit independent of the mesh size
+    up to float reduction order."""
+
+    def __init__(self, model, cfg, loss_fn=softmax_ce, mesh=None):
         self.cfg = cfg
+        self.mesh = mesh
         self.fns = model_fns(model)
         optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
-        self.train_fn = jax.jit(
-            make_local_train_fn_from_cfg(self.fns.apply, optimizer, cfg, loss_fn)
-        )
-        self.eval_fn = jax.jit(make_eval_fn(self.fns.apply, loss_fn))
+        train_fn = make_local_train_fn_from_cfg(self.fns.apply, optimizer, cfg, loss_fn)
+        eval_fn = make_eval_fn(self.fns.apply, loss_fn)
+        if mesh is None:
+            self.train_fn = jax.jit(train_fn)
+            self.eval_fn = jax.jit(eval_fn)
+        else:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            axis = mesh.axis_names[0]
+            n = int(mesh.shape[axis])
+            if cfg.batch_size % n:
+                raise ValueError(
+                    f"batch_size={cfg.batch_size} must divide by the "
+                    f"{n}-device mesh for batch-axis data parallelism")
+            repl = NamedSharding(mesh, P())
+            data = NamedSharding(mesh, P(None, axis))  # [S, B, ...] → B split
+            self.train_fn = jax.jit(
+                train_fn,
+                in_shardings=(repl, data, data, data, repl),
+                out_shardings=(repl, repl),
+            )
+            # Eval stays unsharded: eval sets arrive with arbitrary batch
+            # sizes (divisibility is a TRAIN-loop contract), and replicated
+            # eval of a replicated model is correct on any mesh.
+            self.eval_fn = jax.jit(eval_fn)
         self.rng, init_rng = jax.random.split(jax.random.PRNGKey(cfg.seed))
         self.net = None
         self._init_rng = init_rng

@@ -1,0 +1,81 @@
+"""Centralized baseline CLI — the reference's accuracy anchor
+(fedml_experiments/centralized/main.py, 382 LoC; DDP at :376).
+
+Trains the pooled (non-federated) dataset conventionally over the same
+model/dataset registries as the federated mains; ``--num_devices N``
+shards every global batch over an N-device mesh (the DDP equivalent —
+GSPMD inserts the gradient all-reduce). ``--comm_round`` counts outer
+passes of ``--epochs`` epochs each, so total epochs = comm_round x epochs
+(the reference's single ``--epochs`` loop with eval cadence folded in).
+
+Usage:
+  python -m fedml_tpu.exp.main_centralized --dataset cifar10 \
+      --model resnet56 --batch_size 64 --lr 0.001 --epochs 5 \
+      --comm_round 20 --num_devices 8
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+
+
+def run_centralized(args):
+    from functools import partial
+
+    from fedml_tpu.algos.centralized import CentralizedTrainer
+    from fedml_tpu.exp.args import config_from_args
+    from fedml_tpu.exp.run import SEQ_DATASETS
+    from fedml_tpu.exp.setup import (
+        build_mesh,
+        create_model_for,
+        global_test_batches,
+        global_train_batches,
+        load_data,
+    )
+    from fedml_tpu.trainer.local import seq_softmax_ce, softmax_ce
+
+    fed = load_data(args)
+    train = global_train_batches(fed, args.batch_size)
+    test = global_test_batches(fed, args.batch_size)
+    model = create_model_for(args, fed)
+    cfg = config_from_args(args)
+    mesh = build_mesh(args.num_devices)
+
+    if args.dataset in SEQ_DATASETS:
+        pad_id = -1 if args.dataset == "shakespeare" else 0
+        loss_fn = partial(seq_softmax_ce, pad_id=pad_id)
+    else:
+        loss_fn = softmax_ce
+
+    if train is None:
+        raise ValueError(
+            f"dataset {args.dataset!r} produced no pooled train split "
+            "(train_data_global is empty); the centralized baseline needs "
+            "one")
+    trainer = CentralizedTrainer(model, cfg, loss_fn=loss_fn, mesh=mesh)
+    history = []
+    for r in range(cfg.comm_round):
+        metrics = {"round": r, "train_loss": trainer.train(*train)}
+        if (test is not None
+                and (r % cfg.frequency_of_the_test == 0
+                     or r == cfg.comm_round - 1)):
+            metrics.update(trainer.evaluate(*test))
+        logging.info("%s", json.dumps(metrics))
+        history.append(metrics)
+    print(json.dumps(history[-1]))
+    return trainer, history
+
+
+def main(argv=None):
+    from fedml_tpu.exp.args import parse_args
+
+    logging.basicConfig(level=logging.INFO,
+                        format="[Centralized %(asctime)s] %(message)s")
+    args = parse_args(sys.argv[1:] if argv is None else argv)
+    return run_centralized(args)
+
+
+if __name__ == "__main__":
+    main()

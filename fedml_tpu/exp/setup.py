@@ -57,14 +57,25 @@ def create_model_for(args, fed: FederatedDataset):
     return create_model(name, num_classes=ncls)
 
 
+def _pooled_batches(batches, batch_size: int):
+    if not batches:
+        return None
+    xs = np.concatenate([b[0] for b in batches])
+    ys = np.concatenate([b[1] for b in batches])
+    return batch_global(xs, ys, batch_size)
+
+
 def global_test_batches(fed: FederatedDataset, batch_size: int):
     """Concatenate the global test batches into the on-device
     ``(x, y, mask)`` eval layout."""
-    if not fed.test_data_global:
-        return None
-    xs = np.concatenate([b[0] for b in fed.test_data_global])
-    ys = np.concatenate([b[1] for b in fed.test_data_global])
-    return batch_global(xs, ys, batch_size)
+    return _pooled_batches(fed.test_data_global, batch_size)
+
+
+def global_train_batches(fed: FederatedDataset, batch_size: int):
+    """Pooled TRAIN set in the same layout — what the centralized baseline
+    trains on (the reference pools the non-IID dataset the same way,
+    fedml_api/centralized/centralized_trainer.py)."""
+    return _pooled_batches(fed.train_data_global, batch_size)
 
 
 def build_mesh(num_devices: int):

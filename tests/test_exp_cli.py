@@ -90,3 +90,34 @@ def test_run_fedseg_cli():
     _, history = run(args, algorithm="FedSeg")
     assert np.isfinite(history[-1]["train_loss"])
     assert "mIoU" in history[-1]
+
+
+def test_centralized_cli_single_and_mesh_dp():
+    """Centralized baseline CLI (reference fedml_experiments/centralized/
+    main.py): trains on the pooled dataset, and the mesh data-parallel
+    path (DDP equivalent, :376) matches the single-device run numerically
+    — same function, batch axis sharded, GSPMD all-reduces grads."""
+    import jax
+
+    from fedml_tpu.exp.main_centralized import run_centralized
+
+    base = [
+        "--model", "lr", "--dataset", "synthetic_1_1",
+        "--client_num_in_total", "8", "--batch_size", "8",
+        "--comm_round", "3", "--epochs", "1", "--lr", "0.1",
+        "--frequency_of_the_test", "2",
+    ]
+    t1, h1 = run_centralized(parse_args(base))
+    t8, h8 = run_centralized(parse_args(base + ["--num_devices", "8"]))
+    assert np.isfinite(h1[-1]["train_loss"])
+    assert "accuracy" in h1[-1]
+    np.testing.assert_allclose(h1[-1]["train_loss"], h8[-1]["train_loss"],
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(t1.net.params),
+                    jax.tree.leaves(t8.net.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+    # Batch size must divide the mesh.
+    with pytest.raises(ValueError, match="divide"):
+        run_centralized(parse_args(base[:-4] + [
+            "--batch_size", "9", "--num_devices", "8", "--comm_round", "1"]))
