@@ -29,22 +29,30 @@ def _topic(receiver_id: int) -> str:
 
 
 class MqttCommManager(BaseCommunicationManager):
+    """``client`` injects a paho-compatible MQTT client (an in-memory
+    double in tests — the reference's loopback self-test,
+    mqtt_comm_manager.py:130-146, needs a live broker; ours does not);
+    ``None`` constructs the real paho client."""
+
     def __init__(self, host: str, port: int, rank: int, size: int,
-                 topic_prefix: str = "fedml", keepalive: int = 180):
-        try:
-            import paho.mqtt.client as mqtt
-        except ImportError as e:  # pragma: no cover - env without paho
-            raise ImportError(
-                "MqttCommManager requires paho-mqtt and a reachable broker; "
-                "pip install paho-mqtt (the simulated/collective and TCP "
-                "backends have no such dependency)") from e
+                 topic_prefix: str = "fedml", keepalive: int = 180,
+                 client=None):
+        if client is None:
+            try:
+                import paho.mqtt.client as mqtt
+            except ImportError as e:  # pragma: no cover - env without paho
+                raise ImportError(
+                    "MqttCommManager requires paho-mqtt and a reachable "
+                    "broker; pip install paho-mqtt (the simulated/collective "
+                    "and TCP backends have no such dependency)") from e
+            client = mqtt.Client(
+                client_id=f"{topic_prefix}_{rank}_{uuid.uuid4().hex[:8]}")
 
         self.rank = rank
         self.size = size
         self.topic_prefix = topic_prefix
         self._observers: List[Observer] = []
-        self._client = mqtt.Client(
-            client_id=f"{topic_prefix}_{rank}_{uuid.uuid4().hex[:8]}")
+        self._client = client
         self._client.on_connect = self._on_connect
         self._client.on_message = self._on_message
         self._client.connect(host, port, keepalive)
