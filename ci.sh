@@ -34,6 +34,13 @@ python -m fedml_tpu.exp.run --algorithm QFedAvg --qffl_q 2.0 \
 python -m fedml_tpu.exp.run --algorithm Ditto --ditto_lam 0.1 \
     --model lr --dataset synthetic_1_1 $common
 
+echo "== centralized baseline (mesh data parallelism) =="
+python -m fedml_tpu.exp.main_centralized --model lr --dataset synthetic_1_1 \
+    --num_devices 8 $common
+
+echo "== reproduce-baselines wiring (synthetic sanity, one config) =="
+CI_LITE=1 bash scripts/reproduce_baselines.sh synthetic_lr > /dev/null
+
 echo "== DP-SGD clients (example-level privacy) =="
 python -m fedml_tpu.exp.main_fedavg --model lr --dataset synthetic_1_1 \
     --dp_clip 1.0 --dp_noise_multiplier 0.5 $common

@@ -1,0 +1,113 @@
+#!/bin/bash
+# Reproduce the reference's published accuracy baselines (BASELINE.md, all
+# three tables from /root/reference/benchmark/README.md:10-111) with the
+# exact hyperparameters, wired to this framework's CLIs.
+#
+# Usage:
+#   DATA_ROOT=/path/to/datasets scripts/reproduce_baselines.sh [config ...]
+#   CI_LITE=1 scripts/reproduce_baselines.sh          # synthetic sanity pass
+#
+# With DATA_ROOT set, each config points at the reference's on-disk layout
+# (docs/DATASETS.md documents the expected tree: $DATA_ROOT/MNIST/{train,test},
+# $DATA_ROOT/FederatedEMNIST/datasets, ...). Without it, the loaders fall
+# back to small synthetic writer-shaped data — the curves are then sanity
+# checks of the pipeline (REPRO.md records them), NOT the published numbers.
+#
+# CI_LITE=1 shrinks rounds so every config launches in seconds; results land
+# under runs/repro/<config>/.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATA_ROOT=${DATA_ROOT:-}
+CI_LITE=${CI_LITE:-0}
+
+data_arg() { # data_arg <subdir> → --data_dir flag when DATA_ROOT is set
+  if [ -n "$DATA_ROOT" ]; then echo "--data_dir $DATA_ROOT/$1"; fi
+}
+
+rounds() { # rounds <published> → CI-lite shrink
+  if [ "$CI_LITE" = "1" ]; then echo 2; else echo "$1"; fi
+}
+
+epochs() { # epochs <published> → CI-lite shrink (20-epoch silo rounds
+  if [ "$CI_LITE" = "1" ]; then echo 1; else echo "$1"; fi  # choke CPU CI)
+}
+
+run_cfg() { # run_cfg <name> <main> [args...]
+  local name=$1 main=$2; shift 2
+  echo "=== $name ==="
+  mkdir -p "runs/repro/$name"
+  python -m "fedml_tpu.exp.$main" "$@" \
+    --frequency_of_the_test 25 --run_dir "runs/repro/$name"
+}
+
+FILTERS=("$@")
+match() { # match <name> → run when no filter given or a filter is a substring
+  [ ${#FILTERS[@]} -eq 0 ] && return 0
+  for f in "${FILTERS[@]}"; do [[ $1 == *"$f"* ]] && return 0; done
+  return 1
+}
+
+# ---- Table 1: linear models (benchmark/README.md:10-14) --------------------
+match mnist_lr && run_cfg mnist_lr main_fedavg \
+  --dataset mnist --model lr $(data_arg MNIST) \
+  --client_num_in_total 1000 --client_num_per_round 10 --batch_size 10 \
+  --client_optimizer sgd --lr 0.03 --wd 0 --epochs 1 \
+  --comm_round "$(rounds 120)"          # published: >75% after >100 rounds
+
+match femnist_lr && run_cfg femnist_lr main_fedavg \
+  --dataset femnist --model lr $(data_arg FederatedEMNIST/datasets) \
+  --client_num_in_total 200 --client_num_per_round 10 --batch_size 10 \
+  --client_optimizer sgd --lr 0.003 --wd 0 --epochs 1 \
+  --comm_round "$(rounds 220)"          # published: 10-40% after >200 rounds
+
+match synthetic_lr && run_cfg synthetic_lr main_fedavg \
+  --dataset synthetic_1_1 --model lr \
+  --client_num_in_total 30 --client_num_per_round 10 --batch_size 10 \
+  --client_optimizer sgd --lr 0.01 --wd 0 --epochs 1 \
+  --comm_round "$(rounds 220)"          # published: >60% after >200 rounds
+
+# ---- Table 2: shallow NNs (benchmark/README.md:54-58) ----------------------
+match femnist_cnn && run_cfg femnist_cnn main_fedavg \
+  --dataset femnist --model cnn $(data_arg FederatedEMNIST/datasets) \
+  --client_num_in_total 3400 --client_num_per_round 10 --batch_size 20 \
+  --client_optimizer sgd --lr 0.1 --wd 0 --epochs 1 \
+  --comm_round "$(rounds 1500)"         # published: 84.9%
+
+match fed_cifar100_resnet18 && run_cfg fed_cifar100_resnet18 main_fedavg \
+  --dataset fed_cifar100 --model resnet18_gn $(data_arg fed_cifar100/datasets) \
+  --client_num_in_total 500 --client_num_per_round 10 --batch_size 20 \
+  --client_optimizer sgd --lr 0.1 --wd 0 --epochs 1 \
+  --comm_round "$(rounds 4000)"         # published: 44.7%
+
+match shakespeare_rnn && run_cfg shakespeare_rnn main_fedavg \
+  --dataset shakespeare --model rnn $(data_arg shakespeare) \
+  --client_num_in_total 715 --client_num_per_round 10 --batch_size 4 \
+  --client_optimizer sgd --lr 1.0 --wd 0 --epochs 1 \
+  --comm_round "$(rounds 1200)"         # published: 56.9%
+
+match stackoverflow_rnn && run_cfg stackoverflow_rnn main_fedavg \
+  --dataset stackoverflow_nwp --model rnn_stackoverflow \
+  $(data_arg stackoverflow/datasets) \
+  --client_num_in_total 342477 --client_num_per_round 50 --batch_size 16 \
+  --client_optimizer sgd --lr 0.3162 --wd 0 --epochs 1 \
+  --comm_round "$(rounds 1500)"         # published: 19.5% (lr = 10^-0.5)
+
+# ---- Table 3: cross-silo DNNs (benchmark/README.md:103-111) ----------------
+# LDA alpha=0.5 (hetero) and IID (homo); 10 silos, batch 64, SGD lr=0.001
+# wd=0.001, 20 local epochs, 100 rounds.
+for dataset in cifar10 cifar100 cinic10; do
+  for model in resnet56 mobilenet; do
+    for part in homo hetero; do
+      name="cross_silo_${dataset}_${model}_${part}"
+      match "$name" && run_cfg "$name" main_fedavg \
+        --dataset "$dataset" --model "$model" $(data_arg "$dataset") \
+        --partition_method "$part" --partition_alpha 0.5 \
+        --client_num_in_total 10 --client_num_per_round 10 --batch_size 64 \
+        --client_optimizer sgd --lr 0.001 --wd 0.001 --epochs "$(epochs 20)" \
+        --comm_round "$(rounds 100)"
+    done
+  done
+done
+
+echo "all requested baseline configs completed"
