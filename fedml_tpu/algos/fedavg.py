@@ -298,6 +298,44 @@ class FedAvgAPI(FederatedLoop):
         self.net = self._server_update(self.net, avg)
         return {"round": round_idx, "train_loss": float(loss)}
 
+    def train_rounds_pipelined(self, n_rounds: int, start_round: int = 0):
+        """Run ``n_rounds`` host-loop rounds back-to-back WITHOUT the
+        per-round host sync: ``train_one_round``'s ``float(loss)`` blocks
+        until the round finishes, serializing device compute against the
+        next round's host work. Here every round's jitted dispatch is
+        enqueued as soon as its cohort is ready — async dispatch chains
+        the net dependency, so the device trains round r while the host
+        samples/gathers round r+1 (with the streaming store's prefetcher
+        this pipelines host gather + H2D + compute three-deep). Losses
+        are fetched once at the end. Per-round semantics are identical to
+        calling ``train_one_round`` in a loop (tested bit-equal) — use
+        this between eval points; it skips the eval-cadence bookkeeping.
+        Works for every subclass whose round rides ``run_round``
+        (server updates are device math, so they pipeline too).
+
+        Measured caveat: through a REMOTE device tunnel the synced
+        per-round loop can be faster — the streaming prefetcher already
+        overlaps the next gather with the loss wait, and a flood of
+        unsynced dispatches costs the tunnel more than the syncs save
+        (A/B on the 3400-client FEMNIST bench config: ~8.8 vs ~5.5
+        rounds/sec). Prefer this method on directly-attached devices."""
+        if (type(self).train_one_round is not FedAvgAPI.train_one_round
+                or type(self).run_round is not FederatedLoop.run_round):
+            # A subclass with its own per-round procedure (SCAFFOLD's
+            # control updates, FedNova's tau algebra, ...) would silently
+            # run plain FedAvg rounds here; _server_update overrides
+            # (FedOpt) are fine — the loop applies them.
+            raise NotImplementedError(
+                f"{type(self).__name__} customizes the round itself; "
+                "train_rounds_pipelined only serves subclasses whose "
+                "round rides run_round + _server_update")
+        losses = []
+        for r in range(start_round, start_round + n_rounds):
+            avg, loss = self.run_round(r)
+            self.net = self._server_update(self.net, avg)
+            losses.append(loss)
+        return [float(l) for l in losses]
+
     def train_rounds_on_device(self, n_rounds: int):
         """Run ``n_rounds`` WHOLE federated rounds in one jit: a
         ``lax.scan`` over rounds with on-device client sampling — zero

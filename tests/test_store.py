@@ -211,3 +211,49 @@ def test_max_steps_truncates_clients():
     assert int(store.counts.max()) == 32  # 2 steps x 16
     sub = store.gather_cohort(np.array([0, 1]))
     assert sub.x.shape[1] == 2
+
+
+def test_pipelined_rounds_match_per_round_loop():
+    """train_rounds_pipelined defers the loss fetches but must produce
+    EXACTLY the per-round host loop's sequence (same rng chain, same
+    round functions) — on the streaming store and the resident layout."""
+    x, y, parts = _classification(8, 64)
+    for make in (lambda: FederatedStore(x, y, parts, batch_size=16),
+                 lambda: build_federated_arrays(x, y, parts, batch_size=16)):
+        a = FedAvgAPI(LogisticRegression(num_classes=2), make(), None,
+                      _cfg(8, 4, rounds=6))
+        b = FedAvgAPI(LogisticRegression(num_classes=2), make(), None,
+                      _cfg(8, 4, rounds=6))
+        la = [a.train_one_round(r)["train_loss"] for r in range(6)]
+        lb = b.train_rounds_pipelined(6)
+        np.testing.assert_allclose(la, lb, rtol=0, atol=0)
+        for pa, pb in zip(jax.tree.leaves(a.net.params),
+                          jax.tree.leaves(b.net.params)):
+            np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+
+
+def test_pipelined_rounds_fedopt_subclass():
+    from fedml_tpu.algos.fedopt import FedOptAPI
+
+    x, y, parts = _classification(8, 64)
+    store = FederatedStore(x, y, parts, batch_size=16)
+    cfg = _cfg(8, 4, rounds=5)
+    cfg.server_optimizer = "adam"
+    cfg.server_lr = 0.05
+    api = FedOptAPI(LogisticRegression(num_classes=2), store, None, cfg)
+    losses = api.train_rounds_pipelined(5)
+    assert len(losses) == 5 and np.isfinite(losses).all()
+
+
+def test_pipelined_rounds_reject_custom_round_subclasses():
+    """Subclasses with their own per-round procedure (SCAFFOLD's control
+    updates) must refuse the pipelined loop instead of silently running
+    plain FedAvg rounds."""
+    from fedml_tpu.algos.scaffold import ScaffoldAPI
+
+    x, y, parts = _classification(8, 64)
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+    sc = ScaffoldAPI(LogisticRegression(num_classes=2), fed, None,
+                     _cfg(8, 8))
+    with pytest.raises(NotImplementedError, match="customizes the round"):
+        sc.train_rounds_pipelined(2)
