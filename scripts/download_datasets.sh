@@ -1,0 +1,144 @@
+#!/bin/bash
+# Dataset acquisition — consolidated equivalent of the reference's
+# per-dataset data/*/download_*.sh scripts (e.g. data/MNIST/
+# download_and_unzip.sh, data/fed_cifar100/download_fedcifar100.sh).
+# Produces the DATA_ROOT tree the loaders and scripts/
+# reproduce_baselines.sh expect (docs/DATASETS.md; REPRO.md).
+#
+# Usage:
+#   DATA_ROOT=/data scripts/download_datasets.sh            # everything
+#   DATA_ROOT=/data scripts/download_datasets.sh mnist femnist
+#
+# Sources are the ones the reference pins: the FedML S3 mirrors of the
+# TFF h5 splits, LEAF Google-Drive archives, and the datasets' canonical
+# hosts. Requires network access (this script is the one component that
+# cannot run in a zero-egress environment — everything else degrades to
+# synthetic same-shape data). Idempotent: completed artifacts are kept
+# and skipped on re-run; partial downloads are never cached (temp-name +
+# mv on success).
+set -euo pipefail
+
+DATA_ROOT=${DATA_ROOT:?set DATA_ROOT to the dataset destination directory}
+mkdir -p "$DATA_ROOT"
+DATA_ROOT=$(cd "$DATA_ROOT" && pwd)  # absolute: do_* helpers cd around
+
+S3=https://fedml.s3-us-west-1.amazonaws.com
+
+fetch() { # fetch <url> <dest-file> — atomic: partials never cached
+  [ -f "$2" ] && { echo "have $2"; return; }
+  wget --no-check-certificate -O "$2.part" "$1"
+  mv "$2.part" "$2"
+}
+
+gdrive() { # gdrive <file-id> <dest-file> — Drive's big-file confirm dance
+  [ -f "$2" ] && { echo "have $2"; return; }
+  local confirm url
+  confirm=$(wget --quiet --save-cookies /tmp/gd_cookies.txt \
+    --keep-session-cookies --no-check-certificate \
+    "https://docs.google.com/uc?export=download&id=$1" -O- |
+    sed -rn 's/.*confirm=([0-9A-Za-z_]+).*/\1/p' | head -1)
+  # Empty confirm: small file (served directly) or a changed interstitial
+  # — try the plain export URL and verify we did not save an HTML page.
+  url="https://docs.google.com/uc?export=download&confirm=${confirm:-t}&id=$1"
+  wget --load-cookies /tmp/gd_cookies.txt --no-check-certificate \
+    "$url" -O "$2.part"
+  rm -f /tmp/gd_cookies.txt
+  if head -c 256 "$2.part" | grep -qi "<html"; then
+    rm -f "$2.part"
+    echo "ERROR: Google Drive returned an HTML page for id=$1 (quota or" \
+         "changed download flow); fetch it manually to $2" >&2
+    return 1
+  fi
+  mv "$2.part" "$2"
+}
+
+untar_into() { # untar_into <archive> <dir>
+  mkdir -p "$2" && tar -xf "$1" -C "$2"
+}
+
+do_mnist() { # LEAF power-law MNIST (1000 clients)
+  mkdir -p "$DATA_ROOT/MNIST" && cd "$DATA_ROOT/MNIST"
+  gdrive 1cU_LcBAUZvfZWveOMhG4G5Fg9uFXhVdf MNIST.zip  # kept: re-run guard
+  unzip -o MNIST.zip
+  rm -rf train test
+  mv mnist/train train && mv mnist/test test
+  rm -rf mnist
+}
+
+do_femnist() { # TFF FederatedEMNIST h5 (3400 writers)
+  mkdir -p "$DATA_ROOT/FederatedEMNIST" && cd "$DATA_ROOT/FederatedEMNIST"
+  fetch "$S3/fed_emnist.tar.bz2" fed_emnist.tar.bz2
+  untar_into fed_emnist.tar.bz2 datasets
+}
+
+do_fed_cifar100() { # TFF CIFAR-100 h5 (500/100 clients)
+  mkdir -p "$DATA_ROOT/fed_cifar100" && cd "$DATA_ROOT/fed_cifar100"
+  fetch "$S3/fed_cifar100.tar.bz2" fed_cifar100.tar.bz2
+  untar_into fed_cifar100.tar.bz2 datasets
+}
+
+do_fed_shakespeare() { # TFF Shakespeare h5
+  mkdir -p "$DATA_ROOT/fed_shakespeare" && cd "$DATA_ROOT/fed_shakespeare"
+  fetch "$S3/shakespeare.tar.bz2" shakespeare.tar.bz2
+  untar_into shakespeare.tar.bz2 datasets
+}
+
+do_shakespeare() { # LEAF Shakespeare JSON (715 roles)
+  mkdir -p "$DATA_ROOT/shakespeare/train" "$DATA_ROOT/shakespeare/test"
+  cd "$DATA_ROOT/shakespeare"
+  gdrive 1mD6_4ju7n2WFAahMKDtozaGxUASaHAPH \
+    train/all_data_niid_2_keep_0_train_8.json
+  gdrive 1GERQ9qEJjXk_0FXnw1JbjuGCI-zmmfsk \
+    test/all_data_niid_2_keep_0_test_8.json
+}
+
+do_stackoverflow() { # TFF StackOverflow h5 + vocab side files (342k users)
+  mkdir -p "$DATA_ROOT/stackoverflow" && cd "$DATA_ROOT/stackoverflow"
+  local f
+  for f in stackoverflow.tar.bz2 stackoverflow.word_count.tar.bz2 \
+           stackoverflow.tag_count.tar.bz2; do
+    fetch "$S3/$f" "$f"
+    untar_into "$f" datasets
+  done
+  fetch "$S3/stackoverflow_nwp.pkl" datasets/stackoverflow_nwp.pkl
+}
+
+do_cifar10() {
+  mkdir -p "$DATA_ROOT/cifar10" && cd "$DATA_ROOT/cifar10"
+  fetch https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz \
+    cifar-10-python.tar.gz
+  tar -xzf cifar-10-python.tar.gz
+}
+
+do_cifar100() {
+  mkdir -p "$DATA_ROOT/cifar100" && cd "$DATA_ROOT/cifar100"
+  fetch https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz \
+    cifar-100-python.tar.gz
+  tar -xzf cifar-100-python.tar.gz
+}
+
+do_cinic10() {
+  mkdir -p "$DATA_ROOT/cinic10" && cd "$DATA_ROOT/cinic10"
+  fetch https://datashare.is.ed.ac.uk/bitstream/handle/10283/3192/CINIC-10.tar.gz \
+    CINIC-10.tar.gz
+  tar -xzf CINIC-10.tar.gz
+}
+
+do_gld() { # Google Landmarks federated splits (gld23k/gld160k csv maps)
+  mkdir -p "$DATA_ROOT/gld" && cd "$DATA_ROOT/gld"
+  fetch https://fedcv.s3-us-west-1.amazonaws.com/landmark/data_user_dict.zip \
+    data_user_dict.zip
+  fetch https://fedcv.s3-us-west-1.amazonaws.com/landmark/images.zip images.zip
+  unzip -o data_user_dict.zip && unzip -o images.zip
+}
+
+ALL=(mnist femnist fed_cifar100 fed_shakespeare shakespeare stackoverflow
+     cifar10 cifar100 cinic10 gld)
+TARGETS=("${@:-}")
+[ ${#TARGETS[@]} -eq 0 ] || [ -z "${TARGETS[0]}" ] && TARGETS=("${ALL[@]}")
+
+for t in "${TARGETS[@]}"; do
+  echo "=== $t -> $DATA_ROOT"
+  ( "do_$t" )  # subshell: each helper's cd cannot leak into the next
+done
+echo "datasets ready under $DATA_ROOT"
