@@ -18,8 +18,9 @@ Accounting:
   ``runs/bench_profile`` (TensorBoard-loadable), best-effort;
 - secondary configs as sub-metrics in the SAME JSON object: the
   3400-client FEMNIST-CNN federation (BASELINE.md north-star scale, on
-  the host-resident FederatedStore), a ViT federation, and the pallas
-  flash-attention speedup over naive attention.
+  the host-resident FederatedStore), a ViT federation, the shard_map
+  round on a 1-device mesh (the multi-chip code path's single-chip
+  throughput), and the pallas flash-attention vs dense comparison.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 ``vs_baseline`` keeps the round-1 convention — a ~1500 samples/sec
@@ -70,23 +71,44 @@ def _med_iqr(vals):
     return med, [round(min(vals), 4), round(max(vals), 4)]
 
 
+def _synthetic_cifar_fed(n_clients, per_client, batch):
+    """CIFAR-shaped synthetic federated data (zero-egress environment),
+    shared by every image-model bench section."""
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(n_clients * per_client, 32, 32, 3).astype(np.float32)
+    y = rng.randint(0, 10, size=len(x)).astype(np.int32)
+    return build_federated_arrays(x, y, partition_homo(len(x), n_clients),
+                                  batch)
+
+
+def _timed_scan_trials(api, rounds, samples_per_round, n_trials=3):
+    """samples/sec per trial of the whole-run scan, synced by a host
+    scalar fetch (block_until_ready does not reliably wait through the
+    axon tunnel). Caller warms up first."""
+    vals = []
+    for _ in range(n_trials):
+        t0 = time.perf_counter()
+        losses = api.train_rounds_on_device(rounds)
+        float(np.asarray(losses).sum())
+        vals.append(samples_per_round * rounds / (time.perf_counter() - t0))
+    return vals
+
+
 def bench_cifar_resnet56(profile_dir=None):
     import jax
 
     from fedml_tpu.algos.config import FedConfig
     from fedml_tpu.algos.fedavg import FedAvgAPI
-    from fedml_tpu.data.batching import build_federated_arrays
-    from fedml_tpu.data.partition import partition_homo
     from fedml_tpu.models.resnet import resnet56
     from fedml_tpu.obs.flops import model_cost
 
     n_clients, per_client, batch = 128, 256, 32
     clients_per_round, rounds = 8, 3
 
-    rng = np.random.RandomState(0)
-    x = rng.randn(n_clients * per_client, 32, 32, 3).astype(np.float32)
-    y = rng.randint(0, 10, size=len(x)).astype(np.int32)
-    fed = build_federated_arrays(x, y, partition_homo(len(x), n_clients), batch)
+    fed = _synthetic_cifar_fed(n_clients, per_client, batch)
     cfg = FedConfig(
         client_num_in_total=n_clients, client_num_per_round=clients_per_round,
         comm_round=1, epochs=1, batch_size=batch, lr=0.1,
@@ -216,28 +238,46 @@ def bench_vit():
 
     from fedml_tpu.algos.config import FedConfig
     from fedml_tpu.algos.fedavg import FedAvgAPI
-    from fedml_tpu.data.batching import build_federated_arrays
-    from fedml_tpu.data.partition import partition_homo
     from fedml_tpu.models import create_model
 
     n_clients, per_client, batch, cpr, rounds = 64, 256, 32, 8, 3
-    rng = np.random.RandomState(0)
-    x = rng.randn(n_clients * per_client, 32, 32, 3).astype(np.float32)
-    y = rng.randint(0, 10, size=len(x)).astype(np.int32)
-    fed = build_federated_arrays(x, y, partition_homo(len(x), n_clients), batch)
+    fed = _synthetic_cifar_fed(n_clients, per_client, batch)
     cfg = FedConfig(client_num_in_total=n_clients, client_num_per_round=cpr,
                     comm_round=1, epochs=1, batch_size=batch, lr=0.01)
     api = FedAvgAPI(create_model("vit", num_classes=10, patch=4, d_model=128,
                                  n_heads=4, n_layers=4), fed, None, cfg)
     api.train_rounds_on_device(rounds)
     jax.block_until_ready(api.net.params)
-    vals = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        losses = api.train_rounds_on_device(rounds)
-        float(np.asarray(losses).sum())  # host fetch = reliable sync
-        vals.append(cpr * per_client * rounds / (time.perf_counter() - t0))
+    vals = _timed_scan_trials(api, rounds, cpr * per_client)
     return {"samples_per_sec": round(statistics.median(vals), 2)}
+
+
+def bench_sharded_path():
+    """The shard_map round (the multi-chip code path) on a 1-device mesh:
+    full-participation whole-run scan with client shards pinned — the
+    dryrun validates N>1 correctness on a virtual mesh; this measures the
+    sharded machinery's throughput on the real chip vs the vmap path
+    (primary metric). Same model/data scale as the primary config."""
+    import jax
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.models.resnet import resnet56
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    n_clients, per_client, batch, rounds = 8, 256, 32, 3
+    fed = _synthetic_cifar_fed(n_clients, per_client, batch)
+    cfg = FedConfig(client_num_in_total=n_clients,
+                    client_num_per_round=n_clients,  # full participation
+                    comm_round=1, epochs=1, batch_size=batch, lr=0.1)
+    api = FedAvgAPI(resnet56(num_classes=10, dtype="bf16"), fed, None, cfg,
+                    mesh=client_mesh(1))
+    api.train_rounds_on_device(rounds)
+    jax.block_until_ready(api.net.params)
+    vals = _timed_scan_trials(api, rounds, n_clients * per_client)
+    sps = statistics.median(vals)
+    return {"samples_per_sec": round(sps, 2),
+            "rounds_per_sec": round(sps / (n_clients * per_client), 3)}
 
 
 def bench_flash_attention():
@@ -313,6 +353,7 @@ def main():
     sub = {}
     for name, fn in (("femnist_cnn_3400clients", bench_femnist_cnn_3400),
                      ("vit_cifar_shaped", bench_vit),
+                     ("sharded_path_mesh1", bench_sharded_path),
                      ("flash_attention_t2048", bench_flash_attention)):
         try:
             sub[name] = fn()
