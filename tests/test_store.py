@@ -257,3 +257,54 @@ def test_pipelined_rounds_reject_custom_round_subclasses():
                      _cfg(8, 8))
     with pytest.raises(NotImplementedError, match="customizes the round"):
         sc.train_rounds_pipelined(2)
+
+
+def test_sharded_scan_repeat_calls_continue_bit_equal():
+    """Two chunked scan calls (4+4 rounds) must equal one 8-round host
+    loop exactly — pins the mesh-pinned dataset cache (second call reuses
+    the resharded copy) and the rng-chain continuity across calls."""
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    x, y, parts = _classification(16, 24, d=8)
+    fed = build_federated_arrays(x, y, parts, batch_size=8)
+    cfg = _cfg(16, 16, rounds=8, batch=8, lr=0.2)
+    mesh = client_mesh(8)
+    host = FedAvgAPI(LogisticRegression(num_classes=2), fed, None, cfg,
+                     mesh=mesh)
+    for r in range(8):
+        host.train_one_round(r)
+    dev = FedAvgAPI(LogisticRegression(num_classes=2), fed, None, cfg,
+                    mesh=mesh)
+    dev.train_rounds_on_device(4)
+    assert dev._mesh_pinned_fed is dev.train_fed  # cache installed
+    dev.train_rounds_on_device(4)  # reuses the pinned copy
+    for a, b in zip(jax.tree.leaves(host.net.params),
+                    jax.tree.leaves(dev.net.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_streaming_serves_qfedavg_and_robust():
+    """The store drops into round-hook subclasses that ride run_round:
+    q-FedAvg (custom aggregation) and robust FedAvg (client transform).
+    Equal-count clients → the streaming cohort is identical to the
+    resident gather, so whole training runs must match the resident twin
+    exactly (finiteness alone would not catch stale/misordered cohorts)."""
+    from fedml_tpu.algos.qfedavg import QFedAvgAPI
+    from fedml_tpu.algos.robust import FedAvgRobustAPI
+
+    x, y, parts = _classification(12, 48)
+    for cls, kw in ((QFedAvgAPI, {"q": 1.0}), (FedAvgRobustAPI, {})):
+        stream = cls(LogisticRegression(num_classes=2),
+                     FederatedStore(x, y, parts, batch_size=16), None,
+                     _cfg(12, 4, rounds=4), **kw)
+        resident = cls(LogisticRegression(num_classes=2),
+                       build_federated_arrays(x, y, parts, batch_size=16),
+                       None, _cfg(12, 4, rounds=4), **kw)
+        for r in range(4):
+            ls = stream.train_one_round(r)["train_loss"]
+            lr_ = resident.train_one_round(r)["train_loss"]
+            assert np.isfinite(ls) and np.isclose(ls, lr_, rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(stream.net.params),
+                        jax.tree.leaves(resident.net.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
