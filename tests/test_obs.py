@@ -168,9 +168,10 @@ def test_xla_profiler_trace_produces_artifacts(tmp_path):
     with trace(log_dir):
         x = jnp.ones((64, 64))
         jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
-    import os
-
     files = [os.path.join(r, f) for r, _, fs in os.walk(log_dir) for f in fs]
     assert files, "profiler produced no trace artifacts"
-    assert any("trace" in f or f.endswith(".pb") or "xplane" in f
-               for f in files), files
+    # Match basenames only — tmp_path itself contains 'trace' (the test's
+    # own name), which would make a full-path match vacuous.
+    names = [os.path.basename(f) for f in files]
+    assert any("trace" in n or n.endswith(".pb") or "xplane" in n
+               for n in names), names
