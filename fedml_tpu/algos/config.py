@@ -50,8 +50,13 @@ class FedConfig:
     # uniformly, evaluate the CURRENT global model on each, keep the
     # client_num_per_round with the highest local loss; biases rounds
     # toward the worst-served clients for faster convergence).
+    # ... or "oort" (Oort, Lai et al. OSDI'21 — epsilon-greedy
+    # utility-based selection: exploit clients with high statistical
+    # utility loss*sqrt(n) plus a staleness bonus, explore the unseen).
     client_selection: str = "random"
     pow_d_candidates: int = 0  # 0 → 2 * client_num_per_round
+    oort_epsilon: float = 0.2  # explore fraction of each oort round
+    oort_staleness_coef: float = 0.1  # weight of sqrt(rounds-since-seen)
     # Example-level DP-SGD on clients (new capability — the reference only
     # has server-side weak DP, robust_aggregation.py:49-53): per-example
     # gradient clipping at this L2 norm (0 disables) and Gaussian noise of

@@ -99,6 +99,22 @@ class FedAvgAPI(FederatedLoop):
                     else np.asarray(train_fed.x[0, 0]))
         self.net = self.fns.init(init_rng, sample_x)
 
+        if cfg.client_selection == "oort":
+            if type(self).train_one_round is not FedAvgAPI.train_one_round:
+                # The utility-update hook lives in FedAvgAPI's round; a
+                # subclass round that skips it would silently degenerate
+                # oort to pure exploration (= uniform sampling).
+                raise NotImplementedError(
+                    f"{type(self).__name__} overrides train_one_round and "
+                    "would skip oort's per-round utility update; oort "
+                    "serves the FedAvg family's shared round only")
+            # Eager init: the checkpoint template must match the saved
+            # structure (lazy init would save oort state but restore
+            # against an empty template).
+            n = cfg.client_num_in_total
+            self._oort_utility = np.zeros(n, np.float64)
+            self._oort_last = np.full(n, -1, np.int64)
+
     def set_client_lr(self, lr: float):
         """(Re)build the jitted round for a new client learning rate —
         the hook the round-level LR schedulers use (fed_launch
@@ -206,15 +222,16 @@ class FedAvgAPI(FederatedLoop):
     def _sample_round_uncached(self, round_idx: int):
         if self.cfg.client_selection == "random":
             return super().sample_round(round_idx)
+        if self.cfg.client_selection == "oort":
+            return self._sample_oort(round_idx)
         if self.cfg.client_selection != "pow_d":
             raise ValueError(
                 f"unknown client_selection {self.cfg.client_selection!r}; "
-                "use 'random' or 'pow_d'")
+                "use 'random', 'pow_d' or 'oort'")
         from fedml_tpu.core.sampling import (
             pad_to_multiple,
             sample_clients_weighted,
         )
-        from fedml_tpu.data.batching import gather_clients
 
         cfg = self.cfg
         d = cfg.pow_d_candidates or 2 * cfg.client_num_per_round
@@ -239,21 +256,7 @@ class FedAvgAPI(FederatedLoop):
             order = np.argsort(-losses, kind="stable")[:m]
             idx = candidates[np.sort(order)]
             return pad_to_multiple(idx, self.n_shards)
-        fn = getattr(self, "_pow_d_losses_jit", None)
-        if fn is None:
-            per_client = self._per_client_eval()  # shared cached kernel
-
-            def losses_fn(net, fed, idx):
-                # Gather traced INSIDE the jit: an eager gather would pay
-                # the multi-dispatch host sync the fused round path exists
-                # to avoid (see round_fn_fused above).
-                sub = gather_clients(fed, idx)
-                return per_client(net, sub.x, sub.y, sub.mask)["loss"]
-
-            fn = jax.jit(losses_fn)
-            self._pow_d_losses_jit = fn
-        losses = np.asarray(
-            fn(self._eval_net(), self.train_fed, jnp.asarray(candidates)))
+        losses = self._cohort_losses_resident(candidates)
         order = np.argsort(-losses, kind="stable")[:m]
         idx = candidates[np.sort(order)]
         idx, wmask = pad_to_multiple(idx, self.n_shards)
@@ -270,6 +273,9 @@ class FedAvgAPI(FederatedLoop):
         if pf is None:
             pf = self._cohort_prefetcher = CohortPrefetcher(self.train_fed)
         sub = pf.get(round_idx, idx)
+        # Post-round consumers (oort's utility eval) reuse this instead of
+        # paying a second synchronous host gather of the same cohort.
+        self._stream_last = (round_idx, np.asarray(idx), sub)
         if (self.cfg.client_selection == "random"
                 and round_idx + 1 < self.cfg.comm_round):
             from fedml_tpu.core.sampling import pad_to_multiple, sample_clients
@@ -280,6 +286,113 @@ class FedAvgAPI(FederatedLoop):
                 self.n_shards)
             pf.prefetch(round_idx + 1, nidx)
         return sub
+
+    # --- Oort utility-based selection (Lai et al., OSDI'21) --------------
+    def _sample_oort(self, round_idx: int):
+        """Epsilon-greedy utility selection. Exploit: the highest-utility
+        previously-seen clients, utility = observed loss x sqrt(n_i)
+        (Oort's statistical utility) + staleness bonus
+        ``oort_staleness_coef * sqrt(rounds since last seen)``. Explore:
+        a seeded-uniform draw over never-seen clients. Utilities update
+        from each trained cohort's post-round losses
+        (:meth:`_update_oort_state`), so the very first rounds are pure
+        exploration. Deterministic given the round index and history."""
+        from fedml_tpu.core.sampling import pad_to_multiple
+
+        cfg = self.cfg
+        n = cfg.client_num_in_total
+        k = min(cfg.client_num_per_round, n)
+        seen = self._oort_last >= 0
+        rs = np.random.RandomState(round_idx)
+
+        n_explore = min(int(np.ceil(cfg.oort_epsilon * k)),
+                        int((~seen).sum()))
+        n_exploit = min(k - n_explore, int(seen.sum()))
+        n_explore = k - n_exploit  # unseen backfills any exploit shortfall
+
+        chosen = []
+        if n_exploit:
+            staleness = np.sqrt(np.maximum(round_idx - self._oort_last, 0))
+            score = np.where(
+                seen,
+                self._oort_utility + cfg.oort_staleness_coef * staleness,
+                -np.inf)
+            chosen.append(np.argsort(-score, kind="stable")[:n_exploit])
+        if n_explore:
+            pool = np.flatnonzero(~seen)
+            if len(pool) < n_explore:  # everyone seen: explore uniformly
+                pool = np.setdiff1d(np.arange(n), np.concatenate(chosen)
+                                    if chosen else np.array([], np.int64))
+            chosen.append(rs.choice(pool, n_explore, replace=False))
+        idx = np.sort(np.concatenate(chosen).astype(np.int32))
+        return pad_to_multiple(idx, self.n_shards)
+
+    def _update_oort_state(self, round_idx: int, idx, wmask) -> None:
+        """Refresh utilities for the just-trained cohort: one vmapped
+        eval of the new global on the cohort's local shards (the
+        per-client training losses stay inside the jitted round; this
+        post-round eval is the observable proxy). Evaluates the PADDED
+        cohort so it can reuse the round's own buffers — streaming reuses
+        the cohort ``_stream_cohort`` cached, resident shares the jitted
+        gather+eval kernel with pow_d — and masks padded slots out of the
+        utility write (no second host gather, no eager device gather)."""
+        idx = np.asarray(idx)
+        active_mask = np.asarray(wmask) > 0
+        if self._streaming:
+            cached = getattr(self, "_stream_last", None)
+            if cached is not None and cached[0] == round_idx and \
+                    np.array_equal(cached[1], idx):
+                sub = cached[2]
+            else:
+                sub = self.train_fed.gather_cohort(idx)
+            losses = np.asarray(self._per_client_eval()(
+                self._eval_net(), sub.x, sub.y, sub.mask)["loss"], np.float64)
+        else:
+            losses = self._cohort_losses_resident(idx).astype(np.float64)
+        counts = self._host_counts()[idx].astype(np.float64)
+        util = losses * np.sqrt(np.maximum(counts, 1))
+        active = idx[active_mask]
+        self._oort_utility[active] = util[active_mask]
+        self._oort_last[active] = round_idx
+
+    def _host_counts(self) -> np.ndarray:
+        """Per-client sample counts as host numpy (fetched once)."""
+        c = getattr(self, "_host_counts_np", None)
+        if c is None:
+            c = self._host_counts_np = np.asarray(self.train_fed.counts)
+        return c
+
+    def _cohort_losses_resident(self, idx) -> np.ndarray:
+        """Per-client loss of the current net on a resident-layout cohort
+        — gather traced INSIDE the jit (an eager gather would pay the
+        multi-dispatch host sync the fused round path exists to avoid).
+        Shared by pow_d candidate scoring and oort utility updates."""
+        from fedml_tpu.data.batching import gather_clients
+
+        fn = getattr(self, "_cohort_losses_jit", None)
+        if fn is None:
+            per_client = self._per_client_eval()  # shared cached kernel
+
+            def losses_fn(net, fed, idx):
+                sub = gather_clients(fed, idx)
+                return per_client(net, sub.x, sub.y, sub.mask)["loss"]
+
+            fn = jax.jit(losses_fn)
+            self._cohort_losses_jit = fn
+        return np.asarray(fn(self._eval_net(), self.train_fed,
+                             jnp.asarray(idx)))
+
+    # -- checkpoint/resume: oort utilities are run state ------------------
+    def checkpoint_extra_state(self):
+        if self.cfg.client_selection == "oort":
+            return {"oort_utility": self._oort_utility,
+                    "oort_last": self._oort_last}
+        return {}
+
+    def load_checkpoint_extra_state(self, extra) -> None:
+        if extra and "oort_utility" in extra:
+            self._oort_utility = np.asarray(extra["oort_utility"])
+            self._oort_last = np.asarray(extra["oort_last"])
 
     def _cohort(self, round_idx: int, idx):
         """The round's sampled clients as a ``FederatedArrays``: device
@@ -296,6 +409,10 @@ class FedAvgAPI(FederatedLoop):
     def train_one_round(self, round_idx: int) -> Dict[str, float]:
         avg, loss = self.run_round(round_idx)
         self.net = self._server_update(self.net, avg)
+        if self.cfg.client_selection == "oort":
+            # Memoized — returns the cohort this round actually trained.
+            idx, wmask = self.sample_round(round_idx)
+            self._update_oort_state(round_idx, idx, wmask)
         return {"round": round_idx, "train_loss": float(loss)}
 
     def train_rounds_pipelined(self, n_rounds: int, start_round: int = 0):
@@ -329,6 +446,11 @@ class FedAvgAPI(FederatedLoop):
                 f"{type(self).__name__} customizes the round itself; "
                 "train_rounds_pipelined only serves subclasses whose "
                 "round rides run_round + _server_update")
+        if self.cfg.client_selection == "oort":
+            raise NotImplementedError(
+                "oort updates per-client utilities after every round "
+                "(train_one_round); the pipelined loop skips that hook — "
+                "use the per-round loop")
         losses = []
         for r in range(start_round, start_round + n_rounds):
             avg, loss = self.run_round(r)
@@ -371,7 +493,7 @@ class FedAvgAPI(FederatedLoop):
         if self.cfg.client_selection != "random":
             raise NotImplementedError(
                 "train_rounds_on_device samples uniformly on device; "
-                "loss-biased selection (pow_d) needs the host loop")
+                "loss-biased selection (pow_d/oort) needs the host loop")
         cfg = self.cfg
         n_total = int(self.train_fed.num_clients)
         cpr = min(cfg.client_num_per_round, n_total)

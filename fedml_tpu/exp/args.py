@@ -68,11 +68,16 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="resume from the latest checkpoint in --run_dir")
     p.add_argument("--wandb_project", type=str, default=None)
     p.add_argument("--client_selection", type=str, default="random",
-                   choices=["random", "pow_d"],
-                   help="client sampling: uniform (reference parity) or "
-                        "Power-of-Choice loss-biased selection")
+                   choices=["random", "pow_d", "oort"],
+                   help="client sampling: uniform (reference parity), "
+                        "Power-of-Choice loss-biased selection, or Oort "
+                        "epsilon-greedy utility selection")
     p.add_argument("--pow_d_candidates", type=int, default=0,
                    help="pow_d candidate pool size (0 = 2x clients/round)")
+    p.add_argument("--oort_epsilon", type=float, default=0.2,
+                   help="oort explore fraction per round")
+    p.add_argument("--oort_staleness_coef", type=float, default=0.1,
+                   help="oort staleness bonus weight")
     p.add_argument("--eval_on_clients", action="store_true",
                    help="per-client eval of the global model each eval "
                         "round (reference _local_test_on_all_clients "
@@ -135,4 +140,6 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         dp_noise_multiplier=args.dp_noise_multiplier,
         client_selection=args.client_selection,
         pow_d_candidates=args.pow_d_candidates,
+        oort_epsilon=args.oort_epsilon,
+        oort_staleness_coef=args.oort_staleness_coef,
     )

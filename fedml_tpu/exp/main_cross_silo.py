@@ -80,9 +80,9 @@ def main(argv=None):
         raise SystemExit(f"--rank {args.rank} outside [0, {args.size})")
     if args.client_selection != "random":
         raise SystemExit(
-            "--client_selection pow_d is a simulator feature; the "
-            "cross-silo server samples uniformly (it has no access to "
-            "silo-local losses before assignment)")
+            f"--client_selection {args.client_selection} is a simulator "
+            "feature; the cross-silo server samples uniformly (it has no "
+            "access to silo-local losses before assignment)")
 
     logging.basicConfig(
         level=logging.INFO,

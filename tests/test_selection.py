@@ -81,7 +81,7 @@ def test_pow_d_trains_and_guard_scan():
     # Construction must succeed; only the sampling call hits the guard —
     # keeping construction outside pytest.raises pins that.
     bad = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
-                    _cfg("oort", cpr=3))
+                    _cfg("fedcs", cpr=3))
     with pytest.raises(ValueError, match="client_selection"):
         bad.sample_round(0)
 
@@ -123,3 +123,114 @@ def test_pow_d_cohort_stable_within_round():
         api.train_one_round(r)  # samples internally twice (global+personal)
         after = api.sample_round(r)[0]
         np.testing.assert_array_equal(before, after)
+
+
+def _ocfg(cpr=3, rounds=10, eps=0.34, **kw):
+    return FedConfig(client_num_in_total=8, client_num_per_round=cpr,
+                     comm_round=rounds, epochs=1, batch_size=16, lr=0.3,
+                     client_selection="oort", oort_epsilon=eps,
+                     frequency_of_the_test=1000, **kw)
+
+
+def test_oort_explores_then_exploits_high_loss_clients():
+    """Early rounds explore the unseen; once utilities exist, exploit
+    slots go to the highest observed-loss clients (noisy clients 6/7 in
+    the fixture have the worst losses by construction)."""
+    fed = _noisy_clients()
+    api = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                    _ocfg(cpr=3, rounds=12))
+    participation = np.zeros(8)
+    for r in range(12):
+        idx, wmask = api.sample_round(r)
+        api.train_one_round(r)
+        for i, w in zip(idx, wmask):
+            if w:
+                participation[int(i)] += 1
+    # Everyone got explored at least once...
+    assert (api._oort_last >= 0).all(), api._oort_last
+    # ...and the hard (high-noise) clients dominate exploitation.
+    assert participation[6] + participation[7] > participation[0] + \
+        participation[1], participation
+
+
+def test_oort_utilities_update_only_for_participants():
+    fed = _noisy_clients()
+    api = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                    _ocfg(cpr=2, rounds=4))
+    api.train_one_round(0)
+    idx, wmask = api.sample_round(0)
+    active = {int(i) for i, w in zip(idx, wmask) if w}
+    for c in range(8):
+        assert (api._oort_last[c] == 0) == (c in active)
+    # Utilities are loss * sqrt(n): positive for trained clients.
+    assert all(api._oort_utility[c] > 0 for c in active)
+
+
+def test_oort_deterministic_and_padded():
+    fed = _noisy_clients()
+    a = FedAvgAPI(LogisticRegression(num_classes=2), fed, None, _ocfg())
+    b = FedAvgAPI(LogisticRegression(num_classes=2), fed, None, _ocfg())
+    for r in range(5):
+        ia, wa = a.sample_round(r)
+        ib, wb = b.sample_round(r)
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(wa, wb)
+        a.train_one_round(r)
+        b.train_one_round(r)
+
+
+def test_oort_rejects_scan_and_pipelined_paths():
+    fed = _noisy_clients()
+    api = FedAvgAPI(LogisticRegression(num_classes=2), fed, None, _ocfg())
+    with pytest.raises(NotImplementedError):
+        api.train_rounds_on_device(2)
+    with pytest.raises(NotImplementedError, match="oort"):
+        api.train_rounds_pipelined(2)
+
+
+def test_oort_over_streaming_store():
+    from fedml_tpu.data.store import FederatedStore
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8 * 48, 6).astype(np.float32)
+    y = (x @ rng.randn(6) > 0).astype(np.int32)
+    parts = {c: np.arange(c * 48, (c + 1) * 48) for c in range(8)}
+    api = FedAvgAPI(LogisticRegression(num_classes=2),
+                    FederatedStore(x, y, parts, batch_size=16), None,
+                    _ocfg(cpr=3, rounds=6))
+    for r in range(6):
+        assert np.isfinite(api.train_one_round(r)["train_loss"])
+    assert (api._oort_last >= 0).sum() >= 3
+
+
+def test_oort_state_checkpoints_and_resumes(tmp_path):
+    """Resume must restore utilities/last-seen — otherwise a resumed run
+    silently resets to pure exploration (the save_run docstring's exact
+    bug class)."""
+    from fedml_tpu.obs import CheckpointManager, restore_run, save_run
+
+    fed = _noisy_clients()
+    api = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                    _ocfg(cpr=3, rounds=6))
+    for r in range(3):
+        api.train_one_round(r)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    save_run(mgr, api, 2)
+
+    fresh = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                      _ocfg(cpr=3, rounds=6))
+    assert (fresh._oort_last == -1).all()
+    nxt = restore_run(mgr, fresh)
+    mgr.close()
+    assert nxt == 3
+    np.testing.assert_array_equal(fresh._oort_last, api._oort_last)
+    np.testing.assert_allclose(fresh._oort_utility, api._oort_utility)
+
+
+def test_oort_rejects_custom_round_subclasses():
+    from fedml_tpu.algos.scaffold import ScaffoldAPI
+
+    fed = _noisy_clients()
+    with pytest.raises(NotImplementedError, match="oort"):
+        ScaffoldAPI(LogisticRegression(num_classes=2), fed, None,
+                    _ocfg(cpr=8))
