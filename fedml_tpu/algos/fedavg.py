@@ -194,8 +194,55 @@ class FedAvgAPI(FederatedLoop):
 
     def _client_transform(self):
         """Optional ``(global_net, client_net) -> client_net`` applied to
-        each trained client before averaging (robust clipping etc.)."""
-        return None
+        each trained client before averaging (robust clipping etc.). The
+        base builds the simulated-compression transform from
+        ``cfg.compress``; subclasses that replace this hook (robust
+        clipping) must reject ``cfg.compress`` rather than drop it."""
+        return self._compress_transform()
+
+    def _compress_transform(self):
+        """``cfg.compress="topk<r>"`` → on-device transform sparsifying
+        each client's delta to its top-k entries before aggregation
+        (simulates communication-constrained FL inside the jitted round;
+        per-round unbiased-compression variants needing rng — QSGD — live
+        on the cross-silo wire path, which also carries error feedback)."""
+        name = self.cfg.compress or "none"
+        if name == "none":
+            return None
+        if not name.startswith("topk"):
+            raise ValueError(
+                f"cfg.compress={name!r}: simulator rounds support "
+                "'topk<ratio>' only (stochastic quantization needs "
+                "per-client rng and error feedback — use the cross-silo "
+                "pipeline's --compress)")
+        try:
+            ratio = float(name[len("topk"):])
+        except ValueError:
+            raise ValueError(
+                f"cfg.compress={name!r}: expected 'topk<ratio>' with a "
+                "numeric ratio, e.g. 'topk0.05'") from None
+        if not 0 < ratio <= 1:
+            raise ValueError(f"topk ratio must be in (0, 1], got {ratio}")
+        from fedml_tpu.core.compression import (
+            topk_compress,
+            topk_decompress,
+            tree_spec,
+            tree_to_vector,
+            vector_to_tree,
+        )
+        from fedml_tpu.trainer.local import NetState
+
+        def transform(global_net, client_net):
+            gvec = tree_to_vector(global_net.params)
+            delta = tree_to_vector(client_net.params) - gvec
+            k = max(1, int(round(ratio * delta.shape[0])))
+            values, idx, _ = topk_compress(delta, k)
+            recon = topk_decompress(values, idx, delta.shape[0])
+            params = vector_to_tree(gvec + recon,
+                                    tree_spec(global_net.params))
+            return NetState(params, client_net.model_state)
+
+        return transform
 
     # ----------------------------------------------------------------------
     # sample_round/run_round come from FederatedLoop (shared scaffold).

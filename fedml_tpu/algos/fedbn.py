@@ -69,6 +69,11 @@ class FedBNAPI(FedAvgAPI):
             raise ValueError(
                 "FedBNAPI's round does not implement nan_guard; "
                 "rejecting rather than silently averaging diverged clients")
+        if self.cfg.compress != "none":
+            raise ValueError(
+                "FedBNAPI's round does not apply the compression "
+                "transform; rejecting cfg.compress rather than silently "
+                "running uncompressed")
         self._norm_mask = norm_mask(self.net.params)
         if not any(jax.tree.leaves(self._norm_mask)):
             raise ValueError(

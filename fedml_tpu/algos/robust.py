@@ -22,6 +22,14 @@ class FedAvgRobustAPI(FedAvgAPI):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         cfg = self.cfg
+        if cfg.compress and cfg.compress != "none":
+            # This class replaces the client-transform hook with norm
+            # clipping; accepting cfg.compress here would silently drop
+            # the compression the user asked for.
+            raise ValueError(
+                "FedAvgRobustAPI's client transform is the robust norm "
+                "clip; combining it with simulated compression is not "
+                "supported — drop cfg.compress or use plain FedAvg")
         self._noise = jax.jit(
             lambda p, r: add_gaussian_noise(p, r, cfg.robust_stddev)
         )

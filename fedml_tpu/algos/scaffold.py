@@ -106,6 +106,8 @@ class ScaffoldAPI(FedAvgAPI):
             "grad_clip": self.cfg.grad_clip,
             "dp_clip": self.cfg.dp_clip,
             "dp_noise_multiplier": self.cfg.dp_noise_multiplier,
+            "compress": (self.cfg.compress
+                         if self.cfg.compress != "none" else None),
         }
         # self._nan_guard is what FedAvgAPI actually stored, however the
         # caller passed it (positionally or by keyword).

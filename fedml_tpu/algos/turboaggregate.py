@@ -48,6 +48,11 @@ class TurboAggregateAPI(FedAvgAPI):
             raise ValueError(
                 "TurboAggregate aggregates on the host (MPC protocol); "
                 "use mesh=None")
+        if self.cfg.compress != "none":
+            raise ValueError(
+                "TurboAggregate's MPC path quantizes updates itself and "
+                "bypasses the client-transform hook; cfg.compress would "
+                "be silently dropped — unset it")
         self.n_groups = n_groups
         self.scale = scale
         self.prime = prime

@@ -78,6 +78,12 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="oort explore fraction per round")
     p.add_argument("--oort_staleness_coef", type=float, default=0.1,
                    help="oort staleness bonus weight")
+    p.add_argument("--compress", type=str, default="none",
+                   help="update compression. Simulator rounds: none | "
+                        "topk<ratio> (on-device, inside the jitted "
+                        "round). Cross-silo CLI: none | topk<ratio> "
+                        "(wire-level with error feedback) | q<bits> "
+                        "(stochastic quantization)")
     p.add_argument("--eval_on_clients", action="store_true",
                    help="per-client eval of the global model each eval "
                         "round (reference _local_test_on_all_clients "
@@ -142,4 +148,5 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         pow_d_candidates=args.pow_d_candidates,
         oort_epsilon=args.oort_epsilon,
         oort_staleness_coef=args.oort_staleness_coef,
+        compress=args.compress,
     )

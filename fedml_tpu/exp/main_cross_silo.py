@@ -66,10 +66,9 @@ def main(argv=None):
                         choices=["TCP", "GRPC"],
                         help="cross-silo transport: native C++ msgnet TCP "
                              "or grpcio (proto/comm.proto wire)")
-    parser.add_argument("--compress", type=str, default="none",
-                        help="client->server update compression: none | "
-                             "topk<ratio> (error feedback) | q<bits> "
-                             "(stochastic quantization)")
+    # --compress comes from the shared add_args flag set: here it is the
+    # WIRE-LEVEL codec (none | topk<ratio> with error feedback | q<bits>
+    # stochastic quantization), decoded by the server per frame.
     parser.add_argument("--aggregate_k", type=int, default=0,
                         help="straggler-tolerant first-k rounds: aggregate "
                              "as soon as k fresh uploads arrive (0 = wait "

@@ -120,3 +120,144 @@ def test_distributed_fedavg_compressed_trains(compress):
     )
     accs = [h["accuracy"] for h in agg.test_history]
     assert accs[-1] > 0.5
+
+
+def test_simulator_topk_ratio_one_is_identity():
+    """cfg.compress='topk1.0' keeps every delta entry — rounds must equal
+    plain FedAvg bit-for-bit."""
+    import jax
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.models.lr import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8 * 48, 6).astype(np.float32)
+    y = (x @ rng.randn(6) > 0).astype(np.int32)
+    parts = {c: np.arange(c * 48, (c + 1) * 48) for c in range(8)}
+
+    def mk(compress):
+        return FedAvgAPI(
+            LogisticRegression(num_classes=2),
+            build_federated_arrays(x, y, parts, batch_size=16), None,
+            FedConfig(client_num_in_total=8, client_num_per_round=4,
+                      comm_round=3, epochs=1, batch_size=16, lr=0.3,
+                      compress=compress, frequency_of_the_test=1000))
+
+    plain, full = mk("none"), mk("topk1.0")
+    for r in range(3):
+        plain.train_one_round(r)
+        full.train_one_round(r)
+    for a, b in zip(jax.tree.leaves(plain.net.params),
+                    jax.tree.leaves(full.net.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_simulator_topk_sparsifies_and_still_learns():
+    """Aggressive sparsification changes the trajectory but the easy
+    linearly-separable task still converges; each applied client delta is
+    exactly k-sparse (verified through a one-client full-participation
+    round: avg - global has at most k nonzeros)."""
+    import jax
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.data.batching import batch_global, build_federated_arrays
+    from fedml_tpu.models.lr import LogisticRegression
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(6 * 64, 10).astype(np.float32)
+    y = (x @ rng.randn(10) > 0).astype(np.int32)
+    parts = {c: np.arange(c * 64, (c + 1) * 64) for c in range(6)}
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+    test = batch_global(x, y, 32)
+
+    cfg = FedConfig(client_num_in_total=6, client_num_per_round=6,
+                    comm_round=25, epochs=1, batch_size=16, lr=0.3,
+                    compress="topk0.2", frequency_of_the_test=1000)
+    api = FedAvgAPI(LogisticRegression(num_classes=2), fed, test, cfg)
+    for r in range(25):
+        assert np.isfinite(api.train_one_round(r)["train_loss"])
+    assert float(api.eval_fn(api.net, *test)["accuracy"]) > 0.85
+
+    # Sparsity check: single client, one round → the global update IS the
+    # client's compressed delta.
+    one = {0: np.arange(64)}
+    fed1 = build_federated_arrays(x[:64], y[:64], one, batch_size=16)
+    cfg1 = FedConfig(client_num_in_total=1, client_num_per_round=1,
+                     comm_round=1, epochs=1, batch_size=16, lr=0.3,
+                     compress="topk0.1", frequency_of_the_test=1000)
+    api1 = FedAvgAPI(LogisticRegression(num_classes=2), fed1, None, cfg1)
+    before = np.concatenate([np.ravel(l) for l in
+                             jax.tree.leaves(api1.net.params)])
+    api1.train_one_round(0)
+    after = np.concatenate([np.ravel(l) for l in
+                            jax.tree.leaves(api1.net.params)])
+    n = before.size
+    k = max(1, int(round(0.1 * n)))
+    assert np.count_nonzero(after - before) <= k, (n, k)
+
+
+def test_simulator_compress_validation_and_robust_guard():
+    import pytest
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.algos.robust import FedAvgRobustAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.models.lr import LogisticRegression
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(4 * 32, 6).astype(np.float32)
+    y = (x @ rng.randn(6) > 0).astype(np.int32)
+    parts = {c: np.arange(c * 32, (c + 1) * 32) for c in range(4)}
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+
+    def cfg(compress):
+        return FedConfig(client_num_in_total=4, client_num_per_round=4,
+                         comm_round=1, epochs=1, batch_size=16, lr=0.3,
+                         compress=compress, frequency_of_the_test=1000)
+
+    with pytest.raises(ValueError, match="topk"):
+        FedAvgAPI(LogisticRegression(num_classes=2), fed, None, cfg("q8"))
+    with pytest.raises(ValueError, match="ratio"):
+        FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                  cfg("topk1.5"))
+    with pytest.raises(ValueError, match="clip"):
+        FedAvgRobustAPI(LogisticRegression(num_classes=2), fed, None,
+                        cfg("topk0.1"))
+
+
+def test_simulator_compress_guards_on_custom_round_subclasses():
+    """Subclasses whose rounds bypass the client-transform hook must
+    refuse cfg.compress rather than silently run uncompressed."""
+    import pytest
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.scaffold import ScaffoldAPI
+    from fedml_tpu.algos.turboaggregate import TurboAggregateAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.models.lr import LogisticRegression
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(4 * 32, 6).astype(np.float32)
+    y = (x @ rng.randn(6) > 0).astype(np.int32)
+    parts = {c: np.arange(c * 32, (c + 1) * 32) for c in range(4)}
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=1, epochs=1, batch_size=16, lr=0.3,
+                    compress="topk0.1", frequency_of_the_test=1000)
+    with pytest.raises(ValueError, match="compress"):
+        ScaffoldAPI(LogisticRegression(num_classes=2), fed, None, cfg)
+    with pytest.raises(ValueError, match="compress"):
+        TurboAggregateAPI(LogisticRegression(num_classes=2), fed, None, cfg)
+    with pytest.raises(ValueError, match="topk"):
+        # missing ratio → clear diagnostic, not a bare float() error
+        from fedml_tpu.algos.fedavg import FedAvgAPI
+
+        bad = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                        comm_round=1, epochs=1, batch_size=16, lr=0.3,
+                        compress="topk", frequency_of_the_test=1000)
+        FedAvgAPI(LogisticRegression(num_classes=2), fed, None, bad)
