@@ -17,6 +17,7 @@ from fedml_tpu.algos.ditto import DittoAPI
 from fedml_tpu.algos.fedasync import FedML_FedAsync_distributed
 from fedml_tpu.algos.fedbn import FedBNAPI
 from fedml_tpu.algos.qfedavg import QFedAvgAPI
+from fedml_tpu.algos.feddyn import FedDynAPI
 from fedml_tpu.algos.scaffold import ScaffoldAPI
 from fedml_tpu.algos.vertical_fl import VflAPI
 
@@ -25,6 +26,7 @@ __all__ = [
     "FedBNAPI",
     "FedML_FedAsync_distributed",
     "QFedAvgAPI",
+    "FedDynAPI",
     "ScaffoldAPI",
     "FedConfig",
     "CentralizedTrainer",

@@ -441,6 +441,29 @@ class FedAvgAPI(FederatedLoop):
             self._oort_utility = np.asarray(extra["oort_utility"])
             self._oort_last = np.asarray(extra["oort_last"])
 
+    def _require_plain_sgd_round(self, what: str) -> None:
+        """Shared constructor guard for corrected-SGD algorithms
+        (SCAFFOLD, FedDyn): their dedicated local steps implement plain
+        SGD plus the correction, so cfg knobs the generic trainer honors
+        must be rejected loudly instead of silently dropped."""
+        if self.cfg.client_optimizer != "sgd":
+            raise ValueError(
+                f"{what} applies to plain SGD local steps; got "
+                f"client_optimizer={self.cfg.client_optimizer!r}")
+        unsupported = {
+            "grad_clip": self.cfg.grad_clip,
+            "dp_clip": self.cfg.dp_clip,
+            "dp_noise_multiplier": self.cfg.dp_noise_multiplier,
+            "compress": (self.cfg.compress
+                         if self.cfg.compress != "none" else None),
+        }
+        bad = [k for k, v in unsupported.items() if v]
+        if self._nan_guard:
+            bad.append("nan_guard")
+        if bad:
+            raise ValueError(
+                f"{what} does not support: " + ", ".join(bad))
+
     def _cohort(self, round_idx: int, idx):
         """The round's sampled clients as a ``FederatedArrays``: device
         gather on the resident layout, host gather (double-buffered) on

@@ -31,8 +31,7 @@ import jax.numpy as jnp
 from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.algos.ditto import _gather_stacked, _scatter_stacked
 from fedml_tpu.data.batching import gather_clients
-from fedml_tpu.parallel.shard import client_rngs
-from fedml_tpu.trainer.local import NetState, make_epoch_shuffle, tree_select
+from fedml_tpu.trainer.local import tree_select
 
 
 def make_scaffold_local_train(apply_fn, lr: float, local_epochs: int,
@@ -40,47 +39,16 @@ def make_scaffold_local_train(apply_fn, lr: float, local_epochs: int,
     """``local_train(net, correction, x, y, mask, rng) -> (net', loss, K)``
     — plain SGD with the SCAFFOLD per-step correction ``c - c_k`` added to
     every gradient; ``K`` is the true number of non-empty optimizer steps.
-    Mirrors trainer/local.py's masking/shuffle/no-op-step semantics."""
+    Built on the shared corrected-SGD trainer (trainer/local.py)."""
+    from fedml_tpu.trainer.local import make_corrected_local_train
 
-    def local_train(net: NetState, correction, x, y, mask, rng):
-        def step(carry, inputs):
-            net, rng = carry
-            xb, yb, mb = inputs
-            rng, sub = jax.random.split(rng)
+    def step_update(params, grads, correction):
+        return jax.tree.map(lambda p, g, corr: p - lr * (g + corr),
+                            params, grads, correction)
 
-            def masked_loss(p):
-                logits, new_state = apply_fn(
-                    NetState(p, net.model_state), xb, train=True, rng=sub)
-                per = loss_fn(logits, yb)
-                return (jnp.sum(per * mb) / jnp.maximum(jnp.sum(mb), 1.0),
-                        new_state)
-
-            if remat:
-                masked_loss = jax.checkpoint(masked_loss)
-            (loss, new_state), grads = jax.value_and_grad(
-                masked_loss, has_aux=True)(net.params)
-            new_params = jax.tree.map(
-                lambda p, g, corr: p - lr * (g + corr),
-                net.params, grads, correction)
-            nb = jnp.sum(mb)
-            new_net = tree_select(nb > 0, NetState(new_params, new_state), net)
-            return (new_net, rng), (loss, nb)
-
-        def epoch(carry, epoch_rng):
-            reshuffle = make_epoch_shuffle(mask, epoch_rng)
-            ex, ey, em = reshuffle(x), reshuffle(y), reshuffle(mask)
-            carry, (losses, ns) = jax.lax.scan(step, carry, (ex, ey, em))
-            return carry, jnp.sum(losses * ns) / jnp.maximum(jnp.sum(ns), 1.0)
-
-        rng, shuffle_rng = jax.random.split(rng)
-        (net, _), epoch_losses = jax.lax.scan(
-            epoch, (net, rng), jax.random.split(shuffle_rng, local_epochs))
-        # True step count: padded trailing batches are gated no-ops.
-        k_steps = local_epochs * jnp.sum(
-            (jnp.sum(mask, axis=1) > 0).astype(jnp.float32))
-        return net, jnp.mean(epoch_losses), jnp.maximum(k_steps, 1.0)
-
-    return local_train
+    return make_corrected_local_train(apply_fn, local_epochs, loss_fn,
+                                      step_update, remat=remat,
+                                      with_step_count=True)
 
 
 class ScaffoldAPI(FedAvgAPI):
@@ -92,32 +60,13 @@ class ScaffoldAPI(FedAvgAPI):
 
     def __init__(self, *args, server_lr: float = 1.0, **kw):
         super().__init__(*args, **kw)
-        if self.cfg.client_optimizer != "sgd":
-            raise ValueError(
-                "SCAFFOLD's correction applies to plain SGD local steps; "
-                f"got client_optimizer={self.cfg.client_optimizer!r}")
         # Reject (rather than silently ignore) cfg knobs the corrected
         # local step does not implement — a user who sets --dp_clip must
         # not believe DP is active. cfg.wd is NOT rejected: the generic
         # sgd client optimizer ignores it too (reference parity — the
         # reference pairs weight decay with Adam only, MyModelTrainer.py:
         # 26-31), so behavior matches FedAvg exactly.
-        unsupported = {
-            "grad_clip": self.cfg.grad_clip,
-            "dp_clip": self.cfg.dp_clip,
-            "dp_noise_multiplier": self.cfg.dp_noise_multiplier,
-            "compress": (self.cfg.compress
-                         if self.cfg.compress != "none" else None),
-        }
-        # self._nan_guard is what FedAvgAPI actually stored, however the
-        # caller passed it (positionally or by keyword).
-        bad = [k for k, v in unsupported.items() if v]
-        if self._nan_guard:
-            bad.append("nan_guard")
-        if bad:
-            raise ValueError(
-                "ScaffoldAPI's corrected SGD step does not support: "
-                + ", ".join(bad))
+        self._require_plain_sgd_round("ScaffoldAPI's corrected SGD step")
         self.server_lr = server_lr
         n = int(self.train_fed.num_clients)
         zeros = jax.tree.map(jnp.zeros_like, self.net.params)
@@ -195,34 +144,11 @@ class ScaffoldAPI(FedAvgAPI):
             return self._scaffold_update(net, c_server, ck_sub, trained,
                                          losses, k_steps, weights, cross)
 
-        if self.mesh is None:
-            def round_fn(net, c_server, ck_sub, x, y, mask, weights, rng):
-                rngs = client_rngs(rng, x.shape[0], 0)
-                return body(net, c_server, ck_sub, x, y, mask, weights,
-                            rngs, cross=lambda v: v)
-        else:
-            from functools import partial
+        from fedml_tpu.parallel.shard import make_stateful_client_round
 
-            from jax.sharding import PartitionSpec as P
-            from jax import shard_map
-
-            axis = self.mesh.axis_names[0]
-
-            @partial(
-                shard_map,
-                mesh=self.mesh,
-                in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis),
-                          P(axis), P()),
-                out_specs=(P(), P(), P(axis), P()),
-                check_vma=False,
-            )
-            def round_fn(net, c_server, ck_sub, x, y, mask, weights, rng):
-                shard_idx = jax.lax.axis_index(axis)
-                rngs = client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
-                return body(net, c_server, ck_sub, x, y, mask, weights,
-                            rngs,
-                            cross=lambda v: jax.lax.psum(v, axis))
-
+        axis = None if self.mesh is None else self.mesh.axis_names[0]
+        round_fn = make_stateful_client_round(
+            body, self.mesh, axis or "clients")
         self._scaffold_jit = jax.jit(round_fn)
         return self._scaffold_jit
 

@@ -91,6 +91,9 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--ditto_lam", type=float, default=0.1,
                    help="Ditto proximal strength λ (personal ↔ global "
                         "trade-off; --algorithm Ditto)")
+    p.add_argument("--feddyn_alpha", type=float, default=0.01,
+                   help="FedDyn dynamic-regularization strength "
+                        "(--algorithm FedDyn)")
     p.add_argument("--qffl_q", type=float, default=1.0,
                    help="q-FedAvg fairness exponent (0 = equal-weight "
                         "FedAvg; --algorithm QFedAvg)")

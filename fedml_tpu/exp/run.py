@@ -61,12 +61,15 @@ def make_api(algorithm: str, args, model, arrays, test, cfg, mesh,
         "Ditto": algos.DittoAPI,
         "QFedAvg": algos.QFedAvgAPI,
         "Scaffold": algos.ScaffoldAPI,
+        "FedDyn": algos.FedDynAPI,
         "FedBN": algos.FedBNAPI,
     }
     if algorithm == "Ditto":
         common["lam"] = args.ditto_lam
     elif algorithm == "QFedAvg":
         common["q"] = args.qffl_q
+    elif algorithm == "FedDyn":
+        common["alpha"] = args.feddyn_alpha
     if algorithm in table:
         return table[algorithm](model, arrays, test, cfg, **common)
     if algorithm == "FedSeg":

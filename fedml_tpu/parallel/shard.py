@@ -151,3 +151,37 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
         return avg, loss
 
     return round_fn
+
+
+def make_stateful_client_round(body, mesh, axis: str = "clients"):
+    """Round wrapper for algorithms carrying server + client-stacked
+    state through the round (SCAFFOLD's controls, FedDyn's corrections).
+
+    ``body(net, s_global, s_clients, x, y, mask, weights, rngs, cross)
+    -> (net', s_global', s_clients', loss)`` is written ONCE by the
+    algorithm; this wrapper supplies the per-client rng streams and the
+    cross-shard reduction — identity on a single device, psum under
+    shard_map — so the vmap and sharded paths cannot drift (the same
+    shared-body discipline as make_vmap_round/make_sharded_round)."""
+    if mesh is None:
+        def round_fn(net, s_global, s_clients, x, y, mask, weights, rng):
+            rngs = client_rngs(rng, x.shape[0], 0)
+            return body(net, s_global, s_clients, x, y, mask, weights,
+                        rngs, cross=lambda v: v)
+        return round_fn
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis), P(axis),
+                  P()),
+        out_specs=(P(), P(), P(axis), P()),
+        check_vma=False,
+    )
+    def round_fn(net, s_global, s_clients, x, y, mask, weights, rng):
+        shard_idx = jax.lax.axis_index(axis)
+        rngs = client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
+        return body(net, s_global, s_clients, x, y, mask, weights, rngs,
+                    cross=partial(jax.lax.psum, axis_name=axis))
+
+    return round_fn

@@ -183,6 +183,62 @@ def _dp_batch_grad(apply_fn, loss_fn, net, xb, yb, mb, rng, noise_rng,
     return loss, net.model_state, grads
 
 
+def make_corrected_local_train(apply_fn, local_epochs: int, loss_fn,
+                               step_update, remat: bool = False,
+                               with_step_count: bool = False):
+    """Shared corrected-SGD client trainer for algorithms whose per-step
+    update needs per-client inputs the generic ``extra_grad_fn`` hook
+    cannot carry (SCAFFOLD's control variates, FedDyn's dynamic
+    regularizer). ``step_update(params, grads, aux) -> params'`` applies
+    the algorithm's correction; ``aux`` is an arbitrary per-client pytree
+    the caller vmaps over. Masking / per-epoch reshuffle / gated no-op
+    padded steps mirror :func:`make_local_train_fn` exactly.
+
+    Returns ``local_train(net, aux, x, y, mask, rng) -> (net', loss)``,
+    plus the true optimizer-step count K when ``with_step_count`` (padded
+    trailing batches are no-op steps, so K = epochs x non-empty steps)."""
+
+    def local_train(net: "NetState", aux, x, y, mask, rng):
+        def step(carry, inputs):
+            net, rng = carry
+            xb, yb, mb = inputs
+            rng, sub = jax.random.split(rng)
+
+            def masked_loss(p):
+                logits, new_state = apply_fn(
+                    NetState(p, net.model_state), xb, train=True, rng=sub)
+                per = loss_fn(logits, yb)
+                return (jnp.sum(per * mb) / jnp.maximum(jnp.sum(mb), 1.0),
+                        new_state)
+
+            if remat:
+                masked_loss = jax.checkpoint(masked_loss)
+            (loss, new_state), grads = jax.value_and_grad(
+                masked_loss, has_aux=True)(net.params)
+            new_params = step_update(net.params, grads, aux)
+            nb = jnp.sum(mb)
+            new_net = tree_select(nb > 0, NetState(new_params, new_state),
+                                  net)
+            return (new_net, rng), (loss, nb)
+
+        def epoch(carry, epoch_rng):
+            reshuffle = make_epoch_shuffle(mask, epoch_rng)
+            ex, ey, em = reshuffle(x), reshuffle(y), reshuffle(mask)
+            carry, (losses, ns) = jax.lax.scan(step, carry, (ex, ey, em))
+            return carry, jnp.sum(losses * ns) / jnp.maximum(jnp.sum(ns), 1.0)
+
+        rng, shuffle_rng = jax.random.split(rng)
+        (net, _), epoch_losses = jax.lax.scan(
+            epoch, (net, rng), jax.random.split(shuffle_rng, local_epochs))
+        if with_step_count:
+            k_steps = local_epochs * jnp.sum(
+                (jnp.sum(mask, axis=1) > 0).astype(jnp.float32))
+            return net, jnp.mean(epoch_losses), jnp.maximum(k_steps, 1.0)
+        return net, jnp.mean(epoch_losses)
+
+    return local_train
+
+
 def make_local_train_fn(
     apply_fn,
     optimizer,
