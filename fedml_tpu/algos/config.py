@@ -33,6 +33,14 @@ class FedConfig:
     # flags --norm_bound / --stddev)
     robust_norm_bound: float = 5.0
     robust_stddev: float = 0.0
+    # Backdoor attack harness (fedavg_robust: the poisoned client joins
+    # every attack_freq rounds, main_fedavg_robust.py:120). 0 = no attack;
+    # k > 0 forces the adversary client(s) into the cohort on every
+    # round_idx % k == 0. The adversaries default to the LAST
+    # attack_num_adversaries client ids (their shards should hold
+    # poisoned data, e.g. data.loaders.edge_case.make_backdoor_dataset).
+    attack_freq: int = 0
+    attack_num_adversaries: int = 1
     # Hierarchical FL (fedml_experiments/standalone/hierarchical_fl/main.py
     # flag --group_comm_round)
     group_comm_round: int = 1

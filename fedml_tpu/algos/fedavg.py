@@ -343,7 +343,10 @@ class FedAvgAPI(FederatedLoop):
         a seeded-uniform draw over never-seen clients. Utilities update
         from each trained cohort's post-round losses
         (:meth:`_update_oort_state`), so the very first rounds are pure
-        exploration. Deterministic given the round index and history."""
+        exploration. Exploration is SUSTAINED (Oort §4's epsilon-greedy):
+        once every client has been seen, the epsilon slice is drawn
+        uniformly from seen-but-not-exploited clients rather than silently
+        dropping to zero. Deterministic given round index and history."""
         from fedml_tpu.core.sampling import pad_to_multiple
 
         cfg = self.cfg
@@ -352,10 +355,9 @@ class FedAvgAPI(FederatedLoop):
         seen = self._oort_last >= 0
         rs = np.random.RandomState(round_idx)
 
-        n_explore = min(int(np.ceil(cfg.oort_epsilon * k)),
-                        int((~seen).sum()))
-        n_exploit = min(k - n_explore, int(seen.sum()))
-        n_explore = k - n_exploit  # unseen backfills any exploit shortfall
+        n_exploit = min(k - int(np.ceil(cfg.oort_epsilon * k)),
+                        int(seen.sum()))
+        n_explore = k - n_exploit  # epsilon slice + any exploit shortfall
 
         chosen = []
         if n_exploit:
@@ -366,11 +368,21 @@ class FedAvgAPI(FederatedLoop):
                 -np.inf)
             chosen.append(np.argsort(-score, kind="stable")[:n_exploit])
         if n_explore:
-            pool = np.flatnonzero(~seen)
-            if len(pool) < n_explore:  # everyone seen: explore uniformly
-                pool = np.setdiff1d(np.arange(n), np.concatenate(chosen)
-                                    if chosen else np.array([], np.int64))
-            chosen.append(rs.choice(pool, n_explore, replace=False))
+            # Never-seen clients first; when they run short (everyone —
+            # or nearly everyone — already seen) the remainder comes
+            # uniformly from seen clients outside the exploit set, so the
+            # epsilon fraction of each cohort keeps exploring forever.
+            unseen_pool = np.flatnonzero(~seen)
+            take_unseen = min(len(unseen_pool), n_explore)
+            if take_unseen:
+                chosen.append(rs.choice(unseen_pool, take_unseen,
+                                        replace=False))
+            rest = n_explore - take_unseen
+            if rest:
+                exploited = (chosen[0] if n_exploit
+                             else np.array([], np.int64))
+                pool = np.setdiff1d(np.flatnonzero(seen), exploited)
+                chosen.append(rs.choice(pool, rest, replace=False))
         idx = np.sort(np.concatenate(chosen).astype(np.int32))
         return pad_to_multiple(idx, self.n_shards)
 
@@ -545,7 +557,12 @@ class FedAvgAPI(FederatedLoop):
         scan rides the shard_map round under full participation (the
         gather is the identity there; client shards stay pinned to their
         devices across all rounds); subsampled mesh rounds still need the
-        host loop's resharding gather."""
+        host loop's resharding gather.
+
+        The incoming ``self.net`` is DONATED to the scan
+        (``donate_argnums``): callers that want to compare params before
+        vs after must copy ``api.net`` before calling — the pre-call
+        reference points at a donated (deleted) buffer afterwards."""
         if (type(self)._server_update is not FedAvgAPI._server_update
                 or type(self).train_one_round is not FedAvgAPI.train_one_round
                 or type(self).run_round is not FederatedLoop.run_round):

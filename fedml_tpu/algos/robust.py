@@ -1,4 +1,4 @@
-"""FedAvg with robust aggregation (backdoor defenses).
+"""FedAvg with robust aggregation (backdoor defenses) + attack harness.
 
 Parity: fedml_api/distributed/fedavg_robust/FedAvgRobustAggregator.py —
 per-client norm-difference clipping before the weighted average (:179-185)
@@ -7,21 +7,52 @@ fedml_core/robustness/robust_aggregation.py. Clipping applies to trainable
 params only; BatchNorm stats are excluded structurally (they live in
 ``NetState.model_state``), mirroring the reference's ``is_weight_param``
 filter.
+
+The ATTACK side of the reference's harness is here too: with
+``cfg.attack_freq = k`` the adversary client(s) — whose data shards the
+caller poisons via ``data.loaders.edge_case.make_backdoor_dataset`` — are
+forced into the training cohort every k-th round (the reference's
+poisoned worker joining every ``attack_freq`` rounds,
+main_fedavg_robust.py:120), and :func:`attack_success_rate` measures the
+model on a targeted test set (``test_target_accuracy``,
+FedAvgRobustAggregator.py:270). tests/test_backdoor.py composes the two
+and shows clipping+noise actually suppressing the attack.
 """
 
 from __future__ import annotations
 
 import jax
+import numpy as np
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.core.robustness import add_gaussian_noise, norm_diff_clipping
 from fedml_tpu.trainer.local import NetState
 
 
+def attack_success_rate(api, x_targeted, y_target, batch_size: int = 128):
+    """Accuracy of the CURRENT global model on a targeted test set
+    (triggered inputs labelled with the attack target — e.g. from
+    ``make_targeted_test_set``): by construction this equals the backdoor
+    attack success rate (FedAvgRobustAggregator.test_target_accuracy)."""
+    from fedml_tpu.data.batching import batch_global
+
+    xt, yt, mask = batch_global(np.asarray(x_targeted), np.asarray(y_target),
+                                batch_size)
+    m = api.eval_fn(api._eval_net(), xt, yt, mask)
+    return float(m["accuracy"])
+
+
 class FedAvgRobustAPI(FedAvgAPI):
-    def __init__(self, *args, **kwargs):
+    def __init__(self, *args, adversary_clients=None, **kwargs):
         super().__init__(*args, **kwargs)
         cfg = self.cfg
+        if getattr(cfg, "attack_freq", 0) and adversary_clients is None:
+            k = max(1, int(getattr(cfg, "attack_num_adversaries", 1)))
+            adversary_clients = range(cfg.client_num_in_total - k,
+                                      cfg.client_num_in_total)
+        self.adversary_clients = np.asarray(
+            list(adversary_clients) if adversary_clients is not None else [],
+            np.int64)
         if cfg.compress and cfg.compress != "none":
             # This class replaces the client-transform hook with norm
             # clipping; accepting cfg.compress here would silently drop
@@ -33,6 +64,26 @@ class FedAvgRobustAPI(FedAvgAPI):
         self._noise = jax.jit(
             lambda p, r: add_gaussian_noise(p, r, cfg.robust_stddev)
         )
+
+    def _sample_round_uncached(self, round_idx: int):
+        """On every ``attack_freq``-th round, force the adversary
+        client(s) into the cohort (replacing honestly-sampled slots);
+        other rounds sample exactly as the parent does."""
+        idx, wmask = super()._sample_round_uncached(round_idx)
+        freq = getattr(self.cfg, "attack_freq", 0)
+        if (not freq or self.adversary_clients.size == 0
+                or round_idx % freq != 0):
+            return idx, wmask
+        from fedml_tpu.core.sampling import pad_to_multiple
+
+        active = np.asarray(idx)[np.asarray(wmask) > 0]
+        adv = self.adversary_clients
+        honest = np.setdiff1d(active, adv)
+        n_adv = min(len(adv), len(active))
+        keep = honest[:len(active) - n_adv]
+        cohort = np.sort(np.concatenate([keep, adv[:n_adv]])).astype(
+            np.asarray(idx).dtype)
+        return pad_to_multiple(cohort, self.n_shards)
 
     def _client_transform(self):
         cfg = self.cfg

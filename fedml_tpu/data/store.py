@@ -136,9 +136,6 @@ class CohortPrefetcher:
 
     def prefetch(self, round_idx: int, indices) -> None:
         indices = np.asarray(indices)
-        with self._lock:
-            if round_idx in self._pending or round_idx in self._ready:
-                return
 
         def work():
             try:
@@ -155,6 +152,11 @@ class CohortPrefetcher:
 
         t = threading.Thread(target=work, daemon=True)
         with self._lock:
+            # Membership check and registration under ONE acquisition:
+            # check-then-act across two lock scopes would let concurrent
+            # prefetch calls for the same round both spawn gather threads.
+            if round_idx in self._pending or round_idx in self._ready:
+                return
             self._pending[round_idx] = t
         t.start()
 

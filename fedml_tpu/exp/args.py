@@ -42,9 +42,12 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--server_momentum", type=float, default=0.9)
     # fedprox
     p.add_argument("--fedprox_mu", type=float, default=0.1)
-    # robust (main_fedavg_robust.py)
+    # robust (main_fedavg_robust.py; --attack_freq is the reference's
+    # poisoned-worker cadence flag, main_fedavg_robust.py:120)
     p.add_argument("--norm_bound", type=float, default=5.0)
     p.add_argument("--stddev", type=float, default=0.0)
+    p.add_argument("--attack_freq", type=int, default=0)
+    p.add_argument("--attack_num_adversaries", type=int, default=1)
     # hierarchical (hierarchical_fl/main.py)
     p.add_argument("--group_comm_round", type=int, default=1)
     p.add_argument("--group_num", type=int, default=2)
@@ -140,6 +143,8 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         fedprox_mu=args.fedprox_mu,
         robust_norm_bound=args.norm_bound,
         robust_stddev=args.stddev,
+        attack_freq=args.attack_freq,
+        attack_num_adversaries=args.attack_num_adversaries,
         group_comm_round=args.group_comm_round,
         lr_schedule=args.lr_schedule,
         lr_decay_rate=args.lr_decay_rate,

@@ -12,6 +12,17 @@ from typing import Callable, Dict
 _REGISTRY: Dict[str, Callable] = {}
 
 
+def resolve_dtype(dtype):
+    """'bf16'/'bfloat16' → jnp.bfloat16 (CLI-friendly); None/np dtype
+    passthrough. The shared compute-dtype convention for every model
+    factory that supports mixed precision."""
+    if dtype in ("bf16", "bfloat16"):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    return dtype
+
+
 def register_model(name: str):
     def deco(fn):
         _REGISTRY[name] = fn

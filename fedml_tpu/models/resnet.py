@@ -168,11 +168,7 @@ class ResNetGN(nn.Module):
         return nn.Dense(self.num_classes)(x)
 
 
-def _dt(dtype):
-    """'bf16'/'bfloat16' → jnp.bfloat16 (CLI-friendly); None/np dtype passthrough."""
-    if dtype in ("bf16", "bfloat16"):
-        return jnp.bfloat16
-    return dtype
+from fedml_tpu.models.registry import resolve_dtype as _dt  # noqa: E402
 
 
 @register_model("resnet56")

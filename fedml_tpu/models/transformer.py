@@ -9,7 +9,7 @@ Pre-LN blocks, learned positional embeddings, bf16-friendly.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -23,12 +23,13 @@ class MHA(nn.Module):
     d_model: int
     attn_fn: Optional[Callable] = None  # (q,k,v[,causal]) -> o, else dense
     causal: bool = True
+    dtype: Any = None  # compute dtype (params stay float32)
 
     @nn.compact
     def __call__(self, x):
         b, t, _ = x.shape
         d_head = self.d_model // self.n_heads
-        qkv = nn.Dense(3 * self.d_model, use_bias=False)(x)
+        qkv = nn.Dense(3 * self.d_model, use_bias=False, dtype=self.dtype)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shp = (b, t, self.n_heads, d_head)
         q, k, v = q.reshape(shp), k.reshape(shp), v.reshape(shp)
@@ -50,7 +51,8 @@ class MHA(nn.Module):
                 o = self.attn_fn(q, k, v)
         else:
             o = reference_attention(q, k, v, causal=self.causal)
-        return nn.Dense(self.d_model, use_bias=False)(o.reshape(b, t, self.d_model))
+        return nn.Dense(self.d_model, use_bias=False,
+                        dtype=self.dtype)(o.reshape(b, t, self.d_model))
 
 
 class Block(nn.Module):
@@ -59,15 +61,17 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     attn_fn: Optional[Callable] = None
     causal: bool = True
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        h = nn.LayerNorm()(x)
-        x = x + MHA(self.n_heads, self.d_model, self.attn_fn, self.causal)(h)
-        h = nn.LayerNorm()(x)
-        h = nn.Dense(self.mlp_ratio * self.d_model)(h)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + MHA(self.n_heads, self.d_model, self.attn_fn, self.causal,
+                    dtype=self.dtype)(h)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.dtype)(h)
         h = nn.gelu(h)
-        return x + nn.Dense(self.d_model)(h)
+        return x + nn.Dense(self.d_model, dtype=self.dtype)(h)
 
 
 class TransformerLM(nn.Module):
@@ -78,24 +82,40 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     attn_fn: Optional[Callable] = None
     causal: bool = True
+    dtype: Any = None  # compute dtype; jnp.bfloat16 = mixed precision
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         b, t = tokens.shape
-        x = nn.Embed(self.vocab_size, self.d_model)(tokens)
-        pos = nn.Embed(self.max_len, self.d_model)(jnp.arange(t))
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
+        pos = nn.Embed(self.max_len, self.d_model,
+                       dtype=self.dtype)(jnp.arange(t))
         x = x + pos[None]
         for _ in range(self.n_layers):
-            x = Block(self.n_heads, self.d_model,
-                      attn_fn=self.attn_fn, causal=self.causal)(x, train)
-        x = nn.LayerNorm()(x)
-        return nn.Dense(self.vocab_size, use_bias=False)(x)
+            x = Block(self.n_heads, self.d_model, attn_fn=self.attn_fn,
+                      causal=self.causal, dtype=self.dtype)(x, train)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        # Logits in f32: softmax-CE over a 10k vocab is the one place bf16
+        # rounding visibly hurts the loss.
+        return nn.Dense(self.vocab_size, use_bias=False)(x).astype(jnp.float32)
 
 
 @register_model("transformer_lm")
 def transformer_lm(vocab_size: int = 90, d_model: int = 128, n_heads: int = 4,
                    n_layers: int = 2, max_len: int = 2048,
-                   attn_fn: Optional[Callable] = None, causal: bool = True, **_):
+                   attn_fn: Optional[Callable] = None, causal: bool = True,
+                   attn: str = "dense", dtype=None, **_):
+    """``attn="flash"`` swaps in the pallas fused kernel
+    (fedml_tpu.ops.flash_attention) — O(T) memory, faster than dense on
+    TPU from T≈2k with bf16 activations (measured crossover: bench
+    flash_attention_sweep). ``attn_fn`` (a callable) overrides both."""
+    if attn_fn is None and attn == "flash":
+        from fedml_tpu.ops.flash_attention import flash_attention
+        attn_fn = flash_attention  # MHA forwards causal= (it inspects)
+    elif attn_fn is None and attn != "dense":
+        raise ValueError(f"unknown attn {attn!r}: expected dense|flash")
+    from fedml_tpu.models.registry import resolve_dtype
     return TransformerLM(vocab_size=vocab_size, d_model=d_model,
                          n_heads=n_heads, n_layers=n_layers, max_len=max_len,
-                         attn_fn=attn_fn, causal=causal)
+                         attn_fn=attn_fn, causal=causal,
+                         dtype=resolve_dtype(dtype))

@@ -35,6 +35,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 _SUB = 8  # sublane replication for per-row vectors
 
+# Grid = (batch·heads, outer block dim, contraction block dim). Only the
+# innermost (contraction) dim is sequential — scratch accumulators carry
+# across it; telling Mosaic the outer two are parallel frees its scheduler.
+_DIMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -42,6 +48,18 @@ def _interpret() -> bool:
 
 def _blk(t: int, want: int = 128) -> int:
     return min(want, t)
+
+
+def _auto_blk(t: int, want: int) -> int:
+    """Largest divisor of ``t`` that is ≤ ``want`` and sublane-aligned
+    (multiple of 8) — default block sizes must accept every T the old
+    fixed-128 defaults accepted (e.g. T=384 → 192, not a ValueError)."""
+    if t <= want:
+        return t
+    for b in range(want, 7, -1):
+        if t % b == 0 and b % 8 == 0:
+            return b
+    return t  # no aligned divisor ≤ want: single block
 
 
 def _dot(a, b, dims):
@@ -69,10 +87,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
 
     @pl.when(diag_ok)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = _dot(q, k, (((1,), (1,)))) * scale  # [blk_q, blk_k]
+        # Dots run in the INPUT dtype (bf16 stays bf16 on the MXU — ~4x the
+        # fp32 matmul rate) with f32 accumulation via preferred_element_type;
+        # softmax statistics stay f32.
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        s = _dot(q, k, (((1,), (1,)))) * scale  # [blk_q, blk_k] f32
         if causal:
             q_pos = qi * blk_q + jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, 1), 0)
@@ -86,7 +105,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         c = jnp.exp(m_prev - m_new)
         m_s[...] = m_new
         l_s[...] = l_prev * c + jnp.sum(p, axis=1, keepdims=True)
-        acc[...] = acc[...] * c + _dot(p, v, ((1,), (0,)))
+        acc[...] = acc[...] * c + _dot(p.astype(v.dtype), v, ((1,), (0,)))
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -122,6 +141,7 @@ def _fwd(q3, k3, v3, scale, causal, blk_q, blk_k):
             pltpu.VMEM((blk_q, 1), jnp.float32),
             pltpu.VMEM((blk_q, 1), jnp.float32),
         ],
+        compiler_params=_DIMS,
         interpret=_interpret(),
     )(q3, k3, v3)
 
@@ -143,12 +163,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc, *,
 
     @pl.when(diag_ok)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q, do = q_ref[0], do_ref[0]
         lse = lse_ref[0, 0][:, None]
         delta = delta_ref[0, 0][:, None]
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        k, v = k_ref[0], v_ref[0]
         s = _dot(q, k, ((1,), (1,))) * scale
         if causal:
             q_pos = qi * blk_q + jax.lax.broadcasted_iota(
@@ -158,7 +176,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc, *,
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = _dot(do, v, ((1,), (1,)))
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         acc[...] += _dot(ds, k, ((1,), (0,))) * scale
 
     @pl.when(ki == n_k - 1)
@@ -185,10 +203,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(diag_ok)
     def _compute():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        k, v = k_ref[0], v_ref[0]
+        q, do = q_ref[0], do_ref[0]
         lse = lse_ref[0, 0][:, None]
         delta = delta_ref[0, 0][:, None]
         s = _dot(q, k, ((1,), (1,))) * scale
@@ -198,10 +214,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = ki * blk_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, blk_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [blk_q, blk_k]
-        dv_acc[...] += _dot(p, do, ((0,), (0,)))
+        p = jnp.exp(s - lse)  # [blk_q, blk_k] f32
+        dv_acc[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
         dp = _dot(do, v, ((1,), (1,)))
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk_acc[...] += _dot(ds, q, ((0,), (0,))) * scale
 
     @pl.when(qi == n_q - 1)
@@ -230,6 +246,7 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, blk_q, blk_k):
         out_specs=pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        compiler_params=_DIMS,
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse, delta)
 
@@ -257,6 +274,7 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, blk_q, blk_k):
             pltpu.VMEM((blk_k, d), jnp.float32),
             pltpu.VMEM((blk_k, d), jnp.float32),
         ],
+        compiler_params=_DIMS,
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse, delta)
     return dq, dk, dv
@@ -291,14 +309,24 @@ def _flash_bwd(causal, blocks, res, do3):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128):
+def flash_attention(q, k, v, causal: bool = False, block_q: int | None = None,
+                    block_k: int | None = None):
     """Fused attention: q/k/v [B, T, H, D] → o [B, T, H, D].
 
     T must be a multiple of the (clamped) block sizes; pad upstream if not.
     Differentiable (custom VJP, FlashAttention-2-style backward).
+
+    Default blocks come from a measured v5e sweep (runs/sweep_flash.log,
+    r3): (256, 512) wins at T≤4k, (512, 1024) at T≥8k — both beat the
+    r2-era (128, 128) by 1.2-1.8x. Pass explicit blocks to override.
+    For the MXU rate, feed bf16 q/k/v: the kernel dots run in the input
+    dtype (f32 accumulation), and bf16 is ~4x the fp32 matmul rate.
     """
     b, t, h, d = q.shape
+    if block_q is None:
+        block_q = _auto_blk(t, 512 if t >= 8192 else 256)
+    if block_k is None:
+        block_k = _auto_blk(t, 1024 if t >= 8192 else 512)
     blk_q = _blk(t, block_q)
     blk_k = _blk(t, block_k)
     if t % blk_q or t % blk_k:

@@ -65,8 +65,20 @@ def hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int] = (),
                 f"{len(ici_shape)} (per-axis factors; use 1 for ICI-only axes)")
         if len(axis_names) != len(ici_shape):
             raise ValueError("axis_names must have one name per mesh axis")
-        devices = mesh_utils.create_hybrid_device_mesh(
-            tuple(ici_shape), tuple(dcn_shape))
+        try:
+            devices = mesh_utils.create_hybrid_device_mesh(
+                tuple(ici_shape), tuple(dcn_shape))
+        except ValueError:
+            # Non-TPU devices (CPU multi-process testing) have no usable
+            # slice topology; there the process boundary IS the DCN
+            # boundary, so fall back to one-granule-per-process. On real
+            # TPU the error propagates — retrying a mismatched ici/dcn
+            # shape with process granules could silently build a mesh
+            # whose DCN axis cuts across ICI-connected hosts.
+            if jax.devices()[0].platform == "tpu":
+                raise
+            devices = mesh_utils.create_hybrid_device_mesh(
+                tuple(ici_shape), tuple(dcn_shape), process_is_granule=True)
         return Mesh(devices, axis_names)
     n = int(np.prod(ici_shape))
     devices = mesh_utils.create_device_mesh(

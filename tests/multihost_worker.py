@@ -1,0 +1,105 @@
+"""Subprocess worker + shared round logic for the 2-process SPMD test.
+
+Run as ``python multihost_worker.py <pid> <nprocs> <port> <out.npz>``
+with JAX_PLATFORMS=cpu and 4 virtual devices per process. The SAME
+``run_sharded_round`` builds the reference result inside the test's
+single 8-device process, so any divergence is attributable to the
+process boundary, not to differing code paths.
+"""
+
+import sys
+
+
+def _federation():
+    """Deterministic 8-client federation — identical on every process."""
+    import numpy as np
+
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+
+    C, B = 8, 16
+    x, y = make_classification(C * 2 * B, n_features=12, n_classes=5, seed=0)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), C, seed=0), B)
+    return C, B, fed
+
+
+def run_sharded_round(mesh, to_global):
+    """One full-participation sharded FedAvg round on ``mesh``.
+
+    ``to_global(host_value, pspec) -> jax.Array`` abstracts array
+    placement: device_put for a single process, host-local→global
+    assembly under ``jax.distributed``. Returns (params_leaves, loss) as
+    host numpy (from the replicated output's local shard)."""
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.parallel.shard import make_sharded_round
+    from fedml_tpu.trainer.local import (
+        make_client_optimizer,
+        make_local_train_fn_from_cfg,
+        model_fns,
+    )
+
+    C, B, fed = _federation()
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=C,
+                    comm_round=1, epochs=1, batch_size=B, lr=0.3)
+    fns = model_fns(LogisticRegression(num_classes=5))
+    net = fns.init(jax.random.PRNGKey(0), np.zeros((B, 12), np.float32))
+    opt = make_client_optimizer(cfg.client_optimizer, cfg.lr)
+    local_train = make_local_train_fn_from_cfg(fns.apply, opt, cfg)
+    ax = mesh.axis_names[0]
+    round_fn = jax.jit(make_sharded_round(local_train, mesh, ax))
+
+    w = np.asarray(fed.counts, np.float32)
+    rng = np.asarray(jax.random.PRNGKey(42))  # legacy uint32[2] key
+    args = (
+        jax.tree.map(lambda p: to_global(np.asarray(p), P()), net),
+        to_global(np.asarray(fed.x), P(ax)),
+        to_global(np.asarray(fed.y), P(ax)),
+        to_global(np.asarray(fed.mask), P(ax)),
+        to_global(w, P(ax)),
+        to_global(w, P(ax)),
+        to_global(rng, P()),
+    )
+    avg, loss = round_fn(*args)
+    leaves = [np.asarray(l.addressable_data(0))
+              for l in jax.tree.leaves(avg)]
+    return leaves, float(np.asarray(loss.addressable_data(0)))
+
+
+def main():
+    pid, nprocs, port, out = (int(sys.argv[1]), int(sys.argv[2]),
+                              sys.argv[3], sys.argv[4])
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from fedml_tpu.parallel.multihost import hybrid_mesh, initialize
+
+    assert initialize(f"localhost:{port}", nprocs, pid)
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert jax.local_device_count() == 4, jax.local_device_count()
+    mesh = hybrid_mesh((4,), (nprocs,), ("clients",))
+
+    def to_global(v, pspec):
+        if pspec == jax.sharding.PartitionSpec("clients"):
+            # host-local slice in process order (8 rows → 4 per process)
+            per = v.shape[0] // nprocs
+            v = v[pid * per:(pid + 1) * per]
+        return multihost_utils.host_local_array_to_global_array(
+            v, mesh, pspec)
+
+    leaves, loss = run_sharded_round(mesh, to_global)
+    if pid == 0:
+        np.savez(out, loss=loss,
+                 **{f"leaf{i}": l for i, l in enumerate(leaves)})
+    # Every process must reach shutdown together (gloo hangs otherwise).
+    multihost_utils.sync_global_devices("done")
+
+
+if __name__ == "__main__":
+    main()

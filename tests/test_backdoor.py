@@ -1,0 +1,133 @@
+"""End-to-end backdoor attack vs defense (r2 VERDICT missing #2).
+
+The reference's fedavg_robust harness runs a poisoned client joining
+every ``attack_freq`` rounds and measures backdoor target accuracy
+(FedAvgRobustAggregator.py:166-219, test_target_accuracy:270;
+main_fedavg_robust.py:120). Here the two halves meet: adversary clients
+hold ``make_backdoor_dataset`` shards, ``cfg.attack_freq`` forces them
+into the cohort, and the assertions show norm-clip + weak-DP actually
+suppressing attack success while main-task accuracy survives.
+"""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.robust import FedAvgRobustAPI, attack_success_rate
+from fedml_tpu.data.batching import batch_global, build_federated_arrays
+from fedml_tpu.data.loaders.edge_case import (
+    make_backdoor_dataset,
+    make_targeted_test_set,
+)
+from fedml_tpu.data.synthetic import make_classification
+from fedml_tpu.models.lr import LogisticRegression
+
+N_CLIENTS, TARGET = 8, 2
+
+
+def _attacked_federation(adv_samples=120, honest_samples=60, seed=0):
+    """7 honest clients + 1 adversary. The adversary's shard is fully
+    backdoored (trigger on the last 3 features, labels flipped to TARGET)
+    and heavy (sample-weighted averaging hands it ~half the aggregate),
+    so an undefended federation picks the backdoor up quickly."""
+    n_honest = (N_CLIENTS - 1) * honest_samples
+    x, y = make_classification(n_honest + 1200, n_features=10, n_classes=4,
+                               seed=seed)
+    x_tr, y_tr = x[:n_honest], y[:n_honest]
+    x_te, y_te = x[n_honest:], y[n_honest:]
+
+    xp, yp = make_classification(adv_samples, n_features=10, n_classes=4,
+                                 seed=seed + 1)
+    xp, yp, pmask = make_backdoor_dataset(xp, yp, TARGET, fraction=1.0,
+                                          patch=3, seed=seed)
+    assert pmask.all()
+
+    x_all = np.concatenate([x_tr, xp])
+    y_all = np.concatenate([y_tr, yp])
+    parts = {c: np.arange(c * honest_samples, (c + 1) * honest_samples)
+             for c in range(N_CLIENTS - 1)}
+    parts[N_CLIENTS - 1] = np.arange(n_honest, n_honest + adv_samples)
+    fed = build_federated_arrays(x_all, y_all, parts, batch_size=32)
+    test = batch_global(x_te, y_te, 64)
+    x_tgt, y_tgt = make_targeted_test_set(x_te, y_te, TARGET, patch=3)
+    return fed, test, (x_tgt, y_tgt)
+
+
+def _run(norm_bound, stddev, rounds=24, attack_freq=2):
+    fed, test, targeted = _attacked_federation()
+    cfg = FedConfig(
+        client_num_in_total=N_CLIENTS, client_num_per_round=N_CLIENTS,
+        comm_round=rounds, epochs=1, batch_size=32, lr=0.3,
+        frequency_of_the_test=1000, robust_norm_bound=norm_bound,
+        robust_stddev=stddev, attack_freq=attack_freq,
+    )
+    api = FedAvgRobustAPI(LogisticRegression(num_classes=4), fed, test, cfg)
+    api.train()
+    asr = attack_success_rate(api, *targeted)
+    main_acc = api.evaluate()["accuracy"]
+    return asr, main_acc
+
+
+def test_attack_succeeds_without_defense_and_is_suppressed_with():
+    """The composed experiment the reference's harness runs: defense off
+    → the backdoor lands; clip+noise on → attack success drops
+    materially while main accuracy survives. Operating point from the
+    r3 grid sweep (runs/backdoor_grid.log): undefended ASR 0.94 /
+    acc 0.82; norm_bound=0.2 + stddev=0.03 → ASR 0.46 / acc 0.79."""
+    asr_off, acc_off = _run(norm_bound=1e9, stddev=0.0)
+    asr_on, acc_on = _run(norm_bound=0.2, stddev=0.03)
+    # Undefended: the poisoned client plants the trigger.
+    assert asr_off > 0.8, (asr_off, acc_off)
+    # Defended: attack success drops materially…
+    assert asr_on < 0.65 * asr_off, (asr_on, asr_off)
+    # …while the main task keeps working.
+    assert acc_on > 0.65, acc_on
+    assert acc_off > 0.65, acc_off
+
+
+def test_adversary_joins_only_on_attack_rounds():
+    fed, test, _ = _attacked_federation()
+    cfg = FedConfig(
+        client_num_in_total=N_CLIENTS, client_num_per_round=3,
+        comm_round=6, epochs=1, batch_size=32, lr=0.1,
+        frequency_of_the_test=1000, attack_freq=2,
+    )
+    api = FedAvgRobustAPI(LogisticRegression(num_classes=4), fed, test, cfg)
+    np.testing.assert_array_equal(api.adversary_clients, [N_CLIENTS - 1])
+    for r in range(6):
+        idx, wmask = api._sample_round_uncached(r)
+        active = set(np.asarray(idx)[np.asarray(wmask) > 0].tolist())
+        if r % 2 == 0:
+            assert N_CLIENTS - 1 in active, (r, active)
+        # Cohort size is preserved either way.
+        assert len(active) == 3, (r, active)
+
+
+def test_attack_freq_zero_matches_parent_sampling():
+    fed, test, _ = _attacked_federation()
+    kw = dict(client_num_in_total=N_CLIENTS, client_num_per_round=4,
+              comm_round=2, epochs=1, batch_size=32, lr=0.1,
+              frequency_of_the_test=1000)
+    api = FedAvgRobustAPI(LogisticRegression(num_classes=4), fed, test,
+                          FedConfig(**kw))
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+
+    base = FedAvgAPI(LogisticRegression(num_classes=4), fed, test,
+                     FedConfig(**kw))
+    for r in range(4):
+        ia, wa = api._sample_round_uncached(r)
+        ib, wb = base._sample_round_uncached(r)
+        np.testing.assert_array_equal(np.asarray(ia), np.asarray(ib))
+        np.testing.assert_array_equal(np.asarray(wa), np.asarray(wb))
+
+
+def test_explicit_adversary_ids():
+    fed, test, _ = _attacked_federation()
+    cfg = FedConfig(client_num_in_total=N_CLIENTS, client_num_per_round=2,
+                    comm_round=2, epochs=1, batch_size=32, lr=0.1,
+                    frequency_of_the_test=1000, attack_freq=1)
+    api = FedAvgRobustAPI(LogisticRegression(num_classes=4), fed, test, cfg,
+                          adversary_clients=[0, 3])
+    idx, wmask = api._sample_round_uncached(0)
+    active = set(np.asarray(idx)[np.asarray(wmask) > 0].tolist())
+    assert active == {0, 3}, active

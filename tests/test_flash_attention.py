@@ -50,6 +50,17 @@ def test_flash_rejects_ragged_seq():
         flash_attention(q, k, v, block_q=32, block_k=32)
 
 
+def test_flash_default_blocks_accept_any_128_multiple():
+    """Default (auto) block sizes must not regress on sequence lengths
+    the old fixed-128 defaults accepted: T=384 is not a multiple of the
+    tuned 256/512 targets, so the auto-pick falls back to a divisor."""
+    q, k, v = _qkv(t=384)
+    got = flash_attention(q, k, v, causal=True)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_transformer_lm_with_flash_attention():
     """LM forward with flash attention == dense attention logits."""
     from fedml_tpu.models import create_model
