@@ -230,13 +230,20 @@ def bench_femnist_cnn_3400():
     api.train_one_round(0)
     jax.block_until_ready(api.net.params)
 
-    # Synced per-round loop: measured FASTER than deferring the loss
-    # fetches here (the prefetch worker already overlaps the next
-    # round's gather with the float(loss) wait, and flooding the remote
-    # tunnel with unsynced dispatches costs more than the sync saves —
-    # A/B'd 2026-07-30, ~8.8 vs ~5.5 rounds/sec). Three 10-round windows,
-    # median: this submetric is dispatch-RTT-heavy, so single windows
-    # swing with tunnel variance.
+    # Synced per-round loop BY DEFAULT: measured FASTER than deferring
+    # the loss fetches through the axon tunnel (the prefetch worker
+    # already overlaps the next round's gather with the float(loss)
+    # wait, and flooding the remote tunnel with unsynced dispatches
+    # costs more than the sync saves — A/B'd 2026-07-30, ~8.8 vs ~5.5
+    # rounds/sec). That floor is a TUNNEL property, not a framework one:
+    # on a directly-attached chip set BENCH_ATTACHED=1 to time the
+    # pipelined loop (async dispatch, losses fetched once per window)
+    # instead — see docs/PLATFORMS.md. Three 10-round windows, median:
+    # this submetric is dispatch-RTT-heavy, so single windows swing
+    # with tunnel variance.
+    import os
+
+    attached = os.environ.get("BENCH_ATTACHED") == "1"
     window, rps_w, sps_w, r = 10, [], [], 1
     for _ in range(3):
         samples = 0
@@ -244,18 +251,91 @@ def bench_femnist_cnn_3400():
             idx, _ = api._sample_round_uncached(rr)
             samples += int(np.asarray(store.counts)[np.asarray(idx)].sum())
         t0 = time.perf_counter()
-        for rr in range(r, r + window):
-            m = api.train_one_round(rr)
+        if attached:
+            losses = api.train_rounds_pipelined(window, start_round=r)
+            assert np.isfinite(losses).all()
+        else:
+            for rr in range(r, r + window):
+                m = api.train_one_round(rr)
+            assert np.isfinite(m["train_loss"])
         dt = time.perf_counter() - t0
-        assert np.isfinite(m["train_loss"])
         rps_w.append(window / dt)
         sps_w.append(samples / dt)
         r += window
     return {
         "clients": n_clients,
+        "loop": "pipelined" if attached else "synced",
         "rounds_per_sec": round(statistics.median(rps_w), 3),
         "samples_per_sec": round(statistics.median(sps_w), 2),
         "host_dataset_mb": round(store.nbytes() / 1e6, 1),
+    }
+
+
+def bench_stackoverflow_342k():
+    """BASELINE.md's largest row at its TRUE scale: 342,477 clients
+    (the reference enumerates exactly that many stackoverflow_nwp
+    users), reference model dims (embed 96, LSTM 670, vocab 10004),
+    50 clients/round, batch 16. Host-resident CSR store (~360 MB for
+    ~2.25M synthetic sentences); each round's device cohort is a few MB
+    regardless of the client count."""
+    import resource
+    from functools import partial
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.data.store import FederatedStore
+    from fedml_tpu.models.rnn import RNNStackOverflow
+    from fedml_tpu.trainer.local import seq_softmax_ce
+
+    from fedml_tpu.data.synthetic import make_stackoverflow_nwp
+
+    C, T, V, cpr, batch = 342_477, 20, 10004, 50, 16
+    x, y, parts = make_stackoverflow_nwp(C, seq_len=T, vocab=V)
+    counts = np.array([len(parts[c]) for c in range(C)])
+    store = FederatedStore(x, y, parts, batch_size=batch)
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=cpr,
+                    comm_round=40, epochs=1, batch_size=batch,
+                    lr=10 ** -0.5)  # BASELINE.md row lr
+    api = FedAvgAPI(RNNStackOverflow(vocab_size=V), store, None, cfg,
+                    loss_fn=partial(seq_softmax_ce, pad_id=0), pad_id=0)
+    # Warm every power-of-two step bucket (same rationale as FEMNIST).
+    from fedml_tpu.data.store import _bucket_steps
+
+    buckets = np.array([_bucket_steps(int(np.ceil(c / batch)))
+                        for c in counts])
+    import jax
+
+    for bkt in sorted(set(buckets)):
+        c = int(np.argmax(buckets == bkt))
+        sub = store.gather_cohort(np.full(cpr, c))
+        w = np.asarray(sub.counts, np.float32)
+        api.round_fn(api.net, sub.x, sub.y, sub.mask, w, w,
+                     jax.random.PRNGKey(0))
+    api.train_one_round(0)
+    jax.block_until_ready(api.net.params)
+
+    import os
+
+    attached = os.environ.get("BENCH_ATTACHED") == "1"  # PLATFORMS.md
+    window, rps_w, r = 10, [], 1
+    for _ in range(3):
+        t0 = time.perf_counter()
+        if attached:
+            losses = api.train_rounds_pipelined(window, start_round=r)
+            assert np.isfinite(losses).all()
+        else:
+            for rr in range(r, r + window):
+                m = api.train_one_round(rr)
+            assert np.isfinite(m["train_loss"])
+        rps_w.append(window / (time.perf_counter() - t0))
+        r += window
+    return {
+        "clients": C,
+        "loop": "pipelined" if attached else "synced",
+        "rounds_per_sec": round(statistics.median(rps_w), 3),
+        "host_dataset_mb": round(store.nbytes() / 1e6, 1),
+        "host_rss_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 0),
     }
 
 
@@ -283,6 +363,30 @@ def bench_resnet56_b128():
                       n_clients=128, per_client=256, batch=128, cpr=8,
                       lr=0.1)
     return {"samples_per_sec": round(sps, 2)}
+
+
+def bench_resnet56_s2d():
+    """The space-to-depth stem variant (docs/ROOFLINE.md's first named
+    lane-fill lever): 2x2 s2d input + doubled stage widths (32/64/128)
+    at half spatial — per-conv FLOPs ~equal to the reference model
+    (0.170 vs 0.186 GFLOP/sample) with 2x the MXU lane fill per stage.
+    Same federation config as the primary; reported as a VARIANT row
+    because the model differs (4x params) — the primary stays on the
+    reference stem for comparability."""
+    import jax
+
+    from fedml_tpu.models.resnet import resnet56
+    from fedml_tpu.obs.flops import model_cost
+
+    model = resnet56(num_classes=10, dtype="bf16", stem="s2d")
+    sps = _scan_bench(model, n_clients=128, per_client=256, batch=32,
+                      cpr=8, lr=0.1)
+    fwd = model_cost(model, np.zeros((32, 32, 32, 3), np.float32))
+    delivered = 3.0 * fwd["flops"] / 32 * sps / 1e12
+    peak = _chip_peak(jax.devices()[0].device_kind)
+    return {"samples_per_sec": round(sps, 2),
+            "delivered_tflops": round(delivered, 3),
+            "mfu": (round(delivered / peak, 4) if peak else None)}
 
 
 def bench_sharded_path():
@@ -483,16 +587,22 @@ def main():
     # XLA profile capture is env-gated: jax.profiler hangs against the
     # axon remote-compile tunnel (observed 2026-07-30 — the trace starts,
     # then blocks the program indefinitely). On directly-attached chips
-    # set BENCH_PROFILE=1 to get the TensorBoard trace.
+    # set BENCH_PROFILE=1 (or BENCH_ATTACHED=1, which also switches the
+    # store-backed sections to the pipelined round loop) to get the
+    # TensorBoard trace — docs/PLATFORMS.md "Attached vs tunneled".
+    attached = os.environ.get("BENCH_ATTACHED") == "1"
     profile_dir = ("runs/bench_profile"
-                   if os.environ.get("BENCH_PROFILE") == "1" else None)
+                   if (os.environ.get("BENCH_PROFILE") == "1" or attached)
+                   else None)
     _t0 = time.perf_counter()
     primary = bench_cifar_resnet56(profile_dir=profile_dir)
     _log("primary done")
     sub = {}
     for name, fn in (("femnist_cnn_3400clients", bench_femnist_cnn_3400),
+                     ("stackoverflow_342k", bench_stackoverflow_342k),
                      ("vit_cifar_shaped", bench_vit),
                      ("resnet56_batch128_tuned", bench_resnet56_b128),
+                     ("resnet56_s2d_stem", bench_resnet56_s2d),
                      ("sharded_path_mesh1", bench_sharded_path),
                      ("flash_attention_sweep", bench_flash_attention_sweep),
                      ("transformer_fed_mfu", bench_transformer_fed_mfu),

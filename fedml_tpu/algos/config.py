@@ -65,12 +65,13 @@ class FedConfig:
     pow_d_candidates: int = 0  # 0 → 2 * client_num_per_round
     oort_epsilon: float = 0.2  # explore fraction of each oort round
     oort_staleness_coef: float = 0.1  # weight of sqrt(rounds-since-seen)
-    # Simulated update compression in the on-device rounds: "none" or
-    # "topk<ratio>" (e.g. "topk0.05") — each client's delta is top-k
-    # sparsified before aggregation, ON device inside the jitted round
-    # (studies communication-constrained FL at simulator speed; the
-    # cross-silo pipeline's --compress is the real wire-level version
-    # with error feedback, fedavg_distributed.py).
+    # Simulated update compression in the on-device rounds: "none",
+    # "topk<ratio>" (e.g. "topk0.05" — each client's delta top-k
+    # sparsified), or "q<bits>" (e.g. "q8" — QSGD-style stochastic
+    # uniform quantization, unbiased, per-client rng streams), ON device
+    # inside the jitted round (studies communication-constrained FL at
+    # simulator speed; the cross-silo pipeline's --compress is the real
+    # wire-level version with error feedback, fedavg_distributed.py).
     compress: str = "none"
     # Example-level DP-SGD on clients (new capability — the reference only
     # has server-side weak DP, robust_aggregation.py:49-53): per-example

@@ -175,14 +175,19 @@ class FedAvgAPI(FederatedLoop):
 
     def _make_vmap_round(self, local_train, transform, guard):
         """Single-device round construction; q-FedAvg swaps in a
-        loss-reweighted aggregation here."""
+        loss-reweighted aggregation here. Under oort selection the round
+        additionally returns the per-client training losses (the
+        utility observable, Lai et al. §5) — run_round captures them so
+        no post-round eval pass is needed."""
         return make_vmap_round(
-            local_train, client_transform=transform, nan_guard=guard)
+            local_train, client_transform=transform, nan_guard=guard,
+            with_client_losses=self.cfg.client_selection == "oort")
 
     def _make_sharded_round(self, local_train, mesh, transform, guard):
         return make_sharded_round(
             local_train, mesh, mesh.axis_names[0],
-            client_transform=transform, nan_guard=guard)
+            client_transform=transform, nan_guard=guard,
+            with_client_losses=self.cfg.client_selection == "oort")
 
     def _build_local_train(self, optimizer, loss_fn):
         return make_local_train_fn_from_cfg(self.fns.apply, optimizer,
@@ -201,29 +206,20 @@ class FedAvgAPI(FederatedLoop):
         return self._compress_transform()
 
     def _compress_transform(self):
-        """``cfg.compress="topk<r>"`` → on-device transform sparsifying
-        each client's delta to its top-k entries before aggregation
-        (simulates communication-constrained FL inside the jitted round;
-        per-round unbiased-compression variants needing rng — QSGD — live
-        on the cross-silo wire path, which also carries error feedback)."""
+        """``cfg.compress`` → on-device transform applied to each
+        client's delta before aggregation (simulates communication-
+        constrained FL inside the jitted round): ``"topk<r>"``
+        sparsifies to the top-k entries; ``"q<bits>"`` runs QSGD-style
+        stochastic uniform quantization (unbiased — the per-client rng
+        stream arrives via run_clients_guarded's 3-arg transform form).
+        Error feedback lives on the cross-silo wire path, which carries
+        state between rounds."""
         name = self.cfg.compress or "none"
         if name == "none":
             return None
-        if not name.startswith("topk"):
-            raise ValueError(
-                f"cfg.compress={name!r}: simulator rounds support "
-                "'topk<ratio>' only (stochastic quantization needs "
-                "per-client rng and error feedback — use the cross-silo "
-                "pipeline's --compress)")
-        try:
-            ratio = float(name[len("topk"):])
-        except ValueError:
-            raise ValueError(
-                f"cfg.compress={name!r}: expected 'topk<ratio>' with a "
-                "numeric ratio, e.g. 'topk0.05'") from None
-        if not 0 < ratio <= 1:
-            raise ValueError(f"topk ratio must be in (0, 1], got {ratio}")
         from fedml_tpu.core.compression import (
+            dequantize,
+            quantize_stochastic,
             topk_compress,
             topk_decompress,
             tree_spec,
@@ -232,17 +228,50 @@ class FedAvgAPI(FederatedLoop):
         )
         from fedml_tpu.trainer.local import NetState
 
-        def transform(global_net, client_net):
-            gvec = tree_to_vector(global_net.params)
-            delta = tree_to_vector(client_net.params) - gvec
-            k = max(1, int(round(ratio * delta.shape[0])))
-            values, idx, _ = topk_compress(delta, k)
-            recon = topk_decompress(values, idx, delta.shape[0])
-            params = vector_to_tree(gvec + recon,
-                                    tree_spec(global_net.params))
-            return NetState(params, client_net.model_state)
+        if name.startswith("topk"):
+            try:
+                ratio = float(name[len("topk"):])
+            except ValueError:
+                raise ValueError(
+                    f"cfg.compress={name!r}: expected 'topk<ratio>' with a "
+                    f"numeric ratio, e.g. 'topk0.05'") from None
+            if not 0 < ratio <= 1:
+                raise ValueError(f"topk ratio must be in (0, 1], got {ratio}")
 
-        return transform
+            def transform(global_net, client_net):
+                gvec = tree_to_vector(global_net.params)
+                delta = tree_to_vector(client_net.params) - gvec
+                k = max(1, int(round(ratio * delta.shape[0])))
+                values, idx, _ = topk_compress(delta, k)
+                recon = topk_decompress(values, idx, delta.shape[0])
+                params = vector_to_tree(gvec + recon,
+                                        tree_spec(global_net.params))
+                return NetState(params, client_net.model_state)
+
+            return transform
+        if name.startswith("q"):
+            try:
+                bits = int(name[1:])
+            except ValueError:
+                raise ValueError(
+                    f"cfg.compress={name!r}: expected 'q<bits>', e.g. "
+                    f"'q8'") from None
+            from fedml_tpu.core.compression import _check_bits
+
+            _check_bits(bits)  # fail at construction, not first-round trace
+
+            def transform(global_net, client_net, rng):
+                gvec = tree_to_vector(global_net.params)
+                delta = tree_to_vector(client_net.params) - gvec
+                q, scale = quantize_stochastic(delta, bits, rng)
+                params = vector_to_tree(gvec + dequantize(q, scale),
+                                        tree_spec(global_net.params))
+                return NetState(params, client_net.model_state)
+
+            return transform
+        raise ValueError(
+            f"cfg.compress={name!r}: simulator rounds support "
+            "'topk<ratio>' or 'q<bits>'")
 
     # ----------------------------------------------------------------------
     # sample_round/run_round come from FederatedLoop (shared scaffold).
@@ -341,7 +370,8 @@ class FedAvgAPI(FederatedLoop):
         (Oort's statistical utility) + staleness bonus
         ``oort_staleness_coef * sqrt(rounds since last seen)``. Explore:
         a seeded-uniform draw over never-seen clients. Utilities update
-        from each trained cohort's post-round losses
+        from each trained cohort's IN-ROUND training losses, captured
+        from the jitted round's outputs
         (:meth:`_update_oort_state`), so the very first rounds are pure
         exploration. Exploration is SUSTAINED (Oort §4's epsilon-greedy):
         once every client has been seen, the epsilon slice is drawn
@@ -387,17 +417,21 @@ class FedAvgAPI(FederatedLoop):
         return pad_to_multiple(idx, self.n_shards)
 
     def _update_oort_state(self, round_idx: int, idx, wmask) -> None:
-        """Refresh utilities for the just-trained cohort: one vmapped
-        eval of the new global on the cohort's local shards (the
-        per-client training losses stay inside the jitted round; this
-        post-round eval is the observable proxy). Evaluates the PADDED
-        cohort so it can reuse the round's own buffers — streaming reuses
-        the cohort ``_stream_cohort`` cached, resident shares the jitted
-        gather+eval kernel with pow_d — and masks padded slots out of the
-        utility write (no second host gather, no eager device gather)."""
+        """Refresh utilities for the just-trained cohort from the
+        IN-ROUND training losses (Lai et al. §5's exact observable): the
+        round is built with ``with_client_losses`` under oort, so
+        ``run_round`` captured each client's local training loss and no
+        extra eval pass runs. Fallback for subclasses whose custom round
+        doesn't expose per-client losses (q-FedAvg's fair round): one
+        vmapped eval of the new global on the cohort's shards — the
+        documented r2 proxy. Updates mask padded slots out either way."""
         idx = np.asarray(idx)
         active_mask = np.asarray(wmask) > 0
-        if self._streaming:
+        captured = getattr(self, "_round_client_losses", None)
+        if captured is not None:
+            self._round_client_losses = None  # one round's observable
+            losses = np.asarray(captured, np.float64)
+        elif self._streaming:
             cached = getattr(self, "_stream_last", None)
             if cached is not None and cached[0] == round_idx and \
                     np.array_equal(cached[1], idx):

@@ -67,20 +67,31 @@ class FederatedLoop:
         if getattr(self, "_streaming", False):
             sub = self._stream_cohort(round_idx, idx)
             weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
-            return self.round_fn(
+            return self._unpack_round(self.round_fn(
                 self.net, sub.x, sub.y, sub.mask, weights, weights, rnd_rng
-            )
+            ))
         if self.round_fn_fused is not None:
-            return self.round_fn_fused(
+            return self._unpack_round(self.round_fn_fused(
                 self.net, self.train_fed,
-                jnp.asarray(idx), jnp.asarray(wmask), rnd_rng)
+                jnp.asarray(idx), jnp.asarray(wmask), rnd_rng))
         from fedml_tpu.data.batching import gather_clients
 
         sub = gather_clients(self.train_fed, idx)
         weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
-        return self.round_fn(
+        return self._unpack_round(self.round_fn(
             self.net, sub.x, sub.y, sub.mask, weights, weights, rnd_rng
-        )
+        ))
+
+    def _unpack_round(self, out):
+        """Rounds built with ``with_client_losses`` return a third,
+        per-client-loss output (oort's in-round utility observable);
+        capture it on the instance so callers keep the 2-tuple
+        contract."""
+        if len(out) == 3:
+            avg, loss, client_losses = out
+            self._round_client_losses = client_losses
+            return avg, loss
+        return out
 
     def _per_client_eval(self):
         """Cached jitted vmapped eval over a client-stacked layout —

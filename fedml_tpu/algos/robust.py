@@ -48,6 +48,12 @@ class FedAvgRobustAPI(FedAvgAPI):
         cfg = self.cfg
         if getattr(cfg, "attack_freq", 0) and adversary_clients is None:
             k = max(1, int(getattr(cfg, "attack_num_adversaries", 1)))
+            if k > cfg.client_num_in_total:
+                # A negative id here would silently gather client 0's
+                # (honest) shard — fail loudly instead.
+                raise ValueError(
+                    f"attack_num_adversaries={k} exceeds "
+                    f"client_num_in_total={cfg.client_num_in_total}")
             adversary_clients = range(cfg.client_num_in_total - k,
                                       cfg.client_num_in_total)
         self.adversary_clients = np.asarray(

@@ -104,3 +104,26 @@ def synthetic_alpha_beta(
         idx_map[k] = np.arange(pos, pos + sizes[k])
         pos += sizes[k]
     return np.concatenate(xs), np.concatenate(ys), idx_map
+
+
+def make_stackoverflow_nwp(
+    n_clients: int,
+    seq_len: int = 20,
+    vocab: int = 10004,
+    seed: int = 0,
+):
+    """StackOverflow-NWP-shaped synthetic federation at any client count
+    (the real set enumerates 342,477 users — reference
+    stackoverflow_nwp/data_loader.py): pareto per-client sentence counts,
+    next-token targets, tokens drawn from [1, vocab) so pad_id=0 never
+    collides. Returns ``(x, y, client_indices)`` for FederatedStore /
+    build_federated_arrays. Shared by the full-scale store test and the
+    bench submetric so the two can never drift."""
+    rng = np.random.RandomState(seed)
+    counts = 1 + (rng.pareto(1.5, n_clients) * 4).astype(np.int64).clip(0, 63)
+    tot = int(counts.sum())
+    x = rng.randint(1, vocab, (tot, seq_len)).astype(np.int32)
+    y = np.roll(x, -1, axis=1)
+    edges = np.concatenate([[0], np.cumsum(counts)])
+    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(n_clients)}
+    return x, y, parts

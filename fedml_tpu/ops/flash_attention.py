@@ -316,7 +316,7 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int | None = None,
     T must be a multiple of the (clamped) block sizes; pad upstream if not.
     Differentiable (custom VJP, FlashAttention-2-style backward).
 
-    Default blocks come from a measured v5e sweep (runs/sweep_flash.log,
+    Default blocks come from a measured v5e sweep (scripts/sweep_flash.py (log: r3 sweep),
     r3): (256, 512) wins at T≤4k, (512, 1024) at T≥8k — both beat the
     r2-era (128, 128) by 1.2-1.8x. Pass explicit blocks to override.
     For the MXU rate, feed bf16 q/k/v: the kernel dots run in the input

@@ -43,13 +43,33 @@ def run_clients_guarded(local_train, client_transform, nan_guard,
     is 1.0 for clients whose trained model is wholly finite (all-ones when
     the guard is off) — callers fold it into their aggregation weights.
     Used by the vmap round, the sharded round, and q-FedAvg's fair round
-    so the guard semantics can never drift between them."""
+    so the guard semantics can never drift between them.
+
+    ``client_transform`` is ``(global_net, client_net) -> client_net``,
+    or ``(global_net, client_net, rng) -> client_net`` for randomized
+    transforms (stochastic quantization): the 3-arg form receives a
+    per-client stream forked from the round's client rngs (fold_in with
+    a transform-reserved constant, so it never collides with the streams
+    local training consumed for shuffling/dropout/DP noise)."""
     client_nets, losses = jax.vmap(
         local_train, in_axes=(None, 0, 0, 0, 0)
     )(net, x, y, mask, rngs)
     if client_transform is not None:
-        client_nets = jax.vmap(client_transform, in_axes=(None, 0))(
-            net, client_nets)
+        import inspect
+
+        try:
+            wants_rng = len(
+                inspect.signature(client_transform).parameters) >= 3
+        except (TypeError, ValueError):
+            wants_rng = False
+        if wants_rng:
+            trngs = jax.vmap(
+                lambda r: jax.random.fold_in(r, 0x7F))(rngs)
+            client_nets = jax.vmap(client_transform, in_axes=(None, 0, 0))(
+                net, client_nets, trngs)
+        else:
+            client_nets = jax.vmap(client_transform, in_axes=(None, 0))(
+                net, client_nets)
     if not nan_guard:
         return client_nets, losses, jnp.ones_like(losses)
     finite = client_finite_mask(client_nets)
@@ -63,7 +83,8 @@ def run_clients_guarded(local_train, client_transform, nan_guard,
     return client_nets, losses, finite
 
 
-def make_vmap_round(local_train, client_transform=None, nan_guard: bool = False):
+def make_vmap_round(local_train, client_transform=None, nan_guard: bool = False,
+                    with_client_losses: bool = False):
     """``round_fn(params, x, y, mask, weights, loss_weights, rng) ->
     (avg_params, mean_loss)`` with client-stacked inputs ``[C, S, B, ...]``.
 
@@ -78,6 +99,10 @@ def make_vmap_round(local_train, client_transform=None, nan_guard: bool = False)
     ``nan_guard`` zero-weights any client whose trained model contains a
     non-finite value (and its loss), so one diverged client cannot poison
     the round.
+
+    ``with_client_losses`` appends the per-client training losses ``[C]``
+    as a THIRD output — the in-round observable Oort's utility needs
+    (Lai et al. §5), captured for free instead of a post-round eval pass.
     """
 
     def round_fn(params, x, y, mask, weights, loss_weights, rng):
@@ -95,7 +120,10 @@ def make_vmap_round(local_train, client_transform=None, nan_guard: bool = False)
             avg = jax.tree.map(
                 lambda a, p: jnp.where(any_ok, a, p), avg, params)
         lw = loss_weights / jnp.maximum(jnp.sum(loss_weights), 1e-12)
-        return avg, jnp.sum(losses * lw)
+        mean_loss = jnp.sum(losses * lw)
+        if with_client_losses:
+            return avg, mean_loss, losses
+        return avg, mean_loss
 
     return round_fn
 
@@ -108,19 +136,22 @@ def client_rngs(rng, n_local, offset):
 
 
 def make_sharded_round(local_train, mesh, axis: str = "clients",
-                       client_transform=None, nan_guard: bool = False):
+                       client_transform=None, nan_guard: bool = False,
+                       with_client_losses: bool = False):
     """Sharded round: client axis split over ``mesh[axis]``; output replicated.
 
     Weighted average = psum of per-shard weighted partial sums / psum of
     weights — exact regardless of how clients land on shards.
-    ``nan_guard`` as in :func:`make_vmap_round` (applied per shard).
+    ``nan_guard`` and ``with_client_losses`` as in :func:`make_vmap_round`
+    (the per-client losses come back client-sharded over ``axis``).
     """
 
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
-        out_specs=(P(), P()),
+        out_specs=((P(), P(), P(axis)) if with_client_losses
+                   else (P(), P())),
         check_vma=False,
     )
     def round_fn(params, x, y, mask, weights, loss_weights, rng):
@@ -148,6 +179,8 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
         lw = loss_weights.astype(jnp.float32)
         lw = lw / jnp.maximum(jax.lax.psum(jnp.sum(lw), axis), 1e-12)
         loss = jax.lax.psum(jnp.sum(losses * lw), axis)
+        if with_client_losses:
+            return avg, loss, losses
         return avg, loss
 
     return round_fn

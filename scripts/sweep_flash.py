@@ -1,0 +1,70 @@
+"""One-off TPU sweep: flash block sizes + dtype vs dense, causal fwd.
+
+Scratch experiment for picking flash_attention defaults from data (r3).
+"""
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.ops.flash_attention import flash_attention
+
+H, D = 8, 64
+
+
+def chained(attn, iters):
+    def run(q, k, v):
+        out = jax.lax.fori_loop(0, iters, lambda i, a: attn(a, k, v), q)
+        return jnp.sum(out)
+    return jax.jit(run)
+
+
+def timed(f, q, k, v, tokens):
+    float(f(q, k, v))  # warm + sync
+    vals = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(f(q, k, v))
+        vals.append(tokens / (time.perf_counter() - t0))
+    return statistics.median(vals)
+
+
+def dense(t):
+    def naive(q, k, v):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        return jnp.einsum("bhqk,bkhd->bqhd",
+                          jax.nn.softmax(logits, -1).astype(q.dtype), v)
+    return naive
+
+
+print("backend:", jax.default_backend(), jax.devices()[0].device_kind, flush=True)
+for t, b, iters in [(2048, 4, 16), (8192, 2, 4)]:
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(b, t, H, D), jnp.bfloat16) for _ in range(3))
+    tokens = b * t * iters
+    for bq, bk in [(128, 128), (128, 256), (256, 256), (256, 512),
+                   (512, 512), (128, 512), (512, 1024)]:
+        if bq > t or bk > t:
+            continue
+        f = chained(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bk), iters)
+        try:
+            tps = timed(f, q, k, v, tokens)
+            print(f"T={t} blk=({bq},{bk}): {tps / 1e6:.3f} Mtok/s", flush=True)
+        except Exception as e:
+            print(f"T={t} blk=({bq},{bk}): FAIL {type(e).__name__} "
+                  f"{str(e)[:120]}", flush=True)
+    f = chained(dense(t), iters)
+    print(f"T={t} dense-bf16: {timed(f, q, k, v, tokens) / 1e6:.3f} Mtok/s",
+          flush=True)
+    # fp32 comparison point at T=2048 only (r2 bench config)
+    if t == 2048:
+        qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+        f = chained(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=128), iters)
+        print(f"T={t} flash-fp32 (128,128): {timed(f, qf, kf, vf, tokens) / 1e6:.3f} Mtok/s",
+              flush=True)

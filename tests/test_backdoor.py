@@ -72,8 +72,8 @@ def test_attack_succeeds_without_defense_and_is_suppressed_with():
     """The composed experiment the reference's harness runs: defense off
     → the backdoor lands; clip+noise on → attack success drops
     materially while main accuracy survives. Operating point from the
-    r3 grid sweep (runs/backdoor_grid.log): undefended ASR 0.94 /
-    acc 0.82; norm_bound=0.2 + stddev=0.03 → ASR 0.46 / acc 0.79."""
+    r3 defense grid sweep: undefended ASR 0.94 / acc 0.82;
+    norm_bound=0.2 + stddev=0.03 → ASR 0.46 / acc 0.79."""
     asr_off, acc_off = _run(norm_bound=1e9, stddev=0.0)
     asr_on, acc_on = _run(norm_bound=0.2, stddev=0.03)
     # Undefended: the poisoned client plants the trigger.

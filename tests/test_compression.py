@@ -220,8 +220,10 @@ def test_simulator_compress_validation_and_robust_guard():
                          comm_round=1, epochs=1, batch_size=16, lr=0.3,
                          compress=compress, frequency_of_the_test=1000)
 
-    with pytest.raises(ValueError, match="topk"):
-        FedAvgAPI(LogisticRegression(num_classes=2), fed, None, cfg("q8"))
+    with pytest.raises(ValueError, match="topk.*q<bits>|q<bits>"):
+        FedAvgAPI(LogisticRegression(num_classes=2), fed, None, cfg("zip"))
+    with pytest.raises(ValueError, match="q<bits>"):
+        FedAvgAPI(LogisticRegression(num_classes=2), fed, None, cfg("qx"))
     with pytest.raises(ValueError, match="ratio"):
         FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
                   cfg("topk1.5"))
@@ -261,3 +263,56 @@ def test_simulator_compress_guards_on_custom_round_subclasses():
                         comm_round=1, epochs=1, batch_size=16, lr=0.3,
                         compress="topk", frequency_of_the_test=1000)
         FedAvgAPI(LogisticRegression(num_classes=2), fed, None, bad)
+
+
+def test_simulator_qsgd_rounds_unbiased_and_trainable():
+    """cfg.compress="q8" inside the jitted round (r2 VERDICT stretch #9):
+    the per-client rng streams reach the 3-arg client transform, the
+    quantization is UNBIASED through the vmapped path (averaging the
+    aggregated round over many round rngs converges to the uncompressed
+    round), and training still learns."""
+    import jax
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.models.lr import LogisticRegression
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(4 * 32, 6).astype(np.float32)
+    y = (x @ rng.randn(6) > 0).astype(np.int32)
+    parts = {c: np.arange(c * 32, (c + 1) * 32) for c in range(4)}
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+
+    def mk(compress):
+        cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                        comm_round=1, epochs=1, batch_size=16, lr=0.3,
+                        compress=compress, frequency_of_the_test=1000)
+        return FedAvgAPI(LogisticRegression(num_classes=2), fed, None, cfg)
+
+    ref_api, q_api = mk("none"), mk("q4")
+    w = fed.counts.astype(np.float32)
+    ref_avg, _ = ref_api.round_fn(ref_api.net, fed.x, fed.y, fed.mask,
+                                  w, w, jax.random.PRNGKey(7))
+    ref_vec = np.concatenate(
+        [np.ravel(l) for l in jax.tree.leaves(ref_avg.params)])
+
+    draws = []
+    for s in range(64):
+        avg, _ = q_api.round_fn(q_api.net, fed.x, fed.y, fed.mask,
+                                w, w, jax.random.PRNGKey(7 + 1000 * s))
+        draws.append(np.concatenate(
+            [np.ravel(l) for l in jax.tree.leaves(avg.params)]))
+    draws = np.stack(draws)
+    # NOTE the rng chain differs from the uncompressed round only in the
+    # transform (local training is deterministic given the round key), so
+    # E[q-round] == uncompressed round. 4-bit levels make the per-draw
+    # error visible; the mean must shrink well below it.
+    per_draw = np.abs(draws - ref_vec).max(1).mean()
+    mean_err = np.abs(draws.mean(0) - ref_vec).max()
+    assert mean_err < 0.3 * per_draw, (mean_err, per_draw)
+
+    # End-to-end: q8 training still learns.
+    api = mk("q8")
+    h = [api.train_one_round(r)["train_loss"] for r in range(6)]
+    assert np.isfinite(h).all() and h[-1] < h[0], h

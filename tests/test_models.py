@@ -162,3 +162,28 @@ def test_vit_attn_fn_is_plumbed():
     calls.clear()
     fns.apply(net, x, train=False)
     assert len(calls) == 3  # one per layer
+
+
+def test_resnet56_s2d_stem_variant():
+    """Space-to-depth stem: same input contract, ~equal FLOPs, doubled
+    stage widths; bad stem names rejected."""
+    import jax
+    import numpy as np
+    import pytest
+
+    from fedml_tpu.models.resnet import resnet56, space_to_depth
+    from fedml_tpu.trainer.local import model_fns
+
+    x = np.arange(2 * 4 * 4 * 3).reshape(2, 4, 4, 3).astype(np.float32)
+    s = np.asarray(space_to_depth(jax.numpy.asarray(x)))
+    assert s.shape == (2, 2, 2, 12)
+    np.testing.assert_array_equal(s[0, 0, 0], x[0, 0:2, 0:2, :].reshape(-1))
+
+    fns = model_fns(resnet56(num_classes=10, stem="s2d"))
+    net = fns.init(jax.random.PRNGKey(0), np.zeros((2, 32, 32, 3), np.float32))
+    logits, _ = fns.apply(net, np.zeros((2, 32, 32, 3), np.float32))
+    assert logits.shape == (2, 10)
+
+    with pytest.raises(ValueError, match="stem"):
+        bad = model_fns(resnet56(num_classes=10, stem="nope"))
+        bad.init(jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32))

@@ -1,0 +1,54 @@
+"""Optimization-loop convergence at REFERENCE round counts (r2 VERDICT
+weak #7): the closest zero-egress analogue of BASELINE.md's MNIST-LR row
+(">75% @ >100 rounds", benchmark/README.md:10-14) — 1000 power-law
+clients, 10/round, batch 10, SGD lr 0.03, 120 rounds on the streaming
+FederatedStore. Asserts descending loss and the row's >75% held-out
+accuracy, so the whole loop (sampling → streaming gather → local SGD →
+weighted average) is pinned end-to-end at the reference's
+scale-in-rounds, not just 2-round sanity.
+
+Task construction: MNIST is cluster-shaped, so the synthetic analogue is
+class-conditional Gaussians in 784-d with separation alpha=0.1 —
+calibrated (runs sweep, 2026-07-31) so the curve crosses 75% around
+round ~100 at the reference hyperparameters, like the real row does:
+alpha=0.15 saturates by round 30 (trivial), alpha=0.05 never gets there
+(too hard for 120 rounds), 0.1 → 0.65 @ 40 / 0.77 @ 80 / 0.80 @ 120.
+"""
+
+import numpy as np
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.data.batching import batch_global
+from fedml_tpu.data.store import FederatedStore
+from fedml_tpu.models.lr import LogisticRegression
+
+
+def test_mnist_lr_shaped_convergence_120_rounds():
+    C, K, D, alpha = 1000, 10, 784, 0.1
+    rng = np.random.RandomState(0)
+    # Power-law client sizes (the reference's MNIST partition), ~15/client.
+    counts = 3 + (rng.pareto(1.2, C) * 6).astype(np.int64).clip(0, 60)
+    tot = int(counts.sum())
+    n = tot + 2000
+    y = rng.randint(0, K, size=n).astype(np.int32)
+    protos = rng.randn(K, D).astype(np.float32)
+    x_all = alpha * protos[y] + rng.randn(n, D).astype(np.float32)
+    edges = np.concatenate([[0], np.cumsum(counts)])
+    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(C)}
+    store = FederatedStore(x_all[:tot], y[:tot], parts, batch_size=10)
+    test = batch_global(x_all[tot:], y[tot:], 100)
+
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=10,
+                    comm_round=120, epochs=1, batch_size=10, lr=0.03,
+                    frequency_of_the_test=1000)
+    api = FedAvgAPI(LogisticRegression(num_classes=K), store, test, cfg)
+    acc0 = api.evaluate()["accuracy"]
+    losses = [api.train_one_round(r)["train_loss"] for r in range(120)]
+
+    assert np.isfinite(losses).all()
+    early, late = np.mean(losses[:10]), np.mean(losses[-10:])
+    assert late < 0.5 * early, (early, late)
+    # The BASELINE.md row's figure of merit: >75% past 100 rounds.
+    acc = api.evaluate()["accuracy"]
+    assert acc0 < 0.2 < 0.75 < acc, (acc0, acc)
