@@ -189,6 +189,70 @@ def bench_cifar_resnet56(profile_dir=None):
     }
 
 
+def _warm_store_buckets(api, store, counts, cpr, batch):
+    """Warm EVERY cohort-shape bucket a FederatedStore can produce (a
+    cohort's step count is the power-of-two bucket of its max client) so
+    no XLA compile lands inside the timed window — sampled warmup rounds
+    do not reliably cover all buckets. Shared by every store-backed
+    bench section."""
+    import jax
+
+    from fedml_tpu.data.store import _bucket_steps
+
+    buckets = np.array([_bucket_steps(int(np.ceil(c / batch)))
+                        for c in counts])
+    for bkt in sorted(set(buckets)):
+        c = int(np.argmax(buckets == bkt))
+        sub = store.gather_cohort(np.full(cpr, c))
+        w = np.asarray(sub.counts, np.float32)
+        api.round_fn(api.net, sub.x, sub.y, sub.mask, w, w,
+                     jax.random.PRNGKey(0))
+    api.train_one_round(0)
+    jax.block_until_ready(api.net.params)
+
+
+def _timed_store_windows(api, store, windows=3, window=10,
+                         count_samples=False):
+    """Median rounds/sec (and samples/sec) over ``windows`` timed windows
+    of ``window`` store-backed rounds. Synced per-round loop BY DEFAULT:
+    through the axon tunnel a flood of unsynced dispatches costs more
+    than the per-round float(loss) sync saves (A/B'd 2026-07-30, ~8.8 vs
+    ~5.5 rounds/sec — the prefetch worker already overlaps the next
+    gather with the wait). That floor is a TUNNEL property: on a
+    directly-attached chip set BENCH_ATTACHED=1 to time the pipelined
+    loop instead (docs/PLATFORMS.md). Windowed medians because these
+    sections are dispatch-RTT-heavy and single windows swing with tunnel
+    variance."""
+    import os
+
+    attached = os.environ.get("BENCH_ATTACHED") == "1"
+    rps_w, sps_w, r = [], [], 1
+    for _ in range(windows):
+        samples = 0
+        if count_samples:
+            for rr in range(r, r + window):
+                idx, _ = api._sample_round_uncached(rr)
+                samples += int(
+                    np.asarray(store.counts)[np.asarray(idx)].sum())
+        t0 = time.perf_counter()
+        if attached:
+            losses = api.train_rounds_pipelined(window, start_round=r)
+            assert np.isfinite(losses).all()
+        else:
+            for rr in range(r, r + window):
+                m = api.train_one_round(rr)
+            assert np.isfinite(m["train_loss"])
+        dt = time.perf_counter() - t0
+        rps_w.append(window / dt)
+        sps_w.append(samples / dt)
+        r += window
+    out = {"loop": "pipelined" if attached else "synced",
+           "rounds_per_sec": round(statistics.median(rps_w), 3)}
+    if count_samples:
+        out["samples_per_sec"] = round(statistics.median(sps_w), 2)
+    return out
+
+
 def bench_femnist_cnn_3400():
     """BASELINE.md shallow-NN row at its TRUE client count: 3400 writers,
     10/round, batch 20, Reddi'20 CNN — host-resident FederatedStore
@@ -213,62 +277,10 @@ def bench_femnist_cnn_3400():
     cfg = FedConfig(client_num_in_total=n_clients, client_num_per_round=cpr,
                     comm_round=40, epochs=1, batch_size=batch, lr=0.1)
     api = FedAvgAPI(CNNDropOut(num_classes=62), store, None, cfg)
-    # Warm EVERY cohort-shape bucket this store can produce (a cohort's
-    # step count is the power-of-two bucket of its max client) so no XLA
-    # compile lands inside the timed window — sampled warmup rounds do
-    # not reliably cover all buckets.
-    from fedml_tpu.data.store import _bucket_steps
-
-    client_buckets = np.array(
-        [_bucket_steps(int(np.ceil(c / batch))) for c in counts])
-    for bkt in sorted(set(client_buckets)):
-        c = int(np.argmax(client_buckets == bkt))
-        sub = store.gather_cohort(np.full(cpr, c))
-        w = np.asarray(sub.counts, np.float32)
-        api.round_fn(api.net, sub.x, sub.y, sub.mask, w, w,
-                     jax.random.PRNGKey(0))
-    api.train_one_round(0)
-    jax.block_until_ready(api.net.params)
-
-    # Synced per-round loop BY DEFAULT: measured FASTER than deferring
-    # the loss fetches through the axon tunnel (the prefetch worker
-    # already overlaps the next round's gather with the float(loss)
-    # wait, and flooding the remote tunnel with unsynced dispatches
-    # costs more than the sync saves — A/B'd 2026-07-30, ~8.8 vs ~5.5
-    # rounds/sec). That floor is a TUNNEL property, not a framework one:
-    # on a directly-attached chip set BENCH_ATTACHED=1 to time the
-    # pipelined loop (async dispatch, losses fetched once per window)
-    # instead — see docs/PLATFORMS.md. Three 10-round windows, median:
-    # this submetric is dispatch-RTT-heavy, so single windows swing
-    # with tunnel variance.
-    import os
-
-    attached = os.environ.get("BENCH_ATTACHED") == "1"
-    window, rps_w, sps_w, r = 10, [], [], 1
-    for _ in range(3):
-        samples = 0
-        for rr in range(r, r + window):
-            idx, _ = api._sample_round_uncached(rr)
-            samples += int(np.asarray(store.counts)[np.asarray(idx)].sum())
-        t0 = time.perf_counter()
-        if attached:
-            losses = api.train_rounds_pipelined(window, start_round=r)
-            assert np.isfinite(losses).all()
-        else:
-            for rr in range(r, r + window):
-                m = api.train_one_round(rr)
-            assert np.isfinite(m["train_loss"])
-        dt = time.perf_counter() - t0
-        rps_w.append(window / dt)
-        sps_w.append(samples / dt)
-        r += window
-    return {
-        "clients": n_clients,
-        "loop": "pipelined" if attached else "synced",
-        "rounds_per_sec": round(statistics.median(rps_w), 3),
-        "samples_per_sec": round(statistics.median(sps_w), 2),
-        "host_dataset_mb": round(store.nbytes() / 1e6, 1),
-    }
+    _warm_store_buckets(api, store, counts, cpr, batch)
+    timed = _timed_store_windows(api, store, count_samples=True)
+    return {"clients": n_clients, **timed,
+            "host_dataset_mb": round(store.nbytes() / 1e6, 1)}
 
 
 def bench_stackoverflow_342k():
@@ -298,45 +310,13 @@ def bench_stackoverflow_342k():
                     lr=10 ** -0.5)  # BASELINE.md row lr
     api = FedAvgAPI(RNNStackOverflow(vocab_size=V), store, None, cfg,
                     loss_fn=partial(seq_softmax_ce, pad_id=0), pad_id=0)
-    # Warm every power-of-two step bucket (same rationale as FEMNIST).
-    from fedml_tpu.data.store import _bucket_steps
-
-    buckets = np.array([_bucket_steps(int(np.ceil(c / batch)))
-                        for c in counts])
-    import jax
-
-    for bkt in sorted(set(buckets)):
-        c = int(np.argmax(buckets == bkt))
-        sub = store.gather_cohort(np.full(cpr, c))
-        w = np.asarray(sub.counts, np.float32)
-        api.round_fn(api.net, sub.x, sub.y, sub.mask, w, w,
-                     jax.random.PRNGKey(0))
-    api.train_one_round(0)
-    jax.block_until_ready(api.net.params)
-
-    import os
-
-    attached = os.environ.get("BENCH_ATTACHED") == "1"  # PLATFORMS.md
-    window, rps_w, r = 10, [], 1
-    for _ in range(3):
-        t0 = time.perf_counter()
-        if attached:
-            losses = api.train_rounds_pipelined(window, start_round=r)
-            assert np.isfinite(losses).all()
-        else:
-            for rr in range(r, r + window):
-                m = api.train_one_round(rr)
-            assert np.isfinite(m["train_loss"])
-        rps_w.append(window / (time.perf_counter() - t0))
-        r += window
-    return {
-        "clients": C,
-        "loop": "pipelined" if attached else "synced",
-        "rounds_per_sec": round(statistics.median(rps_w), 3),
-        "host_dataset_mb": round(store.nbytes() / 1e6, 1),
-        "host_rss_mb": round(
-            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 0),
-    }
+    _warm_store_buckets(api, store, counts, cpr, batch)
+    timed = _timed_store_windows(api, store)
+    return {"clients": C, **timed,
+            "host_dataset_mb": round(store.nbytes() / 1e6, 1),
+            "host_rss_mb": round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024,
+                0)}
 
 
 def bench_vit():

@@ -268,6 +268,7 @@ class FedAvgAPI(FederatedLoop):
                                         tree_spec(global_net.params))
                 return NetState(params, client_net.model_state)
 
+            transform.wants_rng = True  # run_clients_guarded's 3-arg form
             return transform
         raise ValueError(
             f"cfg.compress={name!r}: simulator rounds support "
@@ -431,6 +432,12 @@ class FedAvgAPI(FederatedLoop):
         if captured is not None:
             self._round_client_losses = None  # one round's observable
             losses = np.asarray(captured, np.float64)
+            # A diverged client (nan_guard off) must not write NaN into
+            # its utility: argsort ranks NaN last forever, silently
+            # blacklisting the client from exploitation. Zero matches the
+            # nan_guard convention (deprioritized, staleness bonus still
+            # recovers it).
+            losses = np.where(np.isfinite(losses), losses, 0.0)
         elif self._streaming:
             cached = getattr(self, "_stream_last", None)
             if cached is not None and cached[0] == round_idx and \

@@ -93,6 +93,182 @@ def ring_attention_sharded(q, k, v, axis_name: str, causal: bool = False):
     return o / denom
 
 
+# ---------------------------------------------------------------------------
+# Ring attention with the pallas flash kernels as the per-shard computation
+# (r3): each (Q-shard x KV-block) partial runs the fused MXU kernel instead
+# of dense einsums; per-block (o, lse) pairs merge with log-sum-exp algebra.
+# Backward is its OWN ring pass (Liu & Abbeel §3.2) reusing the block-level
+# FlashAttention-2 kernels: the dk/dv accumulators rotate WITH their K/V
+# blocks so every gradient lands home after n permutes, and dq accumulates
+# locally — wired through jax.custom_vjp, so AD never needs to transpose a
+# ppermute.
+# ---------------------------------------------------------------------------
+
+
+def _to3(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from3(x3, b, h):
+    bh, t, d = x3.shape
+    return x3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _ring_cases(my, src, causal):
+    """0 = full block (src strictly before my), 1 = diagonal (causal
+    within the block), 2 = skip (entirely above the causal diagonal)."""
+    if not causal:
+        return jnp.int32(0)
+    return jnp.where(src == my, 1, jnp.where(src < my, 0, 2)).astype(jnp.int32)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_flash_attention_sharded(q, k, v, axis_name: str, causal: bool = False):
+    """Flash-kernel ring attention body (run INSIDE shard_map): local
+    shards [B, T_local, H, D] → local output shard. Exact attention —
+    matches :func:`ring_attention_sharded` / dense to numerical
+    precision, at flash-kernel speed and O(T_local) memory per step."""
+    o3, _ = _ring_flash_fwd_core(q, k, v, axis_name, causal)
+    return _from3(o3, q.shape[0], q.shape[2])
+
+
+def _ring_flash_fwd_core(q, k, v, axis_name, causal):
+    from fedml_tpu.ops.flash_attention import _SUB, NEG_INF, _blk, _fwd
+
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    bq, bk = _blk(t, 256), _blk(t, 512)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    q3 = _to3(q)
+    bh = b * h
+
+    def block(kind, k3, v3):
+        def full(_):
+            return _fwd(q3, k3, v3, scale, False, bq, bk)
+
+        def diag(_):
+            return _fwd(q3, k3, v3, scale, True, bq, bk)
+
+        def skip(_):
+            return (jnp.zeros_like(q3),
+                    jnp.full((bh, _SUB, t), NEG_INF, jnp.float32))
+
+        return jax.lax.switch(kind, (full, diag, skip), None)
+
+    def accumulate(i, o_acc, lse_acc, k_cur, v_cur):
+        src = (my - i) % n
+        o_b3, lse_b = block(_ring_cases(my, src, causal),
+                            _to3(k_cur), _to3(v_cur))
+        lse_b = lse_b[:, 0, :]  # [bh, t]
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        w_a = jnp.exp(lse_acc - lse_new)[..., None]
+        w_b = jnp.exp(lse_b - lse_new)[..., None]
+        # f32 rescale-and-add: with bf16 inputs the per-step rounding
+        # would otherwise compound across ring steps (the backward's
+        # accumulators are f32 for the same reason).
+        return (o_acc * w_a + o_b3.astype(jnp.float32) * w_b), lse_new
+
+    def step(i, carry):
+        o_acc, lse_acc, k_cur, v_cur = carry
+        o_acc, lse_acc = accumulate(i, o_acc, lse_acc, k_cur, v_cur)
+        return (o_acc, lse_acc,
+                jax.lax.ppermute(k_cur, axis_name, perm),
+                jax.lax.ppermute(v_cur, axis_name, perm))
+
+    o0 = jnp.zeros_like(q3, jnp.float32)
+    lse0 = jnp.full((bh, t), NEG_INF, jnp.float32)
+    # n-1 rotating steps + the final block without the dead trailing permute.
+    o_acc, lse_acc, k_last, v_last = jax.lax.fori_loop(
+        0, n - 1, step, (o0, lse0, k, v))
+    o_acc, lse_acc = accumulate(n - 1, o_acc, lse_acc, k_last, v_last)
+    return o_acc.astype(q.dtype), lse_acc
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal):
+    o3, lse = _ring_flash_fwd_core(q, k, v, axis_name, causal)
+    return (_from3(o3, q.shape[0], q.shape[2]),
+            (q, k, v, o3, lse))
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, res, do):
+    """Backward ring pass: (k, v, dk_acc, dv_acc) rotate together — after
+    n permutes every dk/dv accumulator is back on its owner with every
+    Q-shard's contribution; dq accumulates locally."""
+    from fedml_tpu.ops.flash_attention import _SUB, _bwd, _blk
+
+    q, k, v, o3, lse = res
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    bq, bk = _blk(t, 256), _blk(t, 512)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    q3, do3 = _to3(q), _to3(do)
+    lse_sub = jnp.broadcast_to(lse[:, None, :], (lse.shape[0], _SUB,
+                                                 lse.shape[1]))
+
+    def block_bwd(kind, k3, v3):
+        def run(causal_flag):
+            return lambda _: _bwd(q3, k3, v3, o3, lse_sub, do3, scale,
+                                  causal_flag, bq, bk)
+
+        def skip(_):
+            return (jnp.zeros_like(q3), jnp.zeros_like(k3),
+                    jnp.zeros_like(v3))
+
+        return jax.lax.switch(kind, (run(False), run(True), skip), None)
+
+    def step(i, carry):
+        dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (my - i) % n
+        dq_c, dk_c, dv_c = block_bwd(_ring_cases(my, src, causal),
+                                     _to3(k_cur), _to3(v_cur))
+        dq_acc = dq_acc + dq_c.astype(dq_acc.dtype)
+        dk_cur = dk_cur + _from3(dk_c, b, h).astype(dk_cur.dtype)
+        dv_cur = dv_cur + _from3(dv_c, b, h).astype(dv_cur.dtype)
+        return (dq_acc,
+                jax.lax.ppermute(k_cur, axis_name, perm),
+                jax.lax.ppermute(v_cur, axis_name, perm),
+                jax.lax.ppermute(dk_cur, axis_name, perm),
+                jax.lax.ppermute(dv_cur, axis_name, perm))
+
+    dq0 = jnp.zeros_like(q3, jnp.float32)
+    carry = (dq0, k, v, jnp.zeros_like(k, jnp.float32),
+             jnp.zeros_like(v, jnp.float32))
+    # Full n steps each ending in a permute: the dk/dv accumulators make a
+    # complete loop and land back on their owners.
+    dq_acc, _, _, dk_home, dv_home = jax.lax.fori_loop(0, n, step, carry)
+    return (_from3(dq_acc, b, h).astype(q.dtype),
+            dk_home.astype(k.dtype), dv_home.astype(v.dtype))
+
+
+ring_flash_attention_sharded.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def make_ring_flash_attention(mesh, axis_name: str = "sp",
+                              causal: bool = False):
+    """[B, T, H, D] full arrays → exact attention with the pallas flash
+    kernels per shard; sequence axis sharded over ``mesh[axis_name]``.
+    Drop-in for :func:`make_ring_attention` (same pluggable attn_fn
+    contract), differentiable."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
+        out_specs=P(None, axis_name),
+        check_vma=False,
+    )
+    def attn(q, k, v):
+        return ring_flash_attention_sharded(q, k, v, axis_name,
+                                            causal=causal)
+
+    return attn
+
+
 def make_ring_attention(mesh, axis_name: str = "sp", causal: bool = False):
     """[B, T, H, D] full arrays → exact attention, sequence axis sharded
     over ``mesh[axis_name]``; output replicates the input sharding."""

@@ -46,23 +46,19 @@ def run_clients_guarded(local_train, client_transform, nan_guard,
     so the guard semantics can never drift between them.
 
     ``client_transform`` is ``(global_net, client_net) -> client_net``,
-    or ``(global_net, client_net, rng) -> client_net`` for randomized
+    or — when the builder marked it ``transform.wants_rng = True`` —
+    ``(global_net, client_net, rng) -> client_net`` for randomized
     transforms (stochastic quantization): the 3-arg form receives a
     per-client stream forked from the round's client rngs (fold_in with
     a transform-reserved constant, so it never collides with the streams
-    local training consumed for shuffling/dropout/DP noise)."""
+    local training consumed for shuffling/dropout/DP noise). An explicit
+    attribute, not signature sniffing: partials and C-implemented
+    callables would defeat ``inspect`` silently."""
     client_nets, losses = jax.vmap(
         local_train, in_axes=(None, 0, 0, 0, 0)
     )(net, x, y, mask, rngs)
     if client_transform is not None:
-        import inspect
-
-        try:
-            wants_rng = len(
-                inspect.signature(client_transform).parameters) >= 3
-        except (TypeError, ValueError):
-            wants_rng = False
-        if wants_rng:
+        if getattr(client_transform, "wants_rng", False):
             trngs = jax.vmap(
                 lambda r: jax.random.fold_in(r, 0x7F))(rngs)
             client_nets = jax.vmap(client_transform, in_axes=(None, 0, 0))(
