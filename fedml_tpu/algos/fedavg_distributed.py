@@ -333,7 +333,7 @@ def build_federation_setup(model, train_fed: FederatedArrays, test_global,
     args = Args()
     if backend == "LOOPBACK":
         args.network = LoopbackNetwork(size)
-    elif backend in ("TCP", "GRPC"):
+    elif backend in ("TCP", "GRPC", "TRPC"):
         # Single-host table on ephemeral ports: bind rank servers first
         # (port 0), then share the resolved table. Multi-host deployments
         # pass an explicit host_table / grpc_ipconfig.csv instead.

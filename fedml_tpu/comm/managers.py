@@ -38,6 +38,10 @@ def _build_backend(args, rank: int, size: int, backend: str) -> BaseCommunicatio
         from fedml_tpu.comm.mqtt import MqttCommManager
 
         return MqttCommManager(args.mqtt_host, args.mqtt_port, rank, size)
+    if backend == "TRPC":
+        from fedml_tpu.comm.trpc import TRPCCommManager
+
+        return TRPCCommManager(args.host_table, rank)
     raise ValueError(f"unknown comm backend {backend!r}")
 
 

@@ -1,0 +1,189 @@
+"""TRPC-role comm backend: synchronous acknowledged RPC sends with
+tensor-aware wire framing.
+
+Parity: the reference's TRPC backend (torch.distributed.rpc/TensorPipe,
+fedml_core/distributed/communication/trpc/trpc_comm_manager.py:25) gives
+two things its other backends lack: (1) ``send_message`` is an
+acknowledged remote call — ``rpc_sync`` blocks until the receiver's
+servicer has enqueued the message and returned its "message received"
+response (trpc_server.py:28-42); (2) TensorPipe moves tensors without
+pickling them. This module reproduces both TPU-natively: every send is a
+length-prefixed request frame answered by an ACK on the same connection,
+and the payload uses the ``tensor`` wire format
+(fedml_tpu.comm.wire — raw array buffers + JSON structure header, no
+pickle anywhere on the wire).
+
+Config parity: ``TRPCCommManager(trpc_master_config_path=...)`` reads
+the reference's master CSV (header line, then ``address,port`` —
+trpc_comm_manager.py:36-39); worker ``w`` listens on
+``master_port + w``, mirroring the rendezvous-derived worker addressing.
+Tests construct with an explicit ``ip_config`` table instead (same shape
+as the TCP backend's).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from queue import Empty, Queue
+from typing import Dict, List, Optional, Tuple
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.wire import deserialize_message, serialize_message
+
+_ACK = b"\x06"  # the servicer's "message received" response, one byte
+
+
+def read_master_config(path: str) -> Tuple[str, int]:
+    """Reference master CSV: one header line, then ``address,port``."""
+    import csv
+
+    with open(path, newline="") as f:
+        rows = csv.reader(f)
+        next(rows)  # header
+        address, port = next(rows)[:2]
+    return address.strip(), int(port)
+
+
+def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        part = conn.recv(n)
+        if not part:
+            return None
+        chunks.append(part)
+        n -= len(part)
+    return b"".join(chunks)
+
+
+class TRPCCommManager(BaseCommunicationManager):
+    """One instance per rank; see module docstring for the contract."""
+
+    def __init__(self, ip_config: Optional[Dict[int, Tuple[str, int]]] = None,
+                 rank: int = 0, *, trpc_master_config_path: Optional[str] = None,
+                 world_size: int = 0):
+        if ip_config is None:
+            if trpc_master_config_path is None:
+                raise ValueError(
+                    "need ip_config or trpc_master_config_path")
+            if world_size <= 0:
+                raise ValueError(
+                    "trpc_master_config_path requires world_size > 0 "
+                    "(worker w listens on master_port + w)")
+            host, base = read_master_config(trpc_master_config_path)
+            ip_config = {r: (host, base + r) for r in range(world_size)}
+        self.rank = rank
+        self.ip_config = ip_config  # shared BY REFERENCE (ephemeral ports)
+        self._queue: Queue = Queue()
+        self._observers: List[Observer] = []
+        self._running = False
+        self._conns: Dict[int, socket.socket] = {}
+        self._send_lock = threading.Lock()
+
+        self._server = socket.create_server(
+            (ip_config[rank][0], ip_config[rank][1]), backlog=64)
+        self._server.settimeout(0.2)
+        # Ephemeral-port resolution back into the shared table (TCP
+        # backend convention: single-host tests bind port 0 first).
+        self.ip_config[rank] = (ip_config[rank][0],
+                                self._server.getsockname()[1])
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._alive = True
+        self._accept_thread.start()
+
+    # -- server side -------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while self._alive:
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while self._alive:
+                head = _recv_exact(conn, 8)
+                if head is None:
+                    return
+                (n,) = struct.unpack("<Q", head)
+                payload = _recv_exact(conn, n)
+                if payload is None:
+                    return
+                # Enqueue BEFORE acking: the ack is the rpc_sync return —
+                # after send_message returns, the message is guaranteed
+                # queued on the receiver.
+                self._queue.put(deserialize_message(payload, "tensor"))
+                conn.sendall(_ACK)
+
+    # -- BaseCommunicationManager ------------------------------------------
+    def send_message(self, msg: Message, retries: int = 20,
+                     backoff_s: float = 0.5) -> None:
+        """rpc_sync semantics: returns only after the receiver acked the
+        enqueue. Connect retries until a peer is first reached (workers
+        start in any order), then failures surface immediately."""
+        receiver = int(msg.get_receiver_id())
+        blob = serialize_message(msg, "tensor")
+        head = struct.pack("<Q", len(blob))
+        with self._send_lock:
+            first_contact = receiver not in self._conns
+            for attempt in range(retries + 1 if first_contact else 1):
+                try:
+                    conn = self._conns.get(receiver)
+                    if conn is None:
+                        conn = socket.create_connection(
+                            self.ip_config[receiver], timeout=30)
+                        self._conns[receiver] = conn
+                    # Two sendalls: concatenating would copy the whole
+                    # (possibly model-sized) blob a second time.
+                    conn.sendall(head)
+                    conn.sendall(blob)
+                    if _recv_exact(conn, 1) != _ACK:
+                        raise ConnectionError("bad ack")
+                    return
+                except OSError:
+                    self._conns.pop(receiver, None)
+                    if attempt >= (retries if first_contact else 0):
+                        raise
+                    time.sleep(backoff_s)
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        """Blocking dispatch loop over the servicer queue (the reference's
+        message_handling_subroutine, trpc_comm_manager.py:~128)."""
+        self._running = True
+        while self._running:
+            try:
+                msg = self._queue.get(timeout=0.2)
+            except Empty:
+                continue
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+
+    def close(self) -> None:
+        self._alive = False
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        for conn in self._conns.values():
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._conns.clear()

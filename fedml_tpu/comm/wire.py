@@ -1,7 +1,7 @@
 """Message ↔ bytes wire serialization shared by the socket-level backends
-(tcp, grpc_backend).
+(tcp, grpc_backend, trpc).
 
-Two formats, selected per manager and auto-detectable per frame:
+Three formats, selected per manager:
 
 - ``pickle`` — pickled ``Message`` param dict, the same wire content the
   reference's MPI backend ships (mpi_send_thread.py:27). Fast; assumes
@@ -9,13 +9,116 @@ Two formats, selected per manager and auto-detectable per frame:
 - ``json`` — ``Message.to_json`` (message.py:5-74 parity), safe against
   malicious payloads; the format for untrusted/mobile edges (is_mobile
   nested-list encoding included).
+- ``tensor`` — TENSOR-AWARE framing, the TensorPipe role (the reference's
+  TRPC backend exists to move tensors without pickling them): a JSON
+  header describing the nested structure + the arrays' raw buffers
+  appended verbatim. Arrays (numpy/jax, any dtype incl. bfloat16) are
+  never pickled — decode is ``np.frombuffer`` per buffer — and the
+  format is safe to parse (no code execution). NetState payloads are
+  first-class.
 """
 
 from __future__ import annotations
 
+import json
+import struct
+
+import numpy as np
+
 from fedml_tpu.comm.message import Message
 
-WIRE_FORMATS = ("pickle", "json")
+WIRE_FORMATS = ("pickle", "json", "tensor")
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 etc. (registered by jax's dep)
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_obj(obj, bufs):
+    from fedml_tpu.trainer.local import NetState
+
+    if isinstance(obj, NetState):
+        return {"t": "net", "p": _encode_obj(obj.params, bufs),
+                "s": _encode_obj(obj.model_state, bufs)}
+    if isinstance(obj, dict):
+        for k in obj:
+            if not isinstance(k, str):
+                # json would silently stringify int keys (3 → "3"),
+                # diverging from the pickle wire; fail loudly instead.
+                raise TypeError(
+                    f"tensor wire requires string dict keys, got "
+                    f"{type(k).__name__} key {k!r}")
+        return {"t": "d", "v": {k: _encode_obj(v, bufs)
+                                for k, v in obj.items()}}
+    if isinstance(obj, (list, tuple)):
+        return {"t": "l" if isinstance(obj, list) else "tu",
+                "v": [_encode_obj(v, bufs) for v in obj]}
+    if obj is None or isinstance(obj, (bool, str, int, float)):
+        return {"t": "s", "v": obj}
+    if hasattr(obj, "__array__"):  # numpy / jax arrays, numpy scalars
+        arr = np.asarray(obj)
+        if arr.dtype.byteorder == ">":
+            # dtype.name drops byte order; normalize to native so the
+            # decoder's frombuffer reads the values it was sent.
+            arr = arr.astype(arr.dtype.newbyteorder("="))
+        bufs.append(arr.tobytes())
+        return {"t": "a", "dtype": arr.dtype.name, "shape": list(arr.shape)}
+    raise TypeError(
+        f"tensor wire cannot encode {type(obj).__name__} (arrays, "
+        "dicts/lists/tuples, scalars and NetState only — no pickling)")
+
+
+def _decode_obj(node, bufs, pos):
+    """Returns (value, next_buffer_index)."""
+    t = node["t"]
+    if t == "net":
+        from fedml_tpu.trainer.local import NetState
+
+        p, pos = _decode_obj(node["p"], bufs, pos)
+        s, pos = _decode_obj(node["s"], bufs, pos)
+        return NetState(p, s), pos
+    if t == "d":
+        out = {}
+        for k, v in node["v"].items():
+            out[k], pos = _decode_obj(v, bufs, pos)
+        return out, pos
+    if t in ("l", "tu"):
+        items = []
+        for v in node["v"]:
+            item, pos = _decode_obj(v, bufs, pos)
+            items.append(item)
+        return (items if t == "l" else tuple(items)), pos
+    if t == "s":
+        return node["v"], pos
+    if t == "a":
+        arr = np.frombuffer(bufs[pos], dtype=_np_dtype(node["dtype"]))
+        return arr.reshape(node["shape"]), pos + 1
+    raise ValueError(f"tensor wire: unknown node type {t!r}")
+
+
+def _tensor_encode(params: dict) -> bytes:
+    bufs: list = []
+    meta = _encode_obj(params, bufs)
+    header = json.dumps({"meta": meta,
+                         "lens": [len(b) for b in bufs]}).encode()
+    return struct.pack("<I", len(header)) + header + b"".join(bufs)
+
+
+def _tensor_decode(payload: bytes) -> dict:
+    (hlen,) = struct.unpack_from("<I", payload)
+    header = json.loads(payload[4:4 + hlen].decode())
+    bufs, off = [], 4 + hlen
+    for n in header["lens"]:
+        bufs.append(payload[off:off + n])
+        off += n
+    out, used = _decode_obj(header["meta"], bufs, 0)
+    assert used == len(bufs)
+    return out
 
 
 def serialize_message(msg: Message, wire: str) -> bytes:
@@ -25,6 +128,8 @@ def serialize_message(msg: Message, wire: str) -> bytes:
         return pickle.dumps(msg.get_params(), protocol=pickle.HIGHEST_PROTOCOL)
     if wire == "json":
         return msg.to_json().encode()
+    if wire == "tensor":
+        return _tensor_encode(msg.get_params())
     raise ValueError(f"unknown wire format {wire!r}")
 
 
@@ -37,4 +142,8 @@ def deserialize_message(payload: bytes, wire: str) -> Message:
         return msg
     if wire == "json":
         return Message.from_json(payload.decode())
+    if wire == "tensor":
+        msg = Message()
+        msg.init(_tensor_decode(payload))
+        return msg
     raise ValueError(f"unknown wire format {wire!r}")

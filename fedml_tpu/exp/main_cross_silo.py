@@ -63,7 +63,7 @@ def main(argv=None):
                         help="grpc_ipconfig.csv-format rank,host[,port] table")
     parser.add_argument("--port_base", type=int, default=DEFAULT_PORT_BASE)
     parser.add_argument("--comm_backend", type=str, default="TCP",
-                        choices=["TCP", "GRPC"],
+                        choices=["TCP", "GRPC", "TRPC"],
                         help="cross-silo transport: native C++ msgnet TCP "
                              "or grpcio (proto/comm.proto wire)")
     # --compress comes from the shared add_args flag set: here it is the

@@ -7,7 +7,9 @@ model/cv/pretrained/). TPU-native formats:
 - ``save_params`` / ``load_params``: flat ``.npz`` of the NetState (params +
   model_state), path-keyed — portable, no pickle;
 - orbax checkpoints from fedml_tpu.obs.checkpoint restore full run state;
-  this module is for model-only weights (zoo distribution).
+  this module is for model-only weights (zoo distribution);
+- the reference's actual torch ``.pth`` files convert via
+  fedml_tpu.models.torch_convert (forward-equivalence-tested mapping).
 """
 
 from __future__ import annotations

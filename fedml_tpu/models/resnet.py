@@ -67,8 +67,13 @@ class BottleneckBlock(nn.Module):
         y = nn.Conv(self.planes, (1, 1), use_bias=False, dtype=self.dtype)(x)
         y = Norm(self.norm, dtype=self.dtype)(y, train)
         y = nn.relu(y)
+        # Explicit (1,1) padding == torch conv3x3(padding=1): identical to
+        # "SAME" at stride 1, and at stride 2 it keeps the reference's
+        # sampling grid (SAME would pad (0,1) and shift the windows) — so
+        # converted torch checkpoints reproduce outputs exactly.
         y = nn.Conv(self.planes, (3, 3), (self.strides, self.strides),
-                    padding="SAME", use_bias=False, dtype=self.dtype)(y)
+                    padding=((1, 1), (1, 1)), use_bias=False,
+                    dtype=self.dtype)(y)
         y = Norm(self.norm, dtype=self.dtype)(y, train)
         y = nn.relu(y)
         y = nn.Conv(self.planes * self.expansion, (1, 1), use_bias=False,
@@ -94,12 +99,14 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         residual = x
+        # torch conv3x3(padding=1) grid — see BottleneckBlock.
         y = nn.Conv(self.planes, (3, 3), (self.strides, self.strides),
-                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+                    padding=((1, 1), (1, 1)), use_bias=False,
+                    dtype=self.dtype)(x)
         y = Norm(self.norm, dtype=self.dtype)(y, train)
         y = nn.relu(y)
-        y = nn.Conv(self.planes, (3, 3), padding="SAME", use_bias=False,
-                    dtype=self.dtype)(y)
+        y = nn.Conv(self.planes, (3, 3), padding=((1, 1), (1, 1)),
+                    use_bias=False, dtype=self.dtype)(y)
         y = Norm(self.norm, dtype=self.dtype)(y, train)
         if residual.shape != y.shape:
             residual = nn.Conv(

@@ -1,0 +1,124 @@
+"""Convert the reference's torch ``.pth`` checkpoints to flax NetStates.
+
+The reference ships pretrained CIFAR ResNet weights as torch state_dicts
+(``model/cv/pretrained/{CIFAR10,CIFAR100,CINIC10}/resnet56``, loaded by
+``resnet56(pretrained=True, path=...)`` — model/cv/resnet.py:209-220,
+including the DataParallel ``module.`` prefix strip). Zero egress means
+those files cannot be fetched here, but torch (CPU) is available, so the
+PORT is implemented and proven: :func:`convert_torch_cifar_resnet` maps a
+torch ``ResNet(Bottleneck/BasicBlock, [n,n,n])`` state_dict onto
+``CifarResNet(norm="bn")`` — weights, biases AND BatchNorm running stats
+— and the test suite verifies converted models reproduce the torch
+model's forward outputs exactly (tests/test_torch_convert.py). Point
+:func:`load_torch_checkpoint` at a real reference ``.pth`` and it loads.
+
+Layout conversions: torch conv ``(O, I, kh, kw)`` → flax HWIO
+``(kh, kw, I, O)``; linear ``(O, I)`` → ``(I, O)``; BatchNorm
+``weight/bias`` → ``scale/bias``; ``running_mean/var`` →
+``batch_stats .../mean,var``. ``num_batches_tracked`` is dropped (flax
+keeps no equivalent).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import numpy as np
+
+from fedml_tpu.trainer.local import NetState
+
+
+def _torch_key(flax_path, layers: Sequence[int]) -> str:
+    """Reference torch parameter name for one CifarResNet flax path."""
+    keys = [str(getattr(k, "key", k)) for k in flax_path]
+    if keys[0] == "batch_stats":
+        keys = keys[1:]
+    head, leaf = keys[0], keys[-1]
+    suffix = {"kernel": "weight", "scale": "weight", "bias": "bias",
+              "mean": "running_mean", "var": "running_var"}[leaf]
+
+    if head == "Conv_0":  # stem conv
+        return "conv1.weight"
+    if head == "Norm_0":  # stem norm
+        return f"bn1.{suffix}"
+    if head == "Dense_0":  # classifier
+        return f"fc.{suffix}"
+    if head.startswith(("BottleneckBlock_", "BasicBlock_")):
+        blk = int(head.split("_")[1])
+        stage, offset = 0, 0
+        while blk - offset >= layers[stage]:
+            offset += layers[stage]
+            stage += 1
+        prefix = f"layer{stage + 1}.{blk - offset}"
+        part = keys[1]
+        if part == "downsample":
+            return f"{prefix}.downsample.0.weight"
+        if part.startswith("Conv_"):
+            return f"{prefix}.conv{int(part.split('_')[1]) + 1}.{suffix}"
+        if part.startswith("Norm_"):
+            j = int(part.split("_")[1])
+            n_main = 3 if head.startswith("Bottleneck") else 2
+            if j == n_main:  # the downsample branch's norm
+                return f"{prefix}.downsample.1.{suffix}"
+            return f"{prefix}.bn{j + 1}.{suffix}"
+    raise KeyError(f"no torch mapping for flax path {'/'.join(keys)}")
+
+
+def _convert_leaf(torch_arr: np.ndarray, flax_leaf) -> np.ndarray:
+    arr = np.asarray(torch_arr)
+    if arr.ndim == 4:  # conv (O, I, kh, kw) -> (kh, kw, I, O)
+        arr = arr.transpose(2, 3, 1, 0)
+    elif arr.ndim == 2:  # linear (O, I) -> (I, O)
+        arr = arr.T
+    if arr.shape != flax_leaf.shape:
+        raise ValueError(
+            f"converted shape {arr.shape} != model shape {flax_leaf.shape}")
+    return arr.astype(np.asarray(flax_leaf).dtype)
+
+
+def convert_torch_cifar_resnet(state_dict: Dict, net: NetState,
+                               layers: Sequence[int] = (6, 6, 6)) -> NetState:
+    """Map a reference torch CIFAR-ResNet state_dict onto ``net`` (a
+    ``CifarResNet(norm="bn")`` NetState). Strict both ways: every model
+    leaf must find its torch tensor, and every torch tensor (except
+    ``num_batches_tracked``) must be consumed — a partially-matching
+    checkpoint (wrong depth/width) raises instead of silently loading
+    the common prefix."""
+    sd = {k[len("module."):] if k.startswith("module.") else k: v
+          for k, v in state_dict.items()
+          if not k.endswith("num_batches_tracked")}
+    used = set()
+
+    def rebuild(tree):
+        def visit(path, leaf):
+            tk = _torch_key(path, layers)
+            if tk not in sd:
+                raise KeyError(
+                    f"torch checkpoint is missing {tk!r} (wanted by flax "
+                    f"path {'/'.join(str(getattr(k, 'key', k)) for k in path)})")
+            used.add(tk)
+            return _convert_leaf(sd[tk], leaf)
+
+        return jax.tree_util.tree_map_with_path(visit, tree)
+
+    out = NetState(rebuild(net.params), rebuild(net.model_state))
+    leftover = set(sd) - used
+    if leftover:
+        raise ValueError(
+            f"torch checkpoint has {len(leftover)} unused tensors "
+            f"(first: {sorted(leftover)[:3]}) — architecture mismatch?")
+    return out
+
+
+def load_torch_checkpoint(path: str, net: NetState,
+                          layers: Sequence[int] = (6, 6, 6)) -> NetState:
+    """Load a reference ``.pth`` (``{'state_dict': ...}`` wrapper or a
+    bare state_dict, DataParallel prefixes included) into ``net`` — the
+    flax analogue of ``resnet56(pretrained=True, path=...)``."""
+    import torch
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    sd = ckpt.get("state_dict", ckpt) if isinstance(ckpt, dict) else ckpt
+    sd = {k: v.numpy() if hasattr(v, "numpy") else v for k, v in sd.items()}
+    return convert_torch_cifar_resnet(sd, net, layers)
