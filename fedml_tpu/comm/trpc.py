@@ -82,6 +82,8 @@ class TRPCCommManager(BaseCommunicationManager):
         self._running = False
         self._conns: Dict[int, socket.socket] = {}
         self._send_lock = threading.Lock()
+        self._send_seq = 0  # per-sender monotone id; receiver dedupes
+        self._last_seq: Dict[int, int] = {}  # sender rank -> last enqueued
 
         self._server = socket.create_server(
             (ip_config[rank][0], ip_config[rank][1]), backlog=64)
@@ -110,17 +112,25 @@ class TRPCCommManager(BaseCommunicationManager):
     def _serve_conn(self, conn: socket.socket) -> None:
         with conn:
             while self._alive:
-                head = _recv_exact(conn, 8)
+                head = _recv_exact(conn, 16)
                 if head is None:
                     return
-                (n,) = struct.unpack("<Q", head)
+                n, seq = struct.unpack("<QQ", head)
                 payload = _recv_exact(conn, n)
                 if payload is None:
                     return
-                # Enqueue BEFORE acking: the ack is the rpc_sync return —
-                # after send_message returns, the message is guaranteed
-                # queued on the receiver.
-                self._queue.put(deserialize_message(payload, "tensor"))
+                msg = deserialize_message(payload, "tensor")
+                sender = int(msg.get_sender_id())
+                # Idempotent enqueue: a sender retry after a lost ACK
+                # re-delivers the same (sender, seq) — ack it again but
+                # never enqueue twice (a duplicate model upload would be
+                # double-counted by the aggregator).
+                if seq > self._last_seq.get(sender, -1):
+                    self._last_seq[sender] = seq
+                    # Enqueue BEFORE acking: the ack is the rpc_sync
+                    # return — after send_message returns, the message is
+                    # guaranteed queued on the receiver.
+                    self._queue.put(msg)
                 conn.sendall(_ACK)
 
     # -- BaseCommunicationManager ------------------------------------------
@@ -131,15 +141,23 @@ class TRPCCommManager(BaseCommunicationManager):
         start in any order), then failures surface immediately."""
         receiver = int(msg.get_receiver_id())
         blob = serialize_message(msg, "tensor")
-        head = struct.pack("<Q", len(blob))
         with self._send_lock:
+            self._send_seq += 1
+            head = struct.pack("<QQ", len(blob), self._send_seq)
             first_contact = receiver not in self._conns
-            for attempt in range(retries + 1 if first_contact else 1):
+            # Retries are SAFE here (unlike a naive resend): the receiver
+            # dedupes on (sender, seq), so a frame whose ACK was lost is
+            # re-acked without a second enqueue.
+            for attempt in range(retries + 1 if first_contact else 2):
                 try:
                     conn = self._conns.get(receiver)
                     if conn is None:
                         conn = socket.create_connection(
                             self.ip_config[receiver], timeout=30)
+                        # The 30s budget is for the CONNECT only; a send
+                        # of a model-sized blob (or the ack wait behind
+                        # it) on a slow link must not spuriously expire.
+                        conn.settimeout(None)
                         self._conns[receiver] = conn
                     # Two sendalls: concatenating would copy the whole
                     # (possibly model-sized) blob a second time.
@@ -150,9 +168,9 @@ class TRPCCommManager(BaseCommunicationManager):
                     return
                 except OSError:
                     self._conns.pop(receiver, None)
-                    if attempt >= (retries if first_contact else 0):
+                    if attempt >= (retries if first_contact else 1):
                         raise
-                    time.sleep(backoff_s)
+                    time.sleep(backoff_s if first_contact else 0)
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

@@ -117,7 +117,10 @@ def _tensor_decode(payload: bytes) -> dict:
         bufs.append(payload[off:off + n])
         off += n
     out, used = _decode_obj(header["meta"], bufs, 0)
-    assert used == len(bufs)
+    if used != len(bufs):  # not assert: must survive python -O
+        raise ValueError(
+            f"tensor wire: header declares {len(bufs)} buffers but the "
+            f"structure consumed {used} — corrupted or truncated frame")
     return out
 
 

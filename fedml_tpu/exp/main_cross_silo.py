@@ -64,8 +64,10 @@ def main(argv=None):
     parser.add_argument("--port_base", type=int, default=DEFAULT_PORT_BASE)
     parser.add_argument("--comm_backend", type=str, default="TCP",
                         choices=["TCP", "GRPC", "TRPC"],
-                        help="cross-silo transport: native C++ msgnet TCP "
-                             "or grpcio (proto/comm.proto wire)")
+                        help="cross-silo transport: native C++ msgnet TCP, "
+                             "grpcio (proto/comm.proto wire), or TRPC "
+                             "(acknowledged RPC sends, pickle-free tensor "
+                             "wire)")
     # --compress comes from the shared add_args flag set: here it is the
     # WIRE-LEVEL codec (none | topk<ratio> with error feedback | q<bits>
     # stochastic quantization), decoded by the server per frame.

@@ -118,7 +118,9 @@ def load_torch_checkpoint(path: str, net: NetState,
     flax analogue of ``resnet56(pretrained=True, path=...)``."""
     import torch
 
-    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    # weights_only: the supported format is a dict of tensors — never
+    # opt back into pickle code execution for externally-obtained files.
+    ckpt = torch.load(path, map_location="cpu", weights_only=True)
     sd = ckpt.get("state_dict", ckpt) if isinstance(ckpt, dict) else ckpt
     sd = {k: v.numpy() if hasattr(v, "numpy") else v for k, v in sd.items()}
     return convert_torch_cifar_resnet(sd, net, layers)

@@ -1,0 +1,56 @@
+"""Sweep flash fwd+bwd (training) block configs at long T, bf16 causal."""
+import statistics, time
+import jax, jax.numpy as jnp, numpy as np
+from fedml_tpu.ops.flash_attention import flash_attention
+
+H, D = 8, 64
+
+def timed(f, q, k, v, tokens):
+    float(f(q, k, v))
+    vals = []
+    for _ in range(3):
+        t0 = time.perf_counter(); float(f(q, k, v))
+        vals.append(tokens / (time.perf_counter() - t0))
+    return statistics.median(vals)
+
+for t, b, iters in [(4096, 2, 4), (8192, 1, 2)]:
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(b, t, H, D), jnp.bfloat16) for _ in range(3))
+    tokens = b * t * iters
+    for bq, bk in [(None, None), (128, 128), (256, 256), (256, 512),
+                   (512, 512), (512, 256), (1024, 512), (512, 1024)]:
+        def loss(q, k, v, bq=bq, bk=bk):
+            o = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+        g = jax.grad(loss, argnums=(0, 1, 2))
+        def run(q, k, v):
+            def body(i, c):
+                gq, gk, gv = g(c, k, v)
+                return c - (1e-6 * gq).astype(c.dtype)
+            out = jax.lax.fori_loop(0, iters, body, q)
+            return jnp.sum(out.astype(jnp.float32))
+        f = jax.jit(run)
+        try:
+            tps = timed(f, q, k, v, tokens)
+            print(f"T={t} blk=({bq},{bk}): {tps/1e3:.1f} ktok/s (fwd+bwd)", flush=True)
+        except Exception as e:
+            print(f"T={t} blk=({bq},{bk}): FAIL {str(e)[:80]}", flush=True)
+
+    # dense comparison
+    def dense_loss(q, k, v, t=t):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, -1).astype(q.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+    gd = jax.grad(dense_loss, argnums=(0, 1, 2))
+    def rund(q, k, v):
+        def body(i, c):
+            gq, gk, gv = gd(c, k, v)
+            return c - (1e-6 * gq).astype(c.dtype)
+        return jnp.sum(jax.lax.fori_loop(0, iters, body, q).astype(jnp.float32))
+    try:
+        print(f"T={t} dense: {timed(jax.jit(rund), q, k, v, tokens)/1e3:.1f} ktok/s", flush=True)
+    except Exception as e:
+        print(f"T={t} dense: FAIL {str(e)[:80]}", flush=True)

@@ -138,3 +138,28 @@ def test_master_config_requires_world_size(tmp_path):
     p.write_text("address,port\n127.0.0.1,29316\n")
     with pytest.raises(ValueError, match="world_size"):
         TRPCCommManager(trpc_master_config_path=str(p), rank=0)
+
+
+def test_duplicate_frame_after_lost_ack_enqueues_once():
+    """rpc retry safety: re-delivering the same (sender, seq) frame (the
+    lost-ACK retry case) must not enqueue the message twice — a duplicate
+    model upload would be double-counted by the aggregator."""
+    import socket
+    import struct
+
+    from fedml_tpu.comm.wire import serialize_message as ser
+
+    table = {0: ("127.0.0.1", 0), 1: ("127.0.0.1", 0)}
+    m1 = TRPCCommManager(table, 1)
+    try:
+        msg = Message(type=3, sender_id=0, receiver_id=1)
+        msg.add("model_params", {"w": np.ones(4, np.float32)})
+        blob = ser(msg, "tensor")
+        frame = struct.pack("<QQ", len(blob), 1) + blob
+        with socket.create_connection(table[1]) as conn:
+            for _ in range(3):  # same seq delivered three times
+                conn.sendall(frame)
+                assert conn.recv(1) == b"\x06"  # acked every time
+        assert m1._queue.qsize() == 1  # enqueued once
+    finally:
+        m1.close()
