@@ -83,7 +83,14 @@ class TRPCCommManager(BaseCommunicationManager):
         self._conns: Dict[int, socket.socket] = {}
         self._send_lock = threading.Lock()
         self._send_seq = 0  # per-sender monotone id; receiver dedupes
-        self._last_seq: Dict[int, int] = {}  # sender rank -> last enqueued
+        # Fresh random epoch per manager INSTANCE: a restarted sender gets
+        # a new sequence space instead of having its messages silently
+        # dropped against the old instance's high-water mark.
+        import os as _os
+
+        self._send_epoch = int.from_bytes(_os.urandom(8), "little")
+        self._last_seq: Dict[tuple, int] = {}  # (sender, epoch) -> last seq
+        self._dedupe_lock = threading.Lock()
 
         self._server = socket.create_server(
             (ip_config[rank][0], ip_config[rank][1]), backlog=64)
@@ -112,25 +119,30 @@ class TRPCCommManager(BaseCommunicationManager):
     def _serve_conn(self, conn: socket.socket) -> None:
         with conn:
             while self._alive:
-                head = _recv_exact(conn, 16)
+                head = _recv_exact(conn, 24)
                 if head is None:
                     return
-                n, seq = struct.unpack("<QQ", head)
+                n, epoch, seq = struct.unpack("<QQQ", head)
                 payload = _recv_exact(conn, n)
                 if payload is None:
                     return
                 msg = deserialize_message(payload, "tensor")
                 sender = int(msg.get_sender_id())
                 # Idempotent enqueue: a sender retry after a lost ACK
-                # re-delivers the same (sender, seq) — ack it again but
-                # never enqueue twice (a duplicate model upload would be
-                # double-counted by the aggregator).
-                if seq > self._last_seq.get(sender, -1):
-                    self._last_seq[sender] = seq
-                    # Enqueue BEFORE acking: the ack is the rpc_sync
-                    # return — after send_message returns, the message is
-                    # guaranteed queued on the receiver.
-                    self._queue.put(msg)
+                # re-delivers the same (sender, epoch, seq) — ack it
+                # again but never enqueue twice (a duplicate model upload
+                # would be double-counted by the aggregator). Check and
+                # update under ONE lock: a retry lands on a NEW
+                # connection, i.e. a different serve thread, and an
+                # unlocked check-then-act would let both copies through.
+                # Enqueue inside the lock, BEFORE acking: the ack is the
+                # rpc_sync return — after send_message returns, the
+                # message is guaranteed queued on the receiver.
+                key = (sender, epoch)
+                with self._dedupe_lock:
+                    if seq > self._last_seq.get(key, -1):
+                        self._last_seq[key] = seq
+                        self._queue.put(msg)
                 conn.sendall(_ACK)
 
     # -- BaseCommunicationManager ------------------------------------------
@@ -138,12 +150,15 @@ class TRPCCommManager(BaseCommunicationManager):
                      backoff_s: float = 0.5) -> None:
         """rpc_sync semantics: returns only after the receiver acked the
         enqueue. Connect retries until a peer is first reached (workers
-        start in any order), then failures surface immediately."""
+        start in any order); an already-contacted peer gets exactly ONE
+        immediate reconnect+resend (safe: the receiver dedupes on
+        (sender, epoch, seq)) before the failure surfaces."""
         receiver = int(msg.get_receiver_id())
         blob = serialize_message(msg, "tensor")
         with self._send_lock:
             self._send_seq += 1
-            head = struct.pack("<QQ", len(blob), self._send_seq)
+            head = struct.pack("<QQQ", len(blob), self._send_epoch,
+                               self._send_seq)
             first_contact = receiver not in self._conns
             # Retries are SAFE here (unlike a naive resend): the receiver
             # dedupes on (sender, seq), so a frame whose ACK was lost is

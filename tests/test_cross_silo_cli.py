@@ -1,5 +1,6 @@
-"""Cross-silo CLI: 1 server + 2 silo OS processes over the native TCP
-transport on localhost (the reference's mpirun regime, without mpirun)."""
+"""Cross-silo CLI: 1 server + 2 silo OS processes on localhost (the
+reference's mpirun regime, without mpirun), over the native TCP transport
+and over the TRPC backend (acknowledged RPC sends, tensor wire)."""
 
 import json
 import os
@@ -10,16 +11,19 @@ import pytest
 
 
 @pytest.mark.slow
-def test_cross_silo_three_processes(tmp_path):
+@pytest.mark.parametrize("backend", ["TCP", "TRPC"])
+def test_cross_silo_three_processes(tmp_path, backend):
     env = {**os.environ,
            "PALLAS_AXON_POOL_IPS": "",
            "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
-    # pid-derived base so concurrent suite runs don't fight over rank ports
-    port_base = 42000 + (os.getpid() % 4000) * 4
+    # pid+backend-derived base so concurrent suite runs (and the two
+    # backend variants) don't fight over rank ports
+    port_base = 42000 + (os.getpid() % 2000) * 8 + (4 if backend == "TRPC" else 0)
     common = [
         sys.executable, "-m", "fedml_tpu.exp.main_cross_silo",
         "--size", "3", "--port_base", str(port_base),
+        "--comm_backend", backend,
         "--model", "lr", "--dataset", "synthetic_1_1",
         "--client_num_in_total", "6", "--batch_size", "8",
         "--comm_round", "3", "--epochs", "1", "--lr", "0.2",

@@ -45,3 +45,52 @@ def LogisticRegressionFactory():
     from fedml_tpu.models.lr import LogisticRegression
 
     return LogisticRegression(num_classes=4)
+
+
+def test_mesh_dp_batchnorm_is_synced_across_shards():
+    """SyncBatchNorm parity (SURVEY §2.6's last "no"): torch needs
+    SyncBatchNorm because each DDP replica computes batch statistics over
+    its LOCAL shard; under GSPMD the model is written on the global batch,
+    so plain BatchNorm's statistics are computed over the whole logical
+    batch and XLA inserts the cross-device reductions — SyncBN semantics
+    by construction. Proof: training a BN model with the batch split over
+    an 8-device mesh matches single-device training numerically; if stats
+    were per-shard (batch 4 per device instead of 32), the normalization
+    — and the trained params — would diverge immediately."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from fedml_tpu.data.synthetic import make_image_classification
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    class BNNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(8, (3, 3), use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            x = nn.relu(x)
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(4)(x)
+
+    x, y = make_image_classification(128, hwc=(8, 8, 3), n_classes=4, seed=0)
+    xs = x.reshape(4, 32, 8, 8, 3)
+    ys = y.reshape(4, 32)
+    mask = np.ones((4, 32), np.float32)
+    cfg = FedConfig(client_num_in_total=1, client_num_per_round=1,
+                    comm_round=1, epochs=3, batch_size=32, lr=0.1, seed=0)
+
+    def run(mesh):
+        tr = CentralizedTrainer(BNNet(), cfg, mesh=mesh)
+        tr.train(xs, ys, mask)
+        return tr.net
+
+    a, b = run(None), run(client_mesh(8))
+    for la, lb in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=2e-5, atol=2e-5)
+    # Running stats too: they are the batch statistics history, the exact
+    # quantity SyncBN exists to globalize.
+    for la, lb in zip(jax.tree.leaves(a.model_state),
+                      jax.tree.leaves(b.model_state)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=2e-5, atol=2e-5)

@@ -155,11 +155,17 @@ def test_duplicate_frame_after_lost_ack_enqueues_once():
         msg = Message(type=3, sender_id=0, receiver_id=1)
         msg.add("model_params", {"w": np.ones(4, np.float32)})
         blob = ser(msg, "tensor")
-        frame = struct.pack("<QQ", len(blob), 1) + blob
-        with socket.create_connection(table[1]) as conn:
-            for _ in range(3):  # same seq delivered three times
+        frame = struct.pack("<QQQ", len(blob), 77, 1) + blob
+        # Re-delivery across SEPARATE connections (a retry reconnects):
+        # deduped. A fresh sender epoch (a restarted process): accepted.
+        for _ in range(3):
+            with socket.create_connection(table[1]) as conn:
                 conn.sendall(frame)
                 assert conn.recv(1) == b"\x06"  # acked every time
         assert m1._queue.qsize() == 1  # enqueued once
+        with socket.create_connection(table[1]) as conn:
+            conn.sendall(struct.pack("<QQQ", len(blob), 78, 1) + blob)
+            assert conn.recv(1) == b"\x06"
+        assert m1._queue.qsize() == 2  # new epoch = restarted sender
     finally:
         m1.close()
