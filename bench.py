@@ -431,7 +431,7 @@ def bench_flash_attention_sweep():
 
     points, crossover = {}, None
     for t, b, iters in [(2048, 4, 16), (8192, 2, 4), (16384, 1, 2),
-                        (32768, 1, 1)]:
+                        (32768, 1, 1), (65536, 1, 1)]:
         rng = np.random.RandomState(0)
         q, k, v = (jnp.asarray(rng.randn(b, t, h, d), jnp.bfloat16)
                    for _ in range(3))

@@ -161,7 +161,7 @@ class TRPCCommManager(BaseCommunicationManager):
                                self._send_seq)
             first_contact = receiver not in self._conns
             # Retries are SAFE here (unlike a naive resend): the receiver
-            # dedupes on (sender, seq), so a frame whose ACK was lost is
+            # dedupes on (sender, epoch, seq), so a frame whose ACK was lost is
             # re-acked without a second enqueue.
             for attempt in range(retries + 1 if first_contact else 2):
                 try:

@@ -141,7 +141,7 @@ def test_master_config_requires_world_size(tmp_path):
 
 
 def test_duplicate_frame_after_lost_ack_enqueues_once():
-    """rpc retry safety: re-delivering the same (sender, seq) frame (the
+    """rpc retry safety: re-delivering the same (sender, epoch, seq) frame (the
     lost-ACK retry case) must not enqueue the message twice — a duplicate
     model upload would be double-counted by the aggregator."""
     import socket
