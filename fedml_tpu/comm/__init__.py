@@ -6,7 +6,7 @@ federation, where clients are separate OS processes or hosts. It mirrors the
 reference's architecture — a ``Message`` envelope, a pluggable
 ``BaseCommunicationManager``, observer dispatch, and ``ClientManager`` /
 ``ServerManager`` process bases (fedml_core/distributed/communication/
-base_com_manager.py:7, client/client_manager.py:14) — with four backends:
+base_com_manager.py:7, client/client_manager.py:14) — with five backends:
 
 - ``loopback`` — in-memory threaded router for tests and single-host
   multi-worker simulation (the fake backend the reference lacks, SURVEY §4.6)
@@ -15,6 +15,9 @@ base_com_manager.py:7, client/client_manager.py:14) — with four backends:
 - ``grpc_backend`` — grpcio C-core transport speaking the
   ``proto/comm.proto`` wire format (direct gRPC parity, one fixed ip table
   for both listen and send sides)
+- ``trpc`` — TRPC-role RPC transport: acknowledged sends (rpc_sync
+  semantics, epoch+seq idempotent delivery) with the pickle-free
+  ``tensor`` wire format (the TensorPipe role, trpc_comm_manager.py:25)
 - ``mqtt`` — broker pub/sub for device/mobile edges (requires paho-mqtt)
 """
 
