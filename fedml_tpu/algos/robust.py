@@ -84,9 +84,18 @@ class FedAvgRobustAPI(FedAvgAPI):
 
         active = np.asarray(idx)[np.asarray(wmask) > 0]
         adv = self.adversary_clients
-        honest = np.setdiff1d(active, adv)
         n_adv = min(len(adv), len(active))
-        keep = honest[:len(active) - n_adv]
+        # Evict UNIFORMLY at random (seeded by the round, like
+        # sample_clients): truncating np.setdiff1d's sorted output would
+        # deterministically evict the highest-id honest clients on every
+        # attack round — a systematic participation bias. Order-based
+        # truncation is no better: selection policies like oort return
+        # id-sorted cohorts, where sample order IS id order.
+        honest = active[np.isin(active, adv, invert=True)]
+        rs = np.random.RandomState(round_idx)
+        keep = rs.choice(honest, size=min(len(honest),
+                                          len(active) - n_adv),
+                         replace=False) if len(honest) else honest
         cohort = np.sort(np.concatenate([keep, adv[:n_adv]])).astype(
             np.asarray(idx).dtype)
         return pad_to_multiple(cohort, self.n_shards)

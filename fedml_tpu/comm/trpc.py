@@ -62,6 +62,12 @@ def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
 class TRPCCommManager(BaseCommunicationManager):
     """One instance per rank; see module docstring for the contract."""
 
+    #: Upper bound on a single incoming frame's payload length. The frame
+    #: header's 64-bit ``n`` comes from an unauthenticated peer; without a
+    #: cap, _serve_conn would buffer up to 2^64 bytes on request. 4 GiB
+    #: comfortably covers the largest model upload while bounding memory.
+    max_frame_bytes: int = 4 << 30
+
     def __init__(self, ip_config: Optional[Dict[int, Tuple[str, int]]] = None,
                  rank: int = 0, *, trpc_master_config_path: Optional[str] = None,
                  world_size: int = 0):
@@ -123,6 +129,8 @@ class TRPCCommManager(BaseCommunicationManager):
                 if head is None:
                     return
                 n, epoch, seq = struct.unpack("<QQQ", head)
+                if n > self.max_frame_bytes:
+                    return  # oversized frame: drop the connection
                 payload = _recv_exact(conn, n)
                 if payload is None:
                     return
@@ -155,6 +163,12 @@ class TRPCCommManager(BaseCommunicationManager):
         (sender, epoch, seq)) before the failure surfaces."""
         receiver = int(msg.get_receiver_id())
         blob = serialize_message(msg, "tensor")
+        if len(blob) > self.max_frame_bytes:
+            # Fail fast: the receiver would silently drop the connection,
+            # and the retry loop would retransmit the whole blob.
+            raise ValueError(
+                f"message serializes to {len(blob)} bytes, over the "
+                f"{self.max_frame_bytes}-byte frame cap")
         with self._send_lock:
             self._send_seq += 1
             head = struct.pack("<QQQ", len(blob), self._send_epoch,

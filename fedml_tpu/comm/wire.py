@@ -96,8 +96,12 @@ def _decode_obj(node, bufs, pos):
     if t == "s":
         return node["v"], pos
     if t == "a":
+        # .copy(): frombuffer over a bytes slice is read-only, and the
+        # pickle/json wire formats hand receivers writable arrays — a
+        # receiver mutating params in place must behave identically on
+        # every wire. One memcpy per tensor.
         arr = np.frombuffer(bufs[pos], dtype=_np_dtype(node["dtype"]))
-        return arr.reshape(node["shape"]), pos + 1
+        return arr.reshape(node["shape"]).copy(), pos + 1
     raise ValueError(f"tensor wire: unknown node type {t!r}")
 
 
@@ -112,9 +116,12 @@ def _tensor_encode(params: dict) -> bytes:
 def _tensor_decode(payload: bytes) -> dict:
     (hlen,) = struct.unpack_from("<I", payload)
     header = json.loads(payload[4:4 + hlen].decode())
+    # memoryview slices are zero-copy, so the .copy() in _decode_obj's
+    # array branch is the only memcpy per tensor.
+    view = memoryview(payload)
     bufs, off = [], 4 + hlen
     for n in header["lens"]:
-        bufs.append(payload[off:off + n])
+        bufs.append(view[off:off + n])
         off += n
     out, used = _decode_obj(header["meta"], bufs, 0)
     if used != len(bufs):  # not assert: must survive python -O

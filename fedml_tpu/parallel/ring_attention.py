@@ -134,13 +134,16 @@ def ring_flash_attention_sharded(q, k, v, axis_name: str, causal: bool = False):
 
 
 def _ring_flash_fwd_core(q, k, v, axis_name, causal):
-    from fedml_tpu.ops.flash_attention import _SUB, NEG_INF, _blk, _fwd
+    from fedml_tpu.ops.flash_attention import _SUB, NEG_INF, _auto_blk, _fwd
 
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, t, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    bq, bk = _blk(t, 256), _blk(t, 512)
+    # Divisor-aligned blocks: the pallas grid is t//blk, so a non-divisor
+    # block (e.g. T_local=384 with a clamped 256) would silently drop the
+    # tail rows of the shard. _auto_blk mirrors flash_attention's guard.
+    bq, bk = _auto_blk(t, 256), _auto_blk(t, 512)
     perm = [(j, (j + 1) % n) for j in range(n)]
     q3 = _to3(q)
     bh = b * h
@@ -197,14 +200,14 @@ def _ring_flash_vjp_bwd(axis_name, causal, res, do):
     """Backward ring pass: (k, v, dk_acc, dv_acc) rotate together — after
     n permutes every dk/dv accumulator is back on its owner with every
     Q-shard's contribution; dq accumulates locally."""
-    from fedml_tpu.ops.flash_attention import _SUB, _bwd, _blk
+    from fedml_tpu.ops.flash_attention import _SUB, _auto_blk, _bwd
 
     q, k, v, o3, lse = res
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, t, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    bq, bk = _blk(t, 256), _blk(t, 512)
+    bq, bk = _auto_blk(t, 256), _auto_blk(t, 512)  # divisor-aligned (see fwd)
     perm = [(j, (j + 1) % n) for j in range(n)]
     q3, do3 = _to3(q), _to3(do)
     lse_sub = jnp.broadcast_to(lse[:, None, :], (lse.shape[0], _SUB,

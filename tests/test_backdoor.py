@@ -121,6 +121,41 @@ def test_attack_freq_zero_matches_parent_sampling():
         np.testing.assert_array_equal(np.asarray(wa), np.asarray(wb))
 
 
+def test_attack_round_eviction_is_not_id_biased():
+    """When the adversary displaces an honestly-sampled slot, eviction is
+    uniform at random (seeded by the round) — not deterministically the
+    highest-id honest client, which would be a systematic participation
+    bias on every attack round (advisor r3). Order-based eviction would
+    not be enough either: oort returns id-sorted cohorts."""
+    fed, test, _ = _attacked_federation()
+    kw = dict(client_num_in_total=N_CLIENTS, client_num_per_round=4,
+              comm_round=2, epochs=1, batch_size=32, lr=0.1,
+              frequency_of_the_test=1000)
+    api = FedAvgRobustAPI(LogisticRegression(num_classes=4), fed, test,
+                          FedConfig(**kw, attack_freq=1))
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+
+    base = FedAvgAPI(LogisticRegression(num_classes=4), fed, test,
+                     FedConfig(**kw))
+    adv = set(api.adversary_clients.tolist())
+    evicted = []
+    for r in range(8):
+        ib, wb = base._sample_round_uncached(r)
+        sampled = np.asarray(ib)[np.asarray(wb) > 0]
+        honest = set(sampled.tolist()) - adv
+        ia, wa = api._sample_round_uncached(r)
+        active = set(np.asarray(ia)[np.asarray(wa) > 0].tolist())
+        # Adversary forced in, cohort size preserved, kept ⊆ sampled honest.
+        assert adv <= active and len(active) == len(sampled)
+        assert active - adv <= honest, (r, active, sampled)
+        out = honest - active
+        if out:
+            # was the evicted one the max honest id? (old biased behavior)
+            evicted.append(max(honest) in out)
+    # Deterministic under the old code: ALWAYS the highest honest id.
+    assert evicted and not all(evicted), evicted
+
+
 def test_explicit_adversary_ids():
     fed, test, _ = _attacked_federation()
     cfg = FedConfig(client_num_in_total=N_CLIENTS, client_num_per_round=2,

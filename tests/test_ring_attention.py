@@ -125,6 +125,31 @@ def test_ring_flash_matches_dense(causal, n_dev):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ring_flash_non_divisor_shard_length():
+    """T_local=384 is NOT a multiple of the clamped default blocks
+    (256/512): with naive clamping the pallas grid t//blk drops the tail
+    rows (advisor r3: rows 256..383 were garbage). The divisor-aligned
+    _auto_blk must keep the whole shard covered — fwd AND grads."""
+    from fedml_tpu.parallel.ring_attention import make_ring_flash_attention
+
+    rng = np.random.RandomState(7)
+    b, t, h, d = 1, 384 * 2, 1, 8  # T_local = 384 on 2 devices
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    ring = make_ring_flash_attention(_mesh(2), "sp", causal=True)
+    want = reference_attention(q, k, v, causal=True)
+    got = jax.jit(ring)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+    g_ring = jax.grad(lambda a: jnp.sum(ring(a, k, v) ** 2))(q)
+    g_ref = jax.grad(
+        lambda a: jnp.sum(reference_attention(a, k, v, causal=True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
+                               rtol=5e-4, atol=5e-4)
+
+
 def test_ring_flash_grads_match_dense():
     """The backward ring pass (rotating dk/dv accumulators through the
     block FlashAttention-2 kernels, custom_vjp) must equal dense grads."""

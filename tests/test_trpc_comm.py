@@ -53,6 +53,46 @@ def test_tensor_wire_rejects_arbitrary_objects():
         serialize_message(msg, "tensor")
 
 
+def test_tensor_wire_arrays_are_writable():
+    """Decoded arrays must be mutable in place, like the pickle/json wire
+    formats produce — frombuffer over a bytes slice alone would be
+    read-only (advisor r3)."""
+    msg = Message(type=2, sender_id=1, receiver_id=0)
+    msg.add("model_params", {"w": np.arange(8, dtype=np.float32)})
+    out = deserialize_message(serialize_message(msg, "tensor"), "tensor")
+    w = out.get("model_params")["w"]
+    assert w.flags.writeable
+    w += 1.0  # must not raise
+    np.testing.assert_array_equal(w, np.arange(8, dtype=np.float32) + 1)
+
+
+def test_oversized_frame_drops_connection():
+    """A peer announcing a frame larger than max_frame_bytes gets its
+    connection dropped instead of the server buffering up to 2^64 bytes
+    (advisor r3); legitimate traffic still flows afterwards."""
+    import socket
+    import struct
+
+    table = {0: ("127.0.0.1", 0), 1: ("127.0.0.1", 0)}
+    m0 = TRPCCommManager(table, 0)
+    m1 = TRPCCommManager(table, 1)
+    try:
+        host, port = m1.ip_config[1]
+        with socket.create_connection((host, port), timeout=5) as s:
+            s.sendall(struct.pack("<QQQ", m1.max_frame_bytes + 1, 0, 0))
+            s.settimeout(5)
+            assert s.recv(1) == b""  # server closed without acking
+        assert m1._queue.empty()
+
+        msg = Message(type=3, sender_id=0, receiver_id=1)
+        msg.add("model_params", {"w": np.ones((4,), np.float32)})
+        m0.send_message(msg)
+        assert m1._queue.get(timeout=5).get_type() == 3
+    finally:
+        m0.close()
+        m1.close()
+
+
 def test_master_config_csv(tmp_path):
     p = tmp_path / "master.csv"
     p.write_text("address,port\n127.0.0.1,29315\n")
