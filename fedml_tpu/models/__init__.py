@@ -6,7 +6,11 @@
 from fedml_tpu.models.lr import LogisticRegression
 from fedml_tpu.models.pretrained import load_params, save_params
 from fedml_tpu.models.registry import create_model, register_model
-from fedml_tpu.models.torch_convert import load_torch_checkpoint
+from fedml_tpu.models.torch_convert import (
+    load_torch_checkpoint,
+    load_torch_gkt_checkpoint,
+)
 
 __all__ = ["LogisticRegression", "create_model", "register_model",
-           "save_params", "load_params", "load_torch_checkpoint"]
+           "save_params", "load_params", "load_torch_checkpoint",
+           "load_torch_gkt_checkpoint"]

@@ -111,16 +111,68 @@ def convert_torch_cifar_resnet(state_dict: Dict, net: NetState,
     return out
 
 
-def load_torch_checkpoint(path: str, net: NetState,
-                          layers: Sequence[int] = (6, 6, 6)) -> NetState:
-    """Load a reference ``.pth`` (``{'state_dict': ...}`` wrapper or a
-    bare state_dict, DataParallel prefixes included) into ``net`` — the
-    flax analogue of ``resnet56(pretrained=True, path=...)``."""
+def convert_torch_gkt_client(state_dict: Dict, net: NetState,
+                             n_blocks: int = 1) -> NetState:
+    """Map a reference GKT client-stump state_dict onto a
+    ``ResNetClientStump(norm="bn")`` NetState.
+
+    The reference's ``resnet5_56``/``resnet8_56``
+    (model/cv/resnet56_gkt/resnet_client.py:206,:230) are single-stage
+    nets — conv1/bn1 stem, ``layer1`` only, fc on 16·expansion features —
+    loaded from the same ``{'state_dict': ...}`` + ``module.`` format as
+    the full ResNets (:215-226). The stump shares the flax module naming
+    of :class:`~fedml_tpu.models.resnet.CifarResNet`, so the key map is
+    :func:`_torch_key` with a one-stage layers tuple."""
+    return convert_torch_cifar_resnet(state_dict, net, layers=(n_blocks,))
+
+
+def convert_torch_gkt_server(state_dict: Dict, net: NetState,
+                             layers: Sequence[int] = (6, 6, 6)) -> NetState:
+    """Map a reference GKT server-tail state_dict onto a
+    ``ResNetServerTail(norm="bn")`` NetState.
+
+    The reference server net (resnet_server.py:113-199) CONSTRUCTS a
+    conv1/bn1 stem but its forward never runs it (:188-191 — the client
+    supplies the 16-channel features), so its checkpoints carry stem
+    tensors with no flax counterpart: they are dropped here, and the
+    strict leftover check applies to everything else."""
+    sd = {k[len("module."):] if k.startswith("module.") else k: v
+          for k, v in state_dict.items()}
+    stem = ("conv1.weight", "bn1.weight", "bn1.bias", "bn1.running_mean",
+            "bn1.running_var", "bn1.num_batches_tracked")
+    return convert_torch_cifar_resnet(
+        {k: v for k, v in sd.items() if k not in stem}, net, layers)
+
+
+def _load_state_dict(path: str) -> Dict:
     import torch
 
     # weights_only: the supported format is a dict of tensors — never
     # opt back into pickle code execution for externally-obtained files.
     ckpt = torch.load(path, map_location="cpu", weights_only=True)
     sd = ckpt.get("state_dict", ckpt) if isinstance(ckpt, dict) else ckpt
-    sd = {k: v.numpy() if hasattr(v, "numpy") else v for k, v in sd.items()}
-    return convert_torch_cifar_resnet(sd, net, layers)
+    return {k: v.numpy() if hasattr(v, "numpy") else v for k, v in sd.items()}
+
+
+def load_torch_checkpoint(path: str, net: NetState,
+                          layers: Sequence[int] = (6, 6, 6)) -> NetState:
+    """Load a reference ``.pth`` (``{'state_dict': ...}`` wrapper or a
+    bare state_dict, DataParallel prefixes included) into ``net`` — the
+    flax analogue of ``resnet56(pretrained=True, path=...)``."""
+    return convert_torch_cifar_resnet(_load_state_dict(path), net, layers)
+
+
+def load_torch_gkt_checkpoint(path: str, net: NetState, *,
+                              role: str, n_blocks: int = 1,
+                              layers: Sequence[int] = (6, 6, 6)) -> NetState:
+    """Load a reference GKT split-ResNet ``.pth`` into the matching half:
+    ``role="client"`` → :func:`convert_torch_gkt_client` (stump),
+    ``role="server"`` → :func:`convert_torch_gkt_server` (tail) — the
+    flax analogue of ``resnet5_56/resnet8_56/resnet56_server(pretrained=
+    True, path=...)``."""
+    if role not in ("client", "server"):
+        raise ValueError(f"role must be 'client' or 'server', got {role!r}")
+    sd = _load_state_dict(path)
+    if role == "client":
+        return convert_torch_gkt_client(sd, net, n_blocks=n_blocks)
+    return convert_torch_gkt_server(sd, net, layers=layers)

@@ -19,9 +19,15 @@ import torch.nn as tnn  # noqa: E402
 import jax  # noqa: E402
 
 from fedml_tpu.models.resnet import CifarResNet  # noqa: E402
+from fedml_tpu.models.resnet_split import (  # noqa: E402
+    ResNetClientStump,
+    ResNetServerTail,
+)
 from fedml_tpu.models.torch_convert import (  # noqa: E402
     convert_torch_cifar_resnet,
+    convert_torch_gkt_server,
     load_torch_checkpoint,
+    load_torch_gkt_checkpoint,
 )
 from fedml_tpu.trainer.local import model_fns  # noqa: E402
 
@@ -127,6 +133,130 @@ def test_pth_file_roundtrip_with_dataparallel_prefix(tmp_path):
     with torch.no_grad():
         want = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
     got, _ = fns.apply(net, x, train=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+class _TorchBasicBlock(tnn.Module):
+    """Standard basic block (conv3x3-conv3x3), as in the GKT client."""
+
+    def __init__(self, inp, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(inp, planes, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(planes)
+        self.conv2 = tnn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(planes)
+        self.relu = tnn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return self.relu(y + idn)
+
+
+class _TorchGKTClient(tnn.Module):
+    """The reference GKT client stump shape (resnet_client.py:112-204):
+    stem + layer1 only, fc on 16·expansion features, returns
+    (logits, post-stem features)."""
+
+    def __init__(self, n_blocks, bottleneck, num_classes=10):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 16, 3, 1, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(16)
+        self.relu = tnn.ReLU()
+        exp, inp = (4, 16) if bottleneck else (1, 16)
+        blocks = []
+        for i in range(n_blocks):
+            down = None
+            if inp != 16 * exp:
+                down = tnn.Sequential(
+                    tnn.Conv2d(inp, 16 * exp, 1, 1, bias=False),
+                    tnn.BatchNorm2d(16 * exp))
+            blocks.append((_TorchBottleneck if bottleneck else
+                           _TorchBasicBlock)(inp, 16, 1, down))
+            inp = 16 * exp
+        self.layer1 = tnn.Sequential(*blocks)
+        self.fc = tnn.Linear(16 * exp, num_classes)
+
+    def forward(self, x):
+        feats = self.relu(self.bn1(self.conv1(x)))
+        y = self.layer1(feats).mean(dim=(2, 3))
+        return self.fc(y), feats
+
+
+class _TorchGKTServer(tnn.Module):
+    """The reference GKT server tail shape (resnet_server.py:113-199):
+    constructs a stem its forward never runs; layer1/2/3 on the client's
+    16-channel features."""
+
+    def __init__(self, layers, num_classes=10):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 16, 3, 1, 1, bias=False)  # unused
+        self.bn1 = tnn.BatchNorm2d(16)  # unused
+        inp = 16
+        for s, (planes, n) in enumerate(zip((16, 32, 64), layers)):
+            blocks = []
+            for i in range(n):
+                stride = 2 if (s > 0 and i == 0) else 1
+                down = None
+                if stride != 1 or inp != planes * 4:
+                    down = tnn.Sequential(
+                        tnn.Conv2d(inp, planes * 4, 1, stride, bias=False),
+                        tnn.BatchNorm2d(planes * 4))
+                blocks.append(_TorchBottleneck(inp, planes, stride, down))
+                inp = planes * 4
+            setattr(self, f"layer{s + 1}", tnn.Sequential(*blocks))
+        self.fc = tnn.Linear(64 * 4, num_classes)
+
+    def forward(self, feats):
+        x = self.layer3(self.layer2(self.layer1(feats)))
+        return self.fc(x.mean(dim=(2, 3)))
+
+
+@pytest.mark.parametrize("n_blocks,bottleneck",
+                         [(1, False), (2, True)])  # resnet5_56 / resnet8_56
+def test_gkt_client_checkpoint_reproduces_torch_outputs(tmp_path, n_blocks,
+                                                        bottleneck):
+    tm = _randomized(_TorchGKTClient(n_blocks, bottleneck)).eval()
+    path = str(tmp_path / "client.pth")
+    torch.save({"state_dict": {f"module.{k}": v
+                               for k, v in tm.state_dict().items()}}, path)
+
+    fns = model_fns(ResNetClientStump(
+        n_blocks=n_blocks, block="bottleneck" if bottleneck else "basic",
+        num_classes=10, norm="bn"))
+    net = fns.init(jax.random.PRNGKey(0),
+                   np.zeros((1, 32, 32, 3), np.float32))
+    net = load_torch_gkt_checkpoint(path, net, role="client",
+                                    n_blocks=n_blocks)
+
+    x = np.random.RandomState(2).randn(3, 32, 32, 3).astype(np.float32)
+    with torch.no_grad():
+        want_logits, want_feats = tm(torch.from_numpy(
+            x.transpose(0, 3, 1, 2)))
+    (got_logits, got_feats), _ = fns.apply(net, x, train=False)
+    np.testing.assert_allclose(np.asarray(got_logits), want_logits.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(got_feats).transpose(0, 3, 1, 2), want_feats.numpy(),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_gkt_server_checkpoint_reproduces_torch_outputs():
+    layers = (2, 2, 2)
+    tm = _randomized(_TorchGKTServer(layers)).eval()
+    fns = model_fns(ResNetServerTail(layers=layers, block="bottleneck",
+                                     num_classes=10, norm="bn"))
+    net = fns.init(jax.random.PRNGKey(0),
+                   np.zeros((1, 32, 32, 16), np.float32))
+    sd = {k: v.numpy() for k, v in tm.state_dict().items()}
+    net = convert_torch_gkt_server(sd, net, layers=layers)
+
+    feats = np.random.RandomState(3).randn(2, 32, 32, 16).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(feats.transpose(0, 3, 1, 2))).numpy()
+    got, _ = fns.apply(net, feats, train=False)
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
 
 
