@@ -16,20 +16,30 @@ Accounting:
   fwd+bwd≈3x-fwd estimate, stated as such;
 - one XLA profile (``obs/timing.trace``) captured per bench run under
   ``runs/bench_profile`` (TensorBoard-loadable), best-effort;
+- kernel A/B sections enforce a 0.4 s device-work floor per timed call
+  (``_calibrated_side`` / ``_lm_scan_bench(min_call_s=...)``): chain
+  lengths are sized from a measured warm-call rate with the tunnel's
+  dispatch RTT cancelled by a two-point fit, and the floor is asserted
+  — r3's fixed schedules left fast sides inside the RTT noise band,
+  deflating them 3-4x (r3 VERDICT #1);
 - secondary configs as sub-metrics in the SAME JSON object: the
   3400-client FEMNIST-CNN federation (BASELINE.md north-star scale, on
   the host-resident FederatedStore), a ViT federation, the primary
   config at the per-client-batch-128 tiling sweet spot, the shard_map
   round on a 1-device mesh (the multi-chip code path's single-chip
   throughput), the pallas flash-attention vs dense T-sweep (crossover +
-  memory evidence), and two federated-transformer sections (the
-  high-MFU proof at d_model=512; the flash-in-training A/B at T=2048).
+  memory evidence + a labelled memory-cliff datum), and two federated-
+  transformer sections (the high-MFU proof at d_model=512; the
+  flash-in-training A/B curve at T ∈ {2048, 4096, 8192}).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 ``vs_baseline`` keeps the round-1 convention — a ~1500 samples/sec
 single-GPU PyTorch simulator assumption (RTX2080Ti-class ResNet-56/CIFAR;
 the reference publishes no throughput number, BASELINE.md) — while the
 absolute numbers + MFU above are the honest figures of merit.
+``tuned_best`` carries the best honest number for the same task with the
+measured tuning levers applied (s2d stem, batch 128), next to the
+untouched comparable primary.
 
 See docs/ROOFLINE.md for why the ResNet-56 number sits where it does
 (16/32-channel stages under-fill the 128-lane MXU).
@@ -101,9 +111,19 @@ def _timed_scan_trials(api, rounds, samples_per_round, n_trials=3):
 
 
 def _scan_bench(model, n_clients, per_client, batch, cpr, lr,
-                rounds=3, mesh=None):
+                rounds=3, mesh=None, with_iqr=False, min_call_s=0.5):
     """Median samples/sec of the whole-run scan for one (model, config):
-    the shared scaffold behind every secondary image-model section."""
+    the shared scaffold behind every secondary image-model section.
+    ``with_iqr=True`` → (median, [q1, q3]) so trend-sensitive submetrics
+    carry their spread in the artifact (r3 VERDICT #7).
+
+    The scan length is grown until a warm call exceeds ``min_call_s``
+    (the r3 VERDICT #1 device-work floor, applied here in r4): through
+    the tunnel each call carries ~0.1 s of fixed dispatch cost, so a
+    3-round window on a fast config under-reports steady-state
+    throughput by up to ~45% (measured on the s2d variant: 23k
+    samples/s at 3 rounds vs 42.7k by two-point fit,
+    scripts/sweep_s2d_attrib.py `bench_path`)."""
     import jax
 
     from fedml_tpu.algos.config import FedConfig
@@ -115,7 +135,21 @@ def _scan_bench(model, n_clients, per_client, batch, cpr, lr,
     api = FedAvgAPI(model, fed, None, cfg, mesh=mesh)
     api.train_rounds_on_device(rounds)  # warmup/compile
     jax.block_until_ready(api.net.params)
-    return statistics.median(_timed_scan_trials(api, rounds, cpr * per_client))
+    for _ in range(4):
+        t0 = time.perf_counter()
+        losses = api.train_rounds_on_device(rounds)
+        float(np.asarray(losses).sum())
+        dt = time.perf_counter() - t0
+        if dt >= min_call_s:
+            break
+        rounds = max(rounds + 1,
+                     int(np.ceil(rounds * min_call_s * 1.3 / dt)))
+        api.train_rounds_on_device(rounds)  # recompile + warm new length
+        jax.block_until_ready(api.net.params)
+    trials = _timed_scan_trials(api, rounds, cpr * per_client)
+    if with_iqr:
+        return _med_iqr(trials)
+    return statistics.median(trials)
 
 
 def bench_cifar_resnet56(profile_dir=None):
@@ -140,6 +174,17 @@ def bench_cifar_resnet56(profile_dir=None):
     api = FedAvgAPI(model, fed, None, cfg)
     api.train_rounds_on_device(rounds)  # warmup/compile
     jax.block_until_ready(api.net.params)
+    # Device-work floor (currently a no-op at ~0.6 s/call; guards the
+    # metric's honesty if this config ever speeds past the tunnel RTT).
+    for _ in range(4):
+        t0 = time.perf_counter()
+        losses = api.train_rounds_on_device(rounds)
+        float(np.asarray(losses).sum())
+        if time.perf_counter() - t0 >= 0.5:
+            break
+        rounds *= 2
+        api.train_rounds_on_device(rounds)
+        jax.block_until_ready(api.net.params)
 
     sps_trials, rps_trials = [], []
     for trial in range(TRIALS):
@@ -246,10 +291,14 @@ def _timed_store_windows(api, store, windows=3, window=10,
         rps_w.append(window / dt)
         sps_w.append(samples / dt)
         r += window
+    rps_med, rps_iqr = _med_iqr(rps_w)
     out = {"loop": "pipelined" if attached else "synced",
-           "rounds_per_sec": round(statistics.median(rps_w), 3)}
+           "rounds_per_sec": round(rps_med, 3),
+           "rounds_per_sec_iqr": rps_iqr, "windows": windows}
     if count_samples:
-        out["samples_per_sec"] = round(statistics.median(sps_w), 2)
+        sps_med, sps_iqr = _med_iqr(sps_w)
+        out["samples_per_sec"] = round(sps_med, 2)
+        out["samples_per_sec_iqr"] = sps_iqr
     return out
 
 
@@ -364,9 +413,17 @@ def bench_resnet56_s2d():
     fwd = model_cost(model, np.zeros((32, 32, 32, 3), np.float32))
     delivered = 3.0 * fwd["flops"] / 32 * sps / 1e12
     peak = _chip_peak(jax.devices()[0].device_kind)
+    # s2d + batch 128: the two levers composed — the repo's best honest
+    # CIFAR-ResNet56 number, feeding the top-level ``tuned_best`` field
+    # (r3 VERDICT #8). Measured fresh every round, not quoted from docs.
+    sps_b128 = _scan_bench(resnet56(num_classes=10, dtype="bf16",
+                                    stem="s2d"),
+                           n_clients=128, per_client=256, batch=128,
+                           cpr=8, lr=0.1)
     return {"samples_per_sec": round(sps, 2),
             "delivered_tflops": round(delivered, 3),
-            "mfu": (round(delivered / peak, 4) if peak else None)}
+            "mfu": (round(delivered / peak, 4) if peak else None),
+            "s2d_b128_samples_per_sec": round(sps_b128, 2)}
 
 
 def bench_sharded_path():
@@ -379,25 +436,78 @@ def bench_sharded_path():
     from fedml_tpu.parallel.mesh import client_mesh
 
     n_clients = 8  # full participation: cpr == total
-    sps = _scan_bench(resnet56(num_classes=10, dtype="bf16"),
-                      n_clients=n_clients, per_client=256, batch=32,
-                      cpr=n_clients, lr=0.1, mesh=client_mesh(1))
+    sps, iqr = _scan_bench(resnet56(num_classes=10, dtype="bf16"),
+                           n_clients=n_clients, per_client=256, batch=32,
+                           cpr=n_clients, lr=0.1, mesh=client_mesh(1),
+                           with_iqr=True)
     return {"samples_per_sec": round(sps, 2),
+            "samples_per_sec_iqr": iqr,
             "rounds_per_sec": round(sps / (n_clients * 256), 3)}
+
+
+FLOOR_S = 0.4   # required device work per timed call (asserted, not assumed)
+TARGET_S = 0.6  # calibration aims a margin above the floor
+
+
+def _calibrated_side(f, q, k, v, tokens_per_iter, n_timed=5):
+    """Median tokens/sec for one side of a kernel A/B, with the iteration
+    count CALIBRATED from a measured warm-call rate so every timed call
+    carries ≥ FLOOR_S seconds of device work — enforced, not assumed (r3
+    VERDICT: the fixed iters schedule left the fast side at ~0.15 s/call,
+    inside the tunnel's ±30 ms RTT noise band).
+
+    ``f(q, k, v, iters)`` must accept the chain length as a DYNAMIC
+    operand (no recompile across iters). Per-iteration device time is fit
+    two-point — (t(n2) − t(n1)) / (n2 − n1) — which cancels the constant
+    dispatch RTT the tunnel adds to every call; the RTT estimate itself
+    is kept to refine the fit from the timed calls, and the floor is
+    re-checked against the refined rate (retry with more iters if a noisy
+    first fit under-sized the chain)."""
+    def call(iters):
+        t0 = time.perf_counter()
+        float(f(q, k, v, iters))
+        return time.perf_counter() - t0
+
+    call(1)  # warm + compile (host fetch = the only reliable tunnel sync)
+    n1, n2 = 1, 5
+    t1 = min(call(n1) for _ in range(2))
+    t2 = min(call(n2) for _ in range(2))
+    per_iter = max((t2 - t1) / (n2 - n1), 1e-4)
+    rtt = max(t1 - per_iter * n1, 0.0)
+    for _attempt in range(4):
+        iters = max(1, min(4096, int(np.ceil(TARGET_S / per_iter))))
+        calls = sorted(call(iters) for _ in range(n_timed))
+        med = calls[n_timed // 2]
+        refined = max((med - rtt) / iters, 1e-4)
+        if refined * iters >= FLOOR_S:
+            return {"tokens_per_sec": round(tokens_per_iter * iters / med),
+                    "iters": iters, "call_s": round(med, 3),
+                    "device_s_per_call_est": round(refined * iters, 3)}
+        per_iter = refined  # noisy first fit under-sized the chain: retry
+    raise RuntimeError(
+        f"could not reach the {FLOOR_S}s device-work floor "
+        f"(per_iter≈{per_iter:.4f}s, iters≈{iters})")
 
 
 def bench_flash_attention_sweep():
     """Pallas fused attention vs XLA dense attention across sequence
     lengths, in the TRAINING configuration (bf16 activations, causal).
-    Each point chains ITERS data-dependent iterations inside one jit
-    (output feeds the next query) with a single device sync — per-call
-    timing through the axon tunnel measures dispatch RTT, not the kernel.
+    Each point chains data-dependent iterations inside one jit (output
+    feeds the next query) with a single device sync — per-call timing
+    through the axon tunnel measures dispatch RTT, not the kernel. The
+    chain length is calibrated per side (``_calibrated_side``) so every
+    timed call clears the 0.4 s device-work floor.
 
     Reports tokens/sec for both, the per-T speedup, the crossover T, and
     each side's compiled temp-memory (the O(T) vs O(T²) claim, measured
     rather than asserted — r2 VERDICT). Dense is EXPECTED to fail at the
     longest T (its [B, H, T, T] scores exceed HBM); that failure is
-    recorded as a data point, not an error."""
+    recorded as a data point, not an error. All comparable points run
+    batch 1 at T≥8192; the r3-era T=8192 batch-2 configuration — where
+    dense's 8.6 GB compiled temp sits against the HBM boundary and its
+    throughput collapses ~9x — is kept as an explicitly-labelled
+    memory-cliff datum (r3 VERDICT #1: a memory effect must not be
+    presented as an O(T²) kernel property)."""
     import jax
     import jax.numpy as jnp
 
@@ -405,41 +515,24 @@ def bench_flash_attention_sweep():
 
     h, d = 8, 64
 
-    def chained(attn, iters):
-        def run(q, k, v):
+    def chained(attn):
+        def run(q, k, v, iters):
             out = jax.lax.fori_loop(
                 0, iters, lambda i, acc: attn(acc, k, v), q)
             return jnp.sum(out)  # scalar → float() forces a real sync
         return jax.jit(run)
 
-    def timed(f, q, k, v, tokens):
-        float(f(q, k, v))  # warm + sync (block_until_ready does not
-        # reliably wait through the axon tunnel; a host transfer does)
-        vals = []
-        for _ in range(5):
-            t0 = time.perf_counter()
-            float(f(q, k, v))
-            vals.append(tokens / (time.perf_counter() - t0))
-        return statistics.median(vals)
-
     def temp_mb(f, q, k, v):
         try:
-            ma = f.lower(q, k, v).compile().memory_analysis()
+            ma = f.lower(q, k, v, 1).compile().memory_analysis()
             return round(ma.temp_size_in_bytes / 1e6, 1)
         except Exception:
             return None
 
-    # iters sized so each timed call is ≥~0.4s of device work: at 16
-    # iters the T=2048 point was ~0.13s/call and the tunnel's ±30ms RTT
-    # swung the ratio ±25% run-to-run (observed 0.77x-1.15x); 48 iters
-    # cuts that to <10%.
-    points, crossover = {}, None
-    for t, b, iters in [(2048, 4, 48), (8192, 2, 8), (16384, 1, 4),
-                        (32768, 1, 2), (65536, 1, 2)]:
+    def measure(t, b):
         rng = np.random.RandomState(0)
         q, k, v = (jnp.asarray(rng.randn(b, t, h, d), jnp.bfloat16)
                    for _ in range(3))
-        tokens = b * t * iters
 
         def naive(q, k, v, t=t):
             logits = (jnp.einsum("bqhd,bkhd->bhqk", q, k)
@@ -450,24 +543,41 @@ def bench_flash_attention_sweep():
             return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
         f_flash = chained(lambda q, k, v: flash_attention(
-            q, k, v, causal=True), iters)
-        f_naive = chained(naive, iters)
+            q, k, v, causal=True))
+        f_naive = chained(naive)
+        fl = _calibrated_side(f_flash, q, k, v, b * t)
         pt = {"batch": b,
-              "flash_tokens_per_sec": round(timed(f_flash, q, k, v, tokens)),
+              "flash_tokens_per_sec": fl["tokens_per_sec"],
+              "flash_iters": fl["iters"], "flash_call_s": fl["call_s"],
               "flash_temp_mb": temp_mb(f_flash, q, k, v)}
         try:
-            pt["dense_tokens_per_sec"] = round(timed(f_naive, q, k, v,
-                                                     tokens))
-            pt["dense_temp_mb"] = temp_mb(f_naive, q, k, v)
-            pt["speedup"] = round(pt["flash_tokens_per_sec"]
-                                  / pt["dense_tokens_per_sec"], 3)
-            if crossover is None and pt["speedup"] > 1.0:
-                crossover = t
+            de = _calibrated_side(f_naive, q, k, v, b * t)
+            pt.update({"dense_tokens_per_sec": de["tokens_per_sec"],
+                       "dense_iters": de["iters"],
+                       "dense_call_s": de["call_s"],
+                       "dense_temp_mb": temp_mb(f_naive, q, k, v),
+                       "speedup": round(fl["tokens_per_sec"]
+                                        / de["tokens_per_sec"], 3)})
         except Exception as e:  # the T² wall: dense cannot allocate
             pt["dense_tokens_per_sec"] = None
             pt["dense_failed"] = f"{type(e).__name__}: {e}"[:120]
+        return pt
+
+    points, crossover = {}, None
+    for t, b in [(2048, 4), (8192, 1), (16384, 1), (32768, 1), (65536, 1)]:
+        pt = measure(t, b)
+        if (crossover is None and pt.get("speedup")
+                and pt["speedup"] > 1.0):
+            crossover = t
         points[f"t{t}"] = pt
+    cliff = measure(8192, 2)
+    cliff["note"] = ("memory-cliff datum, NOT comparable: dense's b=2 "
+                     "compiled temp (~8.6 GB) sits against the HBM "
+                     "boundary, so its collapse here is memory pressure, "
+                     "not an O(T^2) kernel property — compare the b=1 row")
+    points["t8192_b2_memcliff"] = cliff
     return {"points": points, "crossover_T": crossover,
+            "floor_s": FLOOR_S,
             "config": "bf16, causal, h8 d64, tuned blocks"}
 
 
@@ -486,8 +596,14 @@ def _token_fed(n_clients, per_client, batch, t, vocab, seed=0):
 
 
 def _lm_scan_bench(model, n_clients, per_client, batch, cpr, t, vocab,
-                   lr=0.1, rounds=3):
-    """Median seqs/sec of the whole-run scan for a token LM federation."""
+                   lr=0.1, rounds=3, min_call_s=None):
+    """Median seqs/sec of the whole-run scan for a token LM federation.
+
+    With ``min_call_s`` set, the scan length is grown until a measured
+    warm call exceeds it (the 0.4 s device-work floor of r3 VERDICT #1,
+    with headroom for the tunnel's ~0.1 s dispatch RTT) — each growth
+    recompiles once (scan length is static), so the loop converges in
+    one or two steps. Returns (seqs/sec, rounds, call_s) then."""
     from functools import partial
 
     import jax
@@ -503,8 +619,26 @@ def _lm_scan_bench(model, n_clients, per_client, batch, cpr, t, vocab,
                     loss_fn=partial(seq_softmax_ce, pad_id=0))
     api.train_rounds_on_device(rounds)  # warmup/compile
     jax.block_until_ready(api.net.params)
-    return statistics.median(
-        _timed_scan_trials(api, rounds, cpr * per_client))
+    if min_call_s is None:
+        return statistics.median(
+            _timed_scan_trials(api, rounds, cpr * per_client))
+    for _ in range(4):
+        t0 = time.perf_counter()
+        losses = api.train_rounds_on_device(rounds)
+        float(np.asarray(losses).sum())
+        dt = time.perf_counter() - t0
+        if dt >= min_call_s:
+            break
+        rounds = max(rounds + 1,
+                     int(np.ceil(rounds * min_call_s * 1.3 / dt)))
+        api.train_rounds_on_device(rounds)  # recompile + warm new length
+        jax.block_until_ready(api.net.params)
+    trials = _timed_scan_trials(api, rounds, cpr * per_client)
+    med = statistics.median(trials)
+    call_s = cpr * per_client * rounds / med
+    assert call_s >= FLOOR_S, (
+        f"timed call {call_s:.3f}s below the {FLOOR_S}s floor")
+    return med, rounds, round(call_s, 3)
 
 
 def bench_transformer_fed_mfu():
@@ -535,28 +669,33 @@ def bench_transformer_fed_mfu():
 
 
 def bench_transformer_flash_e2e():
-    """Flash attention inside a REAL federated training round (not a
-    kernel microbench): a transformer_lm federation at T=4096 with
-    attn="flash" vs attn="dense" — the end-to-end win the r2 VERDICT
-    asked for ("wire flash into the training path and show one federated
-    round where it helps"). T=4096 is past the measured END-TO-END
-    crossover: fwd+bwd through the training loss, flash/dense =
-    0.97x @ T=2048, 1.38x @ 4096, 2.02x @ 8192 (v5e, 2026-07-31 —
-    the backward kernels give back some of the forward's T=2k win, so
-    the e2e crossover sits later than the fwd-only one)."""
+    """Flash attention inside REAL federated training rounds (not a
+    kernel microbench): transformer_lm federations at T ∈ {2048, 4096,
+    8192} with attn="flash" vs attn="dense" — fwd+bwd through the
+    training loss, so the three backward kernels are on the clock too.
+    The full training A/B curve lives HERE, in the driver-captured
+    artifact, rather than in offline script runs quoted by the docs
+    (r3 VERDICT #1c); each side's scan length is floor-calibrated
+    (``_lm_scan_bench(min_call_s=...)``) so no point sits inside the
+    tunnel's RTT noise band."""
     from fedml_tpu.models import create_model
 
-    t, vocab = 4096, 1004
-    mk = lambda attn: create_model(
-        "transformer_lm", vocab_size=vocab, d_model=256, n_heads=4,
-        n_layers=2, max_len=t, dtype="bf16", attn=attn)
-    kw = dict(n_clients=8, per_client=4, batch=1, cpr=8, t=t, vocab=vocab)
-    flash_sps = _lm_scan_bench(mk("flash"), **kw)
-    dense_sps = _lm_scan_bench(mk("dense"), **kw)
-    return {"seq_len": t,
+    vocab, out = 1004, {"points": {}}
+    for t, per_client in [(2048, 8), (4096, 4), (8192, 2)]:
+        mk = lambda attn: create_model(
+            "transformer_lm", vocab_size=vocab, d_model=256, n_heads=4,
+            n_layers=2, max_len=t, dtype="bf16", attn=attn)
+        kw = dict(n_clients=8, per_client=per_client, batch=1, cpr=8,
+                  t=t, vocab=vocab, min_call_s=0.5)
+        flash_sps, fr, fcs = _lm_scan_bench(mk("flash"), **kw)
+        dense_sps, dr, dcs = _lm_scan_bench(mk("dense"), **kw)
+        out["points"][f"t{t}"] = {
             "flash_seqs_per_sec": round(flash_sps, 2),
             "dense_seqs_per_sec": round(dense_sps, 2),
+            "flash_rounds_timed": fr, "dense_rounds_timed": dr,
+            "flash_call_s": fcs, "dense_call_s": dcs,
             "speedup": round(flash_sps / dense_sps, 3)}
+    return out
 
 
 def main():
@@ -598,12 +737,31 @@ def main():
         _log(f"{name} done")
 
     sps = primary.pop("samples_per_sec")
+    # The best honest number for the SAME task (CIFAR10 ResNet-56 FedAvg)
+    # with the measured tuning levers applied — machine-readable next to
+    # the untouched comparable primary (r3 VERDICT #8). The primary keeps
+    # the reference stem + batch 32 for round-over-round comparability.
+    tuned = None
+    s2d = sub.get("resnet56_s2d_stem", {})
+    candidates = [
+        (s2d.get("s2d_b128_samples_per_sec"),
+         "resnet56 stem=s2d + per-client batch 128"),
+        (s2d.get("samples_per_sec"), "resnet56 stem=s2d, batch 32"),
+        (sub.get("resnet56_batch128_tuned", {}).get("samples_per_sec"),
+         "resnet56 reference stem, per-client batch 128"),
+    ]
+    candidates = [(v, c) for v, c in candidates if v]
+    if candidates:
+        best, config = max(candidates)
+        tuned = {"samples_per_sec": best, "config": config,
+                 "vs_baseline": round(best / BASELINE_SAMPLES_PER_SEC, 3)}
     out = {
         "metric": "fedavg_cifar10_resnet56_samples_per_sec_per_chip",
         "value": sps,
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
         **primary,
+        "tuned_best": tuned,
         "submetrics": sub,
     }
     print(json.dumps(out))

@@ -85,16 +85,30 @@ class FederatedStore:
     def nbytes(self) -> int:
         return self._x.nbytes + self._y.nbytes
 
-    def gather_cohort(self, indices) -> FederatedArrays:
+    def gather_cohort(self, indices,
+                      steps: Optional[int] = None) -> FederatedArrays:
         """Materialize the sampled clients as a device-resident
         ``FederatedArrays`` padded to the COHORT max count (power-of-two
         step bucket). Duplicate indices are fine (pad_to_multiple repeats
-        index 0 with weight 0)."""
+        index 0 with weight 0).
+
+        ``steps`` forces the step bucket (must cover the cohort's own
+        need): multi-host runs, where each host holds only its
+        ``process_local_client_slice`` of the clients, pass the GLOBAL
+        cohort bucket (allgather of the per-host maxima) so every host's
+        shard of the client-sharded round has identical [S, B] shapes —
+        see tests/multihost_worker.py:run_store_rounds."""
         idx = np.asarray(indices)
         k = len(idx)
         ccounts = self.counts[idx]
         bs = self.batch_size
-        steps = _bucket_steps(int(np.ceil(max(int(ccounts.max()), 1) / bs)))
+        need = _bucket_steps(int(np.ceil(max(int(ccounts.max()), 1) / bs)))
+        if steps is None:
+            steps = need
+        elif steps < need:
+            raise ValueError(
+                f"forced steps {steps} < cohort need {need} "
+                f"(max client count {int(ccounts.max())}, batch {bs})")
         cap = steps * bs
 
         xs = np.zeros((k, cap) + self._x.shape[1:], self._x.dtype)

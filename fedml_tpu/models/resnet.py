@@ -32,7 +32,13 @@ from fedml_tpu.models.registry import register_model
 
 
 class Norm(nn.Module):
-    """GroupNorm (32 groups, clipped to channel count) or BatchNorm."""
+    """GroupNorm (32 groups, clipped to channel count), BatchNorm,
+    ``"gn_fused"`` (the pallas fused GroupNorm kernel,
+    fedml_tpu.ops.group_norm — same math and param tree as ``"gn"``;
+    measured SLOWER than XLA's conv-fused lowering at CIFAR-ResNet
+    shapes, so not the default — docs/ROOFLINE.md), or ``"none"``
+    (identity — the measurement ablation docs/ROOFLINE.md uses to
+    attribute normalization cost; not a training configuration)."""
 
     kind: str = "gn"
     groups: int = 32
@@ -40,6 +46,8 @@ class Norm(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.kind == "none":
+            return x
         if self.kind == "bn":
             return nn.BatchNorm(use_running_average=not train, momentum=0.9,
                                 dtype=self.dtype)(x)
@@ -51,7 +59,33 @@ class Norm(nn.Module):
         g = min(self.groups, c)
         while c % g:
             g -= 1
+        if self.kind == "gn_fused":
+            # name="GroupNorm_0" matches nn.GroupNorm's auto-name in the
+            # "gn" branch → identical param trees; checkpoints are
+            # interchangeable between the two kinds.
+            return _GroupNormFused(num_groups=g, dtype=self.dtype,
+                                   name="GroupNorm_0")(x)
         return nn.GroupNorm(num_groups=g, dtype=self.dtype)(x)
+
+
+class _GroupNormFused(nn.Module):
+    """nn.GroupNorm drop-in backed by the pallas fused kernel
+    (fedml_tpu.ops.group_norm): same params (scale/bias), same f32-stats
+    numerics, one VMEM pass fwd and one fused backward."""
+
+    num_groups: int
+    dtype: Any = None
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        from fedml_tpu.ops.group_norm import group_norm
+
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        return group_norm(x.astype(self.dtype or x.dtype), scale, bias,
+                          self.num_groups, self.epsilon)
 
 
 class BottleneckBlock(nn.Module):

@@ -286,14 +286,14 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, blk_q, blk_k):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q3, k3, v3, causal, blocks):
-    blk_q, blk_k = blocks
+    blk_q, blk_k = blocks[:2]
     scale = 1.0 / (q3.shape[-1] ** 0.5)
     o, _ = _fwd(q3, k3, v3, scale, causal, blk_q, blk_k)
     return o
 
 
 def _flash_fwd(q3, k3, v3, causal, blocks):
-    blk_q, blk_k = blocks
+    blk_q, blk_k = blocks[:2]
     scale = 1.0 / (q3.shape[-1] ** 0.5)
     o, lse = _fwd(q3, k3, v3, scale, causal, blk_q, blk_k)
     return o, (q3, k3, v3, o, lse)
@@ -301,41 +301,55 @@ def _flash_fwd(q3, k3, v3, causal, blocks):
 
 def _flash_bwd(causal, blocks, res, do3):
     q3, k3, v3, o3, lse = res
-    blk_q, blk_k = blocks
+    bwd_blk_q, bwd_blk_k = blocks[2:]
     scale = 1.0 / (q3.shape[-1] ** 0.5)
-    return _bwd(q3, k3, v3, o3, lse, do3, scale, causal, blk_q, blk_k)
+    return _bwd(q3, k3, v3, o3, lse, do3, scale, causal,
+                bwd_blk_q, bwd_blk_k)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False, block_q: int | None = None,
-                    block_k: int | None = None):
+                    block_k: int | None = None,
+                    bwd_block_q: int | None = None,
+                    bwd_block_k: int | None = None):
     """Fused attention: q/k/v [B, T, H, D] → o [B, T, H, D].
 
     T must be a multiple of the (clamped) block sizes; pad upstream if not.
     Differentiable (custom VJP, FlashAttention-2-style backward).
 
-    Default blocks come from a measured v5e sweep (scripts/sweep_flash.py (log: r3 sweep),
-    r3): (256, 512) wins at T≤4k, (512, 1024) at T≥8k — both beat the
-    r2-era (128, 128) by 1.2-1.8x. Pass explicit blocks to override.
-    For the MXU rate, feed bf16 q/k/v: the kernel dots run in the input
-    dtype (f32 accumulation), and bf16 is ~4x the fp32 matmul rate.
+    Default blocks are (512, 1024) at every T (clamped to divisors of
+    T): the r4 re-sweep with floor-calibrated timing
+    (scripts/sweep_flash_bwd.py + the fwd confirm sweep, v5e,
+    2026-07-31) measures (512, 1024) ahead of the r3-era (256, 512)
+    default at EVERY point — fwd +39% @ T=2048, +81% @ 4096; training
+    +27% / +42% — the r3 "small blocks win at short T" conclusion was an
+    artifact of RTT-polluted timing (each r3 call carried ~0.1 s of
+    tunnel dispatch in a ~0.15 s measurement). The three backward
+    kernels take their own block sizes (``bwd_block_q/k``, defaulting to
+    the forward pair — best-of-sweep for training at T ∈ {4096, 8192});
+    pass explicit blocks to override. For the MXU rate, feed bf16
+    q/k/v: the kernel dots run in the input dtype (f32 accumulation),
+    and bf16 is ~4x the fp32 matmul rate.
     """
     b, t, h, d = q.shape
     if block_q is None:
-        block_q = _auto_blk(t, 512 if t >= 8192 else 256)
+        block_q = _auto_blk(t, 512)
     if block_k is None:
-        block_k = _auto_blk(t, 1024 if t >= 8192 else 512)
+        block_k = _auto_blk(t, 1024)
     blk_q = _blk(t, block_q)
     blk_k = _blk(t, block_k)
-    if t % blk_q or t % blk_k:
-        raise ValueError(
-            f"sequence length {t} must be a multiple of block sizes "
-            f"({blk_q}, {blk_k}); pad the sequence")
+    bwd_q = _blk(t, bwd_block_q) if bwd_block_q else blk_q
+    bwd_k = _blk(t, bwd_block_k) if bwd_block_k else blk_k
+    for bq, bk in ((blk_q, blk_k), (bwd_q, bwd_k)):
+        if t % bq or t % bk:
+            raise ValueError(
+                f"sequence length {t} must be a multiple of block sizes "
+                f"({bq}, {bk}); pad the sequence")
 
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
-    o3 = _flash(to3(q), to3(k), to3(v), causal, (blk_q, blk_k))
+    o3 = _flash(to3(q), to3(k), to3(v), causal, (blk_q, blk_k, bwd_q, bwd_k))
     return o3.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,95 @@
+"""Calibrate the FEMNIST-CNN-shaped and char-LM convergence pins
+(r3 VERDICT #4): find the synthetic-task difficulty where the curve at
+the reference hyperparameters is non-trivial (not saturated by round 30,
+clearly converging by the pinned round count). Run on the CPU mesh."""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def femnist_curve(alpha, rounds=150):
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.data.batching import batch_global
+    from fedml_tpu.data.store import FederatedStore
+    from fedml_tpu.models.cnn import CNNDropOut
+
+    C, K, batch = 3400, 62, 20
+    rng = np.random.RandomState(0)
+    counts = np.maximum(4, rng.lognormal(3.0, 0.6, C).astype(int))  # ~22
+    tot = int(counts.sum())
+    y = rng.randint(0, K, size=tot + 2000).astype(np.int32)
+    protos = rng.randn(K, 28, 28, 1).astype(np.float32)
+    x_all = (alpha * protos[y]
+             + rng.randn(len(y), 28, 28, 1).astype(np.float32))
+    edges = np.concatenate([[0], np.cumsum(counts)])
+    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(C)}
+    store = FederatedStore(x_all[:tot], y[:tot], parts, batch_size=batch)
+    test = batch_global(x_all[tot:], y[tot:], 100)
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=10,
+                    comm_round=rounds, epochs=1, batch_size=batch, lr=0.1,
+                    frequency_of_the_test=10_000)
+    api = FedAvgAPI(CNNDropOut(num_classes=K), store, test, cfg)
+    print(f"alpha={alpha} acc0={api.evaluate()['accuracy']:.3f}", flush=True)
+    t0 = time.time()
+    for r in range(rounds):
+        m = api.train_one_round(r)
+        if (r + 1) % 30 == 0:
+            print(f"  r{r+1}: loss={m['train_loss']:.3f} "
+                  f"acc={api.evaluate()['accuracy']:.3f} "
+                  f"({time.time()-t0:.0f}s)", flush=True)
+
+
+def charlm_curve(peak, rounds=60):
+    """peak = probability mass on each symbol's top successor."""
+    from functools import partial
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.models.rnn import RNNOriginalFedAvg
+    from fedml_tpu.trainer.local import seq_softmax_ce
+
+    C, T, V, batch = 715, 80, 90, 4
+    rng = np.random.RandomState(0)
+    # Order-1 Markov chain over symbols 1..V-1 (0 = pad): each symbol has
+    # one likely successor (prob ``peak``) and uniform remainder.
+    succ = rng.randint(1, V, size=V)
+    n_seq = C * 8
+    seqs = np.empty((n_seq, T + 1), np.int32)
+    state = rng.randint(1, V, size=n_seq)
+    for t in range(T + 1):
+        seqs[:, t] = state
+        follow = rng.rand(n_seq) < peak
+        state = np.where(follow, succ[state], rng.randint(1, V, size=n_seq))
+    x, y = seqs[:, :T], seqs[:, 1:]
+    fed = build_federated_arrays(x, y, partition_homo(n_seq, C), batch)
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=10,
+                    comm_round=rounds, epochs=1, batch_size=batch, lr=1.0,
+                    frequency_of_the_test=10_000)
+    api = FedAvgAPI(RNNOriginalFedAvg(vocab_size=V), fed, None, cfg,
+                    loss_fn=partial(seq_softmax_ce, pad_id=0))
+    # entropy of the chain ~ peak*ln(1/peak) + (1-peak)*ln(V/(1-peak))
+    print(f"peak={peak} (chain CE floor ~"
+          f"{-peak*np.log(peak)+(1-peak)*np.log((V-1)/(1-peak)):.2f} nats, "
+          f"init CE ~ ln({V})={np.log(V):.2f})", flush=True)
+    t0 = time.time()
+    for r in range(rounds):
+        m = api.train_one_round(r)
+        if (r + 1) % 10 == 0:
+            print(f"  r{r+1}: loss={m['train_loss']:.3f} "
+                  f"({time.time()-t0:.0f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1]
+    level = float(sys.argv[2])
+    rounds = int(sys.argv[3]) if len(sys.argv) > 3 else None
+    if which == "femnist":
+        femnist_curve(level, rounds or 150)
+    else:
+        charlm_curve(level, rounds or 60)

@@ -1,40 +1,69 @@
-"""Sweep flash fwd+bwd (training) block configs at long T, bf16 causal."""
+"""Sweep flash fwd+bwd (training) block configs at long T, bf16 causal.
+
+r4: the backward kernels take their own block sizes (``bwd_block_q/k``),
+so the sweep covers (a) joint fwd=bwd configs (the r3 grid) and (b) the
+fwd blocks pinned at auto with ONLY the bwd blocks varied — the
+attribution that tells whether bwd wants different tiling than fwd.
+Chained-iteration timing with a calibrated trip count (>=0.4 s device
+work per timed call, dynamic iters so no recompile across lengths)."""
 import statistics, time
 import jax, jax.numpy as jnp, numpy as np
 from fedml_tpu.ops.flash_attention import flash_attention
 
 H, D = 8, 64
+FLOOR_S, TARGET_S = 0.4, 0.6
 
-def timed(f, q, k, v, tokens):
-    float(f(q, k, v))
-    vals = []
-    for _ in range(3):
-        t0 = time.perf_counter(); float(f(q, k, v))
-        vals.append(tokens / (time.perf_counter() - t0))
-    return statistics.median(vals)
+def timed(f, q, k, v, tokens_per_iter):
+    def call(iters):
+        t0 = time.perf_counter(); float(f(q, k, v, iters))
+        return time.perf_counter() - t0
+    call(1)
+    t1 = min(call(1) for _ in range(2))
+    t2 = min(call(5) for _ in range(2))
+    per_iter = max((t2 - t1) / 4, 1e-4)
+    rtt = max(t1 - per_iter, 0.0)
+    for _ in range(4):
+        iters = max(1, min(4096, int(np.ceil(TARGET_S / per_iter))))
+        med = sorted(call(iters) for _ in range(5))[2]
+        refined = max((med - rtt) / iters, 1e-4)
+        if refined * iters >= FLOOR_S:
+            return tokens_per_iter * iters / med
+        per_iter = refined
+    raise RuntimeError("floor not reached")
 
-for t, b, iters in [(4096, 2, 4), (8192, 1, 2)]:
+def train_chain(bq, bk, bwd_bq=None, bwd_bk=None):
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk,
+                            bwd_block_q=bwd_bq, bwd_block_k=bwd_bk)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+    g = jax.grad(loss, argnums=(0, 1, 2))
+    def run(q, k, v, iters):
+        def body(i, c):
+            gq, gk, gv = g(c, k, v)
+            return c - (1e-6 * gq).astype(c.dtype)
+        out = jax.lax.fori_loop(0, iters, body, q)
+        return jnp.sum(out.astype(jnp.float32))
+    return jax.jit(run)
+
+for t, b in [(4096, 2), (8192, 1)]:
     rng = np.random.RandomState(0)
     q, k, v = (jnp.asarray(rng.randn(b, t, H, D), jnp.bfloat16) for _ in range(3))
-    tokens = b * t * iters
     for bq, bk in [(None, None), (128, 128), (256, 256), (256, 512),
                    (512, 512), (512, 256), (1024, 512), (512, 1024)]:
-        def loss(q, k, v, bq=bq, bk=bk):
-            o = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
-            return jnp.sum(o.astype(jnp.float32) ** 2)
-        g = jax.grad(loss, argnums=(0, 1, 2))
-        def run(q, k, v):
-            def body(i, c):
-                gq, gk, gv = g(c, k, v)
-                return c - (1e-6 * gq).astype(c.dtype)
-            out = jax.lax.fori_loop(0, iters, body, q)
-            return jnp.sum(out.astype(jnp.float32))
-        f = jax.jit(run)
         try:
-            tps = timed(f, q, k, v, tokens)
+            tps = timed(train_chain(bq, bk), q, k, v, b * t)
             print(f"T={t} blk=({bq},{bk}): {tps/1e3:.1f} ktok/s (fwd+bwd)", flush=True)
         except Exception as e:
             print(f"T={t} blk=({bq},{bk}): FAIL {str(e)[:80]}", flush=True)
+    # fwd pinned at auto, bwd blocks varied independently
+    for bwd_bq, bwd_bk in [(128, 128), (128, 512), (256, 256), (256, 512),
+                           (256, 1024), (512, 512), (512, 1024),
+                           (1024, 256), (1024, 512)]:
+        try:
+            tps = timed(train_chain(None, None, bwd_bq, bwd_bk), q, k, v, b * t)
+            print(f"T={t} bwd=({bwd_bq},{bwd_bk}): {tps/1e3:.1f} ktok/s", flush=True)
+        except Exception as e:
+            print(f"T={t} bwd=({bwd_bq},{bwd_bk}): FAIL {str(e)[:80]}", flush=True)
 
     # dense comparison
     def dense_loss(q, k, v, t=t):
@@ -45,12 +74,12 @@ for t, b, iters in [(4096, 2, 4), (8192, 1, 2)]:
         o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
         return jnp.sum(o.astype(jnp.float32) ** 2)
     gd = jax.grad(dense_loss, argnums=(0, 1, 2))
-    def rund(q, k, v):
+    def rund(q, k, v, iters):
         def body(i, c):
             gq, gk, gv = gd(c, k, v)
             return c - (1e-6 * gq).astype(c.dtype)
         return jnp.sum(jax.lax.fori_loop(0, iters, body, q).astype(jnp.float32))
     try:
-        print(f"T={t} dense: {timed(jax.jit(rund), q, k, v, tokens)/1e3:.1f} ktok/s", flush=True)
+        print(f"T={t} dense: {timed(jax.jit(rund), q, k, v, b * t)/1e3:.1f} ktok/s", flush=True)
     except Exception as e:
         print(f"T={t} dense: FAIL {str(e)[:80]}", flush=True)

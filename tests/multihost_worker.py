@@ -71,19 +71,120 @@ def run_sharded_round(mesh, to_global):
     return leaves, float(np.asarray(loss.addressable_data(0)))
 
 
+def run_store_rounds(mesh, to_global_local, client_range, n_rounds=3):
+    """``n_rounds`` full-participation sharded FedAvg rounds where the
+    host materializes ONLY its own clients from a local ``FederatedStore``
+    — the pod deployment shape for the 3400-client north star: per-host
+    streaming stores + the client-sharded round, composed (r3 VERDICT #5;
+    the resident-array SPMD test above never crossed the store path).
+
+    ``to_global_local(host_shard, pspec) -> jax.Array`` places a value
+    whose sharded axes are ALREADY host-local (the store only holds this
+    host's slice); replicated values are identical on every host.
+    ``client_range`` is this host's slice of the global client ids
+    (``process_local_client_slice`` under ``jax.distributed``; the full
+    range in the single-process reference). Returns (params_leaves,
+    losses[n_rounds]) as host numpy.
+
+    The per-host gathers force the GLOBAL step bucket (allgather of the
+    local cohort maxima) so every host's shard has identical [S, B]
+    shapes — ``FederatedStore.gather_cohort(steps=...)``.
+    """
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.data.store import FederatedStore, _bucket_steps
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.parallel.shard import make_sharded_round
+    from fedml_tpu.trainer.local import (
+        make_client_optimizer,
+        make_local_train_fn_from_cfg,
+        model_fns,
+    )
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+
+    C, B = 8, 16
+    # Ragged client sizes (clients 0..7 hold 24..52 samples): the global
+    # step bucket (4) differs from what a lone small client would pick,
+    # so the forced-bucket agreement is actually exercised.
+    x, y = make_classification(C * 38, n_features=12, n_classes=5, seed=0)
+    sizes = 24 + 4 * np.arange(C)
+    edges = np.concatenate([[0], np.cumsum(sizes)])
+    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(C)}
+    local_ids = list(range(C))[client_range]
+    store = FederatedStore(x, y, {i: parts[c] for i, c in
+                                  enumerate(local_ids)}, batch_size=B)
+
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=C,
+                    comm_round=n_rounds, epochs=1, batch_size=B, lr=0.3)
+    fns = model_fns(LogisticRegression(num_classes=5))
+    net = fns.init(jax.random.PRNGKey(0), np.zeros((B, 12), np.float32))
+    opt = make_client_optimizer(cfg.client_optimizer, cfg.lr)
+    local_train = make_local_train_fn_from_cfg(fns.apply, opt, cfg)
+    ax = mesh.axis_names[0]
+    round_fn = jax.jit(make_sharded_round(local_train, mesh, ax))
+
+    # Global cohort bucket: every host contributes its local max count.
+    local_max = int(store.counts.max()) if store.num_clients else 0
+    gmax = int(multihost_utils.process_allgather(
+        np.array([local_max])).max())
+    steps = _bucket_steps(int(np.ceil(gmax / B)))
+
+    net_g = jax.tree.map(
+        lambda p: to_global_local(np.asarray(p), P()), net)
+    losses = []
+    for r in range(n_rounds):
+        sub = store.gather_cohort(np.arange(store.num_clients), steps=steps)
+        w = np.asarray(sub.counts, np.float32)
+        rng = np.asarray(jax.random.fold_in(jax.random.PRNGKey(42), r))
+        args = (
+            net_g,
+            to_global_local(np.asarray(sub.x), P(ax)),
+            to_global_local(np.asarray(sub.y), P(ax)),
+            to_global_local(np.asarray(sub.mask), P(ax)),
+            to_global_local(w, P(ax)),
+            to_global_local(w, P(ax)),
+            to_global_local(rng, P()),
+        )
+        net_g, loss = round_fn(*args)
+        losses.append(float(np.asarray(loss.addressable_data(0))))
+    leaves = [np.asarray(l.addressable_data(0))
+              for l in jax.tree.leaves(net_g)]
+    return leaves, losses
+
+
 def main():
     pid, nprocs, port, out = (int(sys.argv[1]), int(sys.argv[2]),
                               sys.argv[3], sys.argv[4])
+    mode = sys.argv[5] if len(sys.argv) > 5 else "resident"
     import jax
     import numpy as np
     from jax.experimental import multihost_utils
 
-    from fedml_tpu.parallel.multihost import hybrid_mesh, initialize
+    from fedml_tpu.parallel.multihost import (hybrid_mesh, initialize,
+                                              process_local_client_slice)
 
     assert initialize(f"localhost:{port}", nprocs, pid)
     assert jax.process_count() == nprocs, jax.process_count()
     assert jax.local_device_count() == 4, jax.local_device_count()
     mesh = hybrid_mesh((4,), (nprocs,), ("clients",))
+
+    if mode == "store":
+        def to_global_local(v, pspec):
+            return multihost_utils.host_local_array_to_global_array(
+                v, mesh, pspec)
+
+        leaves, losses = run_store_rounds(
+            mesh, to_global_local, process_local_client_slice(8))
+        if pid == 0:
+            np.savez(out, losses=np.asarray(losses),
+                     **{f"leaf{i}": l for i, l in enumerate(leaves)})
+        multihost_utils.sync_global_devices("done")
+        return
 
     def to_global(v, pspec):
         if pspec == jax.sharding.PartitionSpec("clients"):

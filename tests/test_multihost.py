@@ -39,6 +39,63 @@ def test_hybrid_mesh_validates_ranks():
         hybrid_mesh((2, 2), (4,), ("hosts", "clients"))
 
 
+def test_two_process_store_rounds_match_single_process():
+    """Multihost × FederatedStore (r3 VERDICT #5): 2 processes × 4
+    virtual devices, each process holding ONLY its
+    ``process_local_client_slice`` of a ragged 8-client federation in a
+    streaming ``FederatedStore``, running 3 sharded FedAvg rounds with
+    the forced GLOBAL step bucket (per-host gathers must agree on [S, B]
+    shapes). Must match the single-process run where one store holds all
+    8 clients — the pod deployment shape for the 3400-client north star.
+    Tolerance 1e-5: the gloo all-reduce's 1-ulp association difference
+    compounds over 3 rounds of training."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding
+
+    from fedml_tpu.parallel.multihost import hybrid_mesh
+    from multihost_worker import run_store_rounds
+
+    mesh = hybrid_mesh((8,), axis_names=("clients",))
+    ref_leaves, ref_losses = run_store_rounds(
+        mesh, lambda v, spec: jax.device_put(v, NamedSharding(mesh, spec)),
+        slice(0, 8))
+
+    worker = Path(__file__).parent / "multihost_worker.py"
+    out = Path(os.environ.get("TMPDIR", "/tmp")) / (
+        f"mh_store_{os.getpid()}.npz")
+    port = 20000 + (os.getpid() + 7) % 10000
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+           "PALLAS_AXON_POOL_IPS": "",
+           "JAX_COMPILATION_CACHE_DIR": "/tmp/jaxcache",
+           # the worker runs script-mode (sys.path[0] = tests/), so the
+           # repo root must be on PYTHONPATH explicitly
+           "PYTHONPATH": os.pathsep.join(
+               [str(Path(__file__).parent.parent),
+                os.environ.get("PYTHONPATH", "")])}
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), "2", str(port), str(out),
+         "store"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in range(2)]
+    logs = [p.communicate(timeout=600)[0] for p in procs]
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"worker failed:\n{log[-3000:]}"
+
+    got = np.load(out)
+    try:
+        np.testing.assert_allclose(got["losses"], ref_losses, rtol=1e-5)
+        got_leaves = [got[f"leaf{i}"] for i in range(len(ref_leaves))]
+        for a, b in zip(ref_leaves, got_leaves):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+    finally:
+        out.unlink(missing_ok=True)
+
+
 def test_two_process_spmd_round_matches_single_process():
     """Spawn 2 OS processes × 4 virtual CPU devices each, initialize
     ``jax.distributed`` against a localhost coordinator, build
@@ -70,7 +127,12 @@ def test_two_process_spmd_round_matches_single_process():
            "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
            "PALLAS_AXON_POOL_IPS": "",
-           "JAX_COMPILATION_CACHE_DIR": "/tmp/jaxcache"}
+           "JAX_COMPILATION_CACHE_DIR": "/tmp/jaxcache",
+           # the worker runs script-mode (sys.path[0] = tests/), so the
+           # repo root must be on PYTHONPATH explicitly
+           "PYTHONPATH": os.pathsep.join(
+               [str(Path(__file__).parent.parent),
+                os.environ.get("PYTHONPATH", "")])}
     procs = [subprocess.Popen(
         [sys.executable, str(worker), str(pid), "2", str(port), str(out)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)

@@ -49,6 +49,28 @@ def test_gather_cohort_matches_resident_gather():
         np.testing.assert_array_equal(np.asarray(lhs), np.asarray(rhs))
 
 
+def test_gather_cohort_forced_steps():
+    """``steps=`` forces the bucket (multi-host shard-shape agreement):
+    a larger bucket pads with masked rows and must leave the real rows
+    identical; an insufficient bucket must raise, not truncate."""
+    import pytest
+
+    x, y, parts = _classification(8, 64)
+    store = FederatedStore(x, y, parts, batch_size=16)
+    idx = np.array([5, 1, 6])
+    own = store.gather_cohort(idx)
+    s_own = own.x.shape[1]
+    forced = store.gather_cohort(idx, steps=2 * s_own)
+    assert forced.x.shape[1] == 2 * s_own
+    np.testing.assert_array_equal(np.asarray(forced.x[:, :s_own]),
+                                  np.asarray(own.x))
+    np.testing.assert_array_equal(np.asarray(forced.mask[:, s_own:]), 0.0)
+    np.testing.assert_array_equal(np.asarray(forced.counts),
+                                  np.asarray(own.counts))
+    with pytest.raises(ValueError, match="forced steps"):
+        store.gather_cohort(idx, steps=s_own // 2)
+
+
 def test_streaming_rounds_equal_resident_rounds():
     """Equal-count clients (steps already a power of two) → the streaming
     cohort is identical to the resident gather, so whole training rounds
