@@ -32,7 +32,12 @@ Accounting:
   transformer sections (the high-MFU proof at d_model=512; the
   flash-in-training A/B curve at T ∈ {2048, 4096, 8192}).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints the full JSON blob (also written to ``docs/bench_r5_local.json``)
+followed by a compact (<1 KB) headline JSON as the FINAL stdout line —
+{"metric", "value", "unit", "vs_baseline", "mfu", "tuned_best", one
+scalar per submetric} — so the driver's bounded tail capture always
+keeps a parseable record of the primary number (r4 VERDICT #1: the full
+line outgrew the tail window and BENCH_r0{3,4}.json lost the metric).
 ``vs_baseline`` keeps the round-1 convention — a ~1500 samples/sec
 single-GPU PyTorch simulator assumption (RTX2080Ti-class ResNet-56/CIFAR;
 the reference publishes no throughput number, BASELINE.md) — while the
@@ -147,6 +152,11 @@ def _scan_bench(model, n_clients, per_client, batch, cpr, lr,
         api.train_rounds_on_device(rounds)  # recompile + warm new length
         jax.block_until_ready(api.net.params)
     trials = _timed_scan_trials(api, rounds, cpr * per_client)
+    # The floor is asserted, matching _lm_scan_bench (r4 ADVICE: the
+    # silent give-up here contradicted the module docstring).
+    call_s = cpr * per_client * rounds / statistics.median(trials)
+    assert call_s >= FLOOR_S, (
+        f"timed call {call_s:.3f}s below the {FLOOR_S}s floor")
     if with_iqr:
         return _med_iqr(trials)
     return statistics.median(trials)
@@ -256,23 +266,34 @@ def _warm_store_buckets(api, store, counts, cpr, batch):
     jax.block_until_ready(api.net.params)
 
 
-def _timed_store_windows(api, store, windows=3, window=10,
-                         count_samples=False):
+def _timed_store_windows(api, store, windows=5, window=10,
+                         count_samples=False, min_window_s=6.0):
     """Median rounds/sec (and samples/sec) over ``windows`` timed windows
-    of ``window`` store-backed rounds. Synced per-round loop BY DEFAULT:
+    of store-backed rounds, each window floor-calibrated to carry
+    ``min_window_s`` seconds of work. Synced per-round loop BY DEFAULT:
     through the axon tunnel a flood of unsynced dispatches costs more
     than the per-round float(loss) sync saves (A/B'd 2026-07-30, ~8.8 vs
     ~5.5 rounds/sec — the prefetch worker already overlaps the next
     gather with the wait). That floor is a TUNNEL property: on a
     directly-attached chip set BENCH_ATTACHED=1 to time the pipelined
-    loop instead (docs/PLATFORMS.md). Windowed medians because these
-    sections are dispatch-RTT-heavy and single windows swing with tunnel
-    variance."""
+    loop instead (docs/PLATFORMS.md).
+
+    Window calibration (r4 VERDICT #2): the scan sections got the
+    device-work floor in r4 but these per-round loops kept fixed 10-round
+    windows (~3 s for femnist, inside the tunnel's RTT band once divided
+    per-round), so the submetric's IQR spanned 2.5x and round-over-round
+    trends were unreadable. Now the window length is grown from a probe
+    window until one window ≥ ``min_window_s``, then median-of-5 windows
+    with IQR. Like FLOOR_S vs TARGET_S elsewhere in this file, the
+    calibration aims at ``min_window_s`` but the post-measurement assert
+    allows 2/3 of it — headroom so ordinary tunnel variance cannot crash
+    a section after its measurement succeeded."""
     import os
 
     attached = os.environ.get("BENCH_ATTACHED") == "1"
-    rps_w, sps_w, r = [], [], 1
-    for _ in range(windows):
+    window_floor_s = min_window_s * 2.0 / 3.0
+
+    def run_window(r, window):
         samples = 0
         if count_samples:
             for rr in range(r, r + window):
@@ -287,14 +308,37 @@ def _timed_store_windows(api, store, windows=3, window=10,
             for rr in range(r, r + window):
                 m = api.train_one_round(rr)
             assert np.isfinite(m["train_loss"])
-        dt = time.perf_counter() - t0
+        return time.perf_counter() - t0, samples
+
+    # Calibrate: grow the window until a single window carries
+    # min_window_s of wall work (measured, not assumed — and asserted
+    # below, like every other floor in this file).
+    r = 1
+    for _ in range(4):
+        dt, _ = run_window(r, window)
+        r += window
+        if dt >= min_window_s:
+            break
+        window = max(window + 5,
+                     int(np.ceil(window * min_window_s * 1.2 / dt)))
+    assert dt >= window_floor_s, (
+        f"calibration window {dt:.2f}s below the {window_floor_s:.1f}s floor")
+
+    rps_w, sps_w, window_s = [], [], []
+    for _ in range(windows):
+        dt, samples = run_window(r, window)
         rps_w.append(window / dt)
         sps_w.append(samples / dt)
+        window_s.append(dt)
         r += window
+    assert statistics.median(window_s) >= window_floor_s, window_s
     rps_med, rps_iqr = _med_iqr(rps_w)
     out = {"loop": "pipelined" if attached else "synced",
            "rounds_per_sec": round(rps_med, 3),
-           "rounds_per_sec_iqr": rps_iqr, "windows": windows}
+           "rounds_per_sec_iqr": rps_iqr, "windows": windows,
+           "window_rounds": window,
+           "window_s_floor": min_window_s,
+           "window_s_median": round(statistics.median(window_s), 2)}
     if count_samples:
         sps_med, sps_iqr = _med_iqr(sps_w)
         out["samples_per_sec"] = round(sps_med, 2)
@@ -323,8 +367,12 @@ def bench_femnist_cnn_3400():
     edges = np.concatenate([[0], np.cumsum(counts)])
     parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(n_clients)}
     store = FederatedStore(x, y, parts, batch_size=batch)
+    # comm_round bounds prefetch (fedavg.py _stream_cohort only prefetches
+    # while round_idx+1 < comm_round): the floor-calibrated windows run
+    # well past 40 rounds, so keep the horizon above any window schedule
+    # or the timed loop silently degrades to synchronous gathers mid-run.
     cfg = FedConfig(client_num_in_total=n_clients, client_num_per_round=cpr,
-                    comm_round=40, epochs=1, batch_size=batch, lr=0.1)
+                    comm_round=100_000, epochs=1, batch_size=batch, lr=0.1)
     api = FedAvgAPI(CNNDropOut(num_classes=62), store, None, cfg)
     _warm_store_buckets(api, store, counts, cpr, batch)
     timed = _timed_store_windows(api, store, count_samples=True)
@@ -355,7 +403,9 @@ def bench_stackoverflow_342k():
     counts = np.array([len(parts[c]) for c in range(C)])
     store = FederatedStore(x, y, parts, batch_size=batch)
     cfg = FedConfig(client_num_in_total=C, client_num_per_round=cpr,
-                    comm_round=40, epochs=1, batch_size=batch,
+                    comm_round=100_000,  # > any window schedule: keeps
+                    # the cohort prefetcher live for every timed round
+                    epochs=1, batch_size=batch,
                     lr=10 ** -0.5)  # BASELINE.md row lr
     api = FedAvgAPI(RNNStackOverflow(vocab_size=V), store, None, cfg,
                     loss_fn=partial(seq_softmax_ce, pad_id=0), pad_id=0)
@@ -764,7 +814,72 @@ def main():
         "tuned_best": tuned,
         "submetrics": sub,
     }
+    # Full blob → a file the repo keeps (round-over-round comparison
+    # material), plus stdout for anyone reading the whole log. Anchored
+    # to THIS file's directory, not the cwd, so the headline's "full"
+    # pointer is honest wherever bench.py is launched from.
+    blob_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "docs", "bench_r5_local.json")
+    try:
+        with open(blob_path, "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError as e:
+        print(f"[bench] could not write {blob_path}: {e}", file=sys.stderr)
+        blob_path = None
     print(json.dumps(out))
+    sys.stdout.flush()
+    print(json.dumps(build_headline(out, full_path=blob_path)))
+
+
+def build_headline(out, full_path="docs/bench_r5_local.json"):
+    """Compact headline emitted as the FINAL stdout line (r4 VERDICT #1):
+    the driver records a bounded TAIL of stdout, and by r3/r4 the full
+    line had outgrown it — BENCH_r0{3,4}.json carried neither the primary
+    metric nor tuned_best (parsed: null). One scalar per submetric, <1 KB
+    total (pinned by tests/test_bench_headline.py), so any tail window
+    keeps the number that matters and the driver's JSON parse works."""
+    sub = out.get("submetrics", {})
+    tuned = out.get("tuned_best")
+
+    def _scalar(name, *path):
+        node = sub.get(name, {})
+        for p in path:
+            node = node.get(p, {}) if isinstance(node, dict) else {}
+        return node if isinstance(node, (int, float)) else None
+
+    return {
+        "metric": out["metric"],
+        "value": out["value"],
+        "unit": out["unit"],
+        "vs_baseline": out["vs_baseline"],
+        "samples_per_sec_iqr": out.get("samples_per_sec_iqr"),
+        "rounds_per_sec": out.get("rounds_per_sec"),
+        "mfu": out.get("mfu"),
+        "tuned_best": ({"samples_per_sec": tuned["samples_per_sec"],
+                        "vs_baseline": tuned["vs_baseline"]}
+                       if tuned else None),
+        "sub": {
+            "femnist_3400_rps": _scalar("femnist_cnn_3400clients",
+                                        "rounds_per_sec"),
+            "stackoverflow_342k_rps": _scalar("stackoverflow_342k",
+                                              "rounds_per_sec"),
+            "vit_sps": _scalar("vit_cifar_shaped", "samples_per_sec"),
+            "b128_sps": _scalar("resnet56_batch128_tuned",
+                                "samples_per_sec"),
+            "s2d_sps": _scalar("resnet56_s2d_stem", "samples_per_sec"),
+            "s2d_b128_sps": _scalar("resnet56_s2d_stem",
+                                    "s2d_b128_samples_per_sec"),
+            "sharded_sps": _scalar("sharded_path_mesh1",
+                                   "samples_per_sec"),
+            "flash_speedup_t16384": _scalar("flash_attention_sweep",
+                                            "points", "t16384", "speedup"),
+            "transformer_mfu": _scalar("transformer_fed_mfu", "mfu"),
+            "flash_e2e_speedup_t8192": _scalar("transformer_flash_e2e",
+                                               "points", "t8192",
+                                               "speedup"),
+        },
+        "full": full_path,
+    }
 
 
 if __name__ == "__main__":

@@ -80,7 +80,9 @@ def _stats_per_channel(x32, groups):
     sumsq_c = jnp.sum(x32 * x32, axis=1)
     mu = ((sum_c @ m) @ m.T) / denom    # [bn, C], group-pooled
     ex2 = ((sumsq_c @ m) @ m.T) / denom
-    return mu, ex2 - mu * mu
+    # Clamp like flax's _compute_stats: E[x^2] - mu^2 can cancel below
+    # zero for near-constant inputs, and rsqrt(var + eps) would NaN.
+    return mu, jnp.maximum(ex2 - mu * mu, 0.0)
 
 
 def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, *, groups, eps):

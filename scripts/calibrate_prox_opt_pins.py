@@ -1,0 +1,132 @@
+"""Calibrate the FedProx and FedOpt reference-scale pins (r4 VERDICT #3).
+
+Run on the 8-device CPU mesh:
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python scripts/calibrate_prox_opt_pins.py [prox|opt]
+
+Prints the loss curves for each arm so the pin thresholds in
+tests/test_repro_convergence.py are measured numbers, not hopes — the
+same method the r4 pins used (module docstring there records the
+calibration sweeps).
+
+FedProx arm: the Shakespeare char-LM regime (2-layer LSTM, batch 4, SGD
+lr 1.0 — BASELINE.md row hyperparameters) with heterogeneity BOOSTED:
+clients are split into KGROUP disjoint order-1 Markov chains with
+different successor tables, so sampled cohorts pull the global model
+toward incompatible local optima. μ is the drift control; the pin
+asserts the documented FedProx effect (μ>0 tightens late-round loss
+variance and does not lose final loss) at reference scale.
+
+FedOpt arm: the FEMNIST-CNN row's task shape (62-class CNNDropOut,
+batch 20, 10/round) with client lr and task separation tuned so plain
+FedAvg descends SLOWLY — the regime "Adaptive Federated Optimization"
+(Reddi'20) targets — and server-Adam at the reference's --server_lr 0.1
+(main_fedopt.py:54-60; adam eps=1e-3 per the paper) must descend
+measurably faster by the asserted round.
+"""
+
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+
+def charlm_hetero_fed(C=256, T=80, V=90, batch=4, kgroup=8, seqs_per_client=8,
+                      peak=0.95, seed=0):
+    """Heterogeneity-boosted char-LM federation: kgroup disjoint successor
+    tables; client c follows table c % kgroup."""
+    from fedml_tpu.data.batching import build_federated_arrays
+
+    rng = np.random.RandomState(seed)
+    succ = rng.randint(1, V, size=(kgroup, V))
+    n_seq = C * seqs_per_client
+    group = (np.arange(n_seq) // seqs_per_client) % kgroup
+    seqs = np.empty((n_seq, T + 1), np.int32)
+    state = rng.randint(1, V, size=n_seq)
+    for t in range(T + 1):
+        seqs[:, t] = state
+        follow = rng.rand(n_seq) < peak
+        state = np.where(follow, succ[group, state],
+                         rng.randint(1, V, size=n_seq))
+    parts = {c: np.arange(c * seqs_per_client, (c + 1) * seqs_per_client)
+             for c in range(C)}
+    return build_federated_arrays(seqs[:, :T], seqs[:, 1:], parts, batch)
+
+
+def run_prox(mu, rounds=40, epochs=2, C=256):
+    import jax
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedprox import FedProxAPI
+    from fedml_tpu.models.rnn import RNNOriginalFedAvg
+    from fedml_tpu.trainer.local import seq_softmax_ce
+
+    fed = charlm_hetero_fed(C=C)
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=10,
+                    comm_round=rounds, epochs=epochs, batch_size=4, lr=1.0,
+                    fedprox_mu=mu, frequency_of_the_test=10_000)
+    api = FedProxAPI(RNNOriginalFedAvg(vocab_size=90), fed, None, cfg,
+                     loss_fn=partial(seq_softmax_ce, pad_id=0))
+    losses = [api.train_one_round(r)["train_loss"] for r in range(rounds)]
+    return np.asarray(losses)
+
+
+def femnist_shaped(C=200, K=62, batch=20, alpha=0.4, per=22, seed=0):
+    from fedml_tpu.data.batching import batch_global
+    from fedml_tpu.data.store import FederatedStore
+
+    rng = np.random.RandomState(seed)
+    counts = np.maximum(4, rng.lognormal(np.log(per), 0.5, C).astype(int))
+    tot = int(counts.sum())
+    y = rng.randint(0, K, size=tot + 2000).astype(np.int32)
+    protos = rng.randn(K, 28, 28, 1).astype(np.float32)
+    x_all = alpha * protos[y] + rng.randn(len(y), 28, 28, 1).astype(np.float32)
+    edges = np.concatenate([[0], np.cumsum(counts)])
+    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(C)}
+    store = FederatedStore(x_all[:tot], y[:tot], parts, batch_size=batch)
+    test = batch_global(x_all[tot:], y[tot:], 100)
+    return store, test
+
+
+def run_opt(server, rounds=40, lr=0.03, server_lr=0.1, alpha=0.4):
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.algos.fedopt import FedOptAPI
+    from fedml_tpu.models.cnn import CNNDropOut
+
+    store, test = femnist_shaped(alpha=alpha)
+    cfg = FedConfig(client_num_in_total=200, client_num_per_round=10,
+                    comm_round=rounds, epochs=1, batch_size=20, lr=lr,
+                    server_optimizer=server, server_lr=server_lr,
+                    frequency_of_the_test=10_000)
+    cls = FedAvgAPI if server == "none" else FedOptAPI
+    api = cls(CNNDropOut(num_classes=62), store, test, cfg)
+    losses = [api.train_one_round(r)["train_loss"] for r in range(rounds)]
+    return np.asarray(losses), api.evaluate()["accuracy"]
+
+
+def fmt(a):
+    return "[" + ", ".join(f"{v:.3f}" for v in a) + "]"
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which in ("prox", "both"):
+        for mu in [0.0, 0.01, 0.1]:
+            t0 = time.time()
+            ls = run_prox(mu)
+            late = ls[-10:]
+            print(f"prox mu={mu}: final10 mean={late.mean():.4f} "
+                  f"std={late.std():.4f} max={late.max():.4f} "
+                  f"curve10={fmt(ls[::4])} ({time.time()-t0:.0f}s)",
+                  flush=True)
+    if which in ("opt", "both"):
+        for server in ["none", "adam"]:
+            t0 = time.time()
+            ls, acc = run_opt(server)
+            print(f"opt server={server}: acc={acc:.4f} "
+                  f"loss@10={ls[9]:.3f} loss@20={ls[19]:.3f} "
+                  f"loss@40={ls[-1]:.3f} curve={fmt(ls[::4])} "
+                  f"({time.time()-t0:.0f}s)", flush=True)

@@ -1,0 +1,88 @@
+"""Name the buffers behind the T=8192 dense-attention anomaly (r4
+VERDICT #5).
+
+docs/ROOFLINE.md attributes dense attention's collapse at T=8192 b=1
+(~31k tok/s vs 703k at T=16384) to XLA materializing two unfused f32
+score buffers at 8192 but fusing to a single bf16 buffer at 16384 —
+inferred from temp-size arithmetic alone. This script compiles the
+EXACT bench formulation (bench.py bench_flash_attention_sweep's
+``naive``) at both points and prints:
+
+  - memory_analysis() totals (temp/argument/output bytes)
+  - every [.., T, T]-shaped tensor in the optimized HLO, with the
+    instruction name + opcode that produces it
+
+so the ROOFLINE paragraph can cite the actual buffer list instead of
+"the temp evidence says".
+
+Run on the real chip: python scripts/dump_dense_attention_buffers.py
+"""
+
+import re
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def naive_attn(q, k, v, t, d=64):
+    logits = (jnp.einsum("bqhd,bkhd->bhqk", q, k)
+              .astype(jnp.float32) / np.sqrt(d))
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    p = jax.nn.softmax(logits, -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def dump(t, b=1, h=8, d=64):
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(b, t, h, d), jnp.bfloat16)
+               for _ in range(3))
+
+    # Same chained-jit wrapper the bench times (iters=1 is what its
+    # temp_mb reports), so the buffer list matches the timed program.
+    def run(q, k, v, iters):
+        out = jax.lax.fori_loop(
+            0, iters, lambda i, acc: naive_attn(acc, k, v, t), q)
+        return jnp.sum(out)
+
+    compiled = jax.jit(run).lower(q, k, v, 1).compile()
+    ma = compiled.memory_analysis()
+    print(f"\n=== dense T={t} b={b} ===")
+    print(f"temp {ma.temp_size_in_bytes / 1e9:.3f} GB, "
+          f"args {ma.argument_size_in_bytes / 1e6:.1f} MB, "
+          f"output {ma.output_size_in_bytes / 1e6:.1f} MB, "
+          f"peak-ish total {(ma.temp_size_in_bytes + ma.argument_size_in_bytes) / 1e9:.3f} GB")
+
+    hlo = compiled.as_text()
+    # Every instruction whose RESULT carries a [.., T, T] score-shaped
+    # tensor (f32 or bf16): these are the materialized score buffers.
+    pat = re.compile(
+        rf"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*"
+        rf"((?:f32|bf16|f16|s32|pred)\[[\d,]*{t},{t}(?:\]|[,\d]*\]))"
+        rf"[^\n]*?\s(\w+)\(", re.M)
+    seen = {}
+    for name, shape, opcode in pat.findall(hlo):
+        dtype = shape.split("[")[0]
+        dims = shape[shape.index("["):]
+        nbytes = np.prod([int(x) for x in
+                          dims.strip("[]").split(",")]).astype(np.int64)
+        nbytes *= {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "pred": 1}[dtype]
+        key = (shape, opcode)
+        seen.setdefault(key, []).append((name, nbytes))
+    if not seen:
+        print("  (no [T,T]-shaped instruction results in optimized HLO)")
+    for (shape, opcode), insts in sorted(
+            seen.items(), key=lambda kv: -kv[1][0][1]):
+        names = ", ".join(n for n, _ in insts[:4])
+        more = f" (+{len(insts) - 4} more)" if len(insts) > 4 else ""
+        print(f"  {shape:28s} {opcode:12s} {insts[0][1] / 1e9:6.2f} GB each "
+              f"x{len(insts)}: {names}{more}")
+
+
+if __name__ == "__main__":
+    for t in (8192, 16384):
+        dump(t)

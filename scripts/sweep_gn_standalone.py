@@ -1,0 +1,110 @@
+"""Win-or-retire measurement for ``gn_fused``'s reserved use case (r4
+VERDICT #9).
+
+ops/group_norm.py keeps the pallas kernel available "for shapes where a
+standalone GN is already memory-bound and unfused (e.g. very wide
+channels)" — an untested escape hatch until now. This script times a
+STANDALONE GroupNorm (no surrounding convs, so XLA has no conv epilogue
+to fuse it into) at wide-channel transformer-ish shapes, pallas kernel
+vs flax nn.GroupNorm under jit, fwd-only and fwd+bwd.
+
+Chained iterations (output feeds the next input, so nothing hoists),
+two-point RTT-cancelling fit, 0.4 s device-work floor — the repo's
+standard kernel-timing machinery.
+
+Run on the real chip: python scripts/sweep_gn_standalone.py
+The measured verdict goes in ops/group_norm.py's docstring + ROOFLINE.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.ops.group_norm import group_norm
+
+FLOOR_S, TARGET_S = 0.4, 0.6
+
+# (B*S rows, C channels): standalone wide-channel GN shapes. bf16 input.
+SHAPES = [(256, 1024, 2048), (64, 512, 4096), (16, 256, 8192)]
+GROUPS = 32
+
+
+def calibrated(run):
+    def call(iters):
+        t0 = time.perf_counter()
+        float(run(iters))
+        return time.perf_counter() - t0
+
+    call(1)
+    t1 = min(call(1) for _ in range(2))
+    t2 = min(call(5) for _ in range(2))
+    per_iter = max((t2 - t1) / 4, 1e-7)
+    rtt = max(t1 - per_iter, 0.0)
+    for _ in range(5):
+        iters = max(1, min(1 << 18, int(np.ceil(TARGET_S / per_iter))))
+        meds = sorted(call(iters) for _ in range(5))
+        med = meds[2]
+        refined = max((med - rtt) / iters, 1e-7)
+        if refined * iters >= FLOOR_S:
+            return refined
+        per_iter = refined
+    raise RuntimeError("floor not reached")
+
+
+def bench_side(apply_fn, x, gamma, beta, with_bwd):
+    """apply_fn(x, gamma, beta) -> y, same shape as x."""
+    if with_bwd:
+        def loss(x, g, b):
+            return jnp.sum(apply_fn(x, g, b).astype(jnp.float32))
+
+        grad = jax.grad(loss, argnums=0)
+
+        def step(x):
+            return x + 1e-30 * grad(x, gamma, beta).astype(x.dtype)
+    else:
+        def step(x):
+            return apply_fn(x, gamma, beta).astype(x.dtype)
+
+    def run(iters):
+        out = jax.lax.fori_loop(0, jnp.int32(iters),
+                                lambda i, acc: step(acc), x)
+        return jnp.sum(out.astype(jnp.float32))
+
+    return calibrated(jax.jit(run))
+
+
+def main():
+    print(f"device: {jax.devices()[0].device_kind}", flush=True)
+    for b, s, c in SHAPES:
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(b, s, c), jnp.bfloat16)
+        gamma = jnp.asarray(rng.rand(c) + 0.5, jnp.float32)
+        beta = jnp.asarray(rng.randn(c), jnp.float32)
+        flax_mod = nn.GroupNorm(num_groups=GROUPS, epsilon=1e-6,
+                                dtype=jnp.bfloat16)
+
+        def flax_gn(x, g, bt):
+            return flax_mod.apply({"params": {"scale": g, "bias": bt}}, x)
+
+        def fused_gn(x, g, bt):
+            return group_norm(x, g, bt, GROUPS)
+
+        gb = x.size * 2 / 1e9
+        for tag, with_bwd in [("fwd", False), ("fwd+bwd", True)]:
+            tf = bench_side(flax_gn, x, gamma, beta, with_bwd)
+            tp = bench_side(fused_gn, x, gamma, beta, with_bwd)
+            print(f"[{b}x{s}x{c}] {tag}: flax {tf * 1e6:.1f} us "
+                  f"({gb / tf:.0f} GB/s in) | pallas {tp * 1e6:.1f} us "
+                  f"({gb / tp:.0f} GB/s in) | pallas/flax "
+                  f"{tp / tf:.2f}x", flush=True)
+
+
+if __name__ == "__main__":
+    main()

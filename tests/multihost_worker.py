@@ -1,10 +1,13 @@
-"""Subprocess worker + shared round logic for the 2-process SPMD test.
+"""Subprocess worker + shared round logic for the multi-process SPMD
+tests.
 
-Run as ``python multihost_worker.py <pid> <nprocs> <port> <out.npz>``
-with JAX_PLATFORMS=cpu and 4 virtual devices per process. The SAME
-``run_sharded_round`` builds the reference result inside the test's
-single 8-device process, so any divergence is attributable to the
-process boundary, not to differing code paths.
+Run as ``python multihost_worker.py <pid> <nprocs> <port> <out.npz>
+[mode] [local_devices]`` with JAX_PLATFORMS=cpu and ``local_devices``
+(default 4) virtual devices per process — the 2-proc × 4-dev and
+4-proc × 2-dev shapes both exercise the same 8-device global mesh. The
+SAME ``run_sharded_round`` builds the reference result inside the
+test's single 8-device process, so any divergence is attributable to
+the process boundary, not to differing code paths.
 """
 
 import sys
@@ -104,7 +107,6 @@ def run_store_rounds(mesh, to_global_local, client_range, n_rounds=3):
         make_local_train_fn_from_cfg,
         model_fns,
     )
-    from fedml_tpu.data.partition import partition_homo
     from fedml_tpu.data.synthetic import make_classification
 
     C, B = 8, 16
@@ -161,6 +163,7 @@ def main():
     pid, nprocs, port, out = (int(sys.argv[1]), int(sys.argv[2]),
                               sys.argv[3], sys.argv[4])
     mode = sys.argv[5] if len(sys.argv) > 5 else "resident"
+    local_devices = int(sys.argv[6]) if len(sys.argv) > 6 else 4
     import jax
     import numpy as np
     from jax.experimental import multihost_utils
@@ -170,8 +173,9 @@ def main():
 
     assert initialize(f"localhost:{port}", nprocs, pid)
     assert jax.process_count() == nprocs, jax.process_count()
-    assert jax.local_device_count() == 4, jax.local_device_count()
-    mesh = hybrid_mesh((4,), (nprocs,), ("clients",))
+    assert jax.local_device_count() == local_devices, (
+        jax.local_device_count())
+    mesh = hybrid_mesh((local_devices,), (nprocs,), ("clients",))
 
     if mode == "store":
         def to_global_local(v, pspec):

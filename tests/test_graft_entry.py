@@ -17,3 +17,19 @@ def test_dryrun_multichip_8():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+import pytest
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_32():
+    """Mesh shapes beyond the 8-device habit (r4 VERDICT #8): the full
+    parallel stack (client shards, ring/flash SP, TP, EP all_to_all,
+    GPipe PP) on a 32-virtual-device mesh — catches any hardcoded
+    8-assumption (divisibility, stage counts, microbatch math) before a
+    real pod exists. Subprocess-bootstrapped, so the in-process backend
+    (usually 8 CPU devices under conftest) doesn't constrain it."""
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(32)

@@ -40,7 +40,11 @@ from fedml_tpu.data.store import FederatedStore
 from fedml_tpu.models.lr import LogisticRegression
 
 
+@pytest.mark.slow
 def test_mnist_lr_shaped_convergence_120_rounds():
+    # slow-marked in r5 (r4 VERDICT #6b): 120 store-backed rounds is the
+    # single heaviest unmarked test on a 1-core box; the fast lane keeps
+    # 2-round algorithmic coverage, the slow lane owns reference scale.
     C, K, D, alpha = 1000, 10, 784, 0.1
     rng = np.random.RandomState(0)
     # Power-law client sizes (the reference's MNIST partition), ~15/client.
