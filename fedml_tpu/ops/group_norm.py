@@ -14,9 +14,19 @@ folds the normalize/affine into conv epilogues, so swapping in an
 opaque pallas call severs those fusions and forces extra HBM
 round-trips per layer that the kernel's own efficiency cannot buy
 back. The op stays available (``models.resnet.Norm(kind="gn_fused")``,
-param-compatible with ``"gn"``) for shapes where a standalone GN is
-already memory-bound and unfused (e.g. very wide channels), and as the
-measured record of the experiment; models default to ``"gn"``.
+param-compatible with ``"gn"``); models default to ``"gn"``.
+
+The reserved use case is now MEASURED, not hypothetical
+(scripts/sweep_gn_standalone.py, v5e, 2026-07-31, random cotangent —
+an all-ones cotangent lets XLA simplify the mean-subtracted backward
+and was rejected as an unfair workload): standalone wide-channel GN
+TRAINING steps (fwd+bwd) run 0.67-0.73x of flax's time at C=2048-4096
+([64,128,2048]: 165 vs 225 us; [32,128,4096]: 143 vs 214 us) — the
+backward's recompute-in-VMEM strategy beats XLA's saved-temporaries
+autodiff, which drops to ~150 GB/s. Forward-only, XLA wins everywhere
+(1.56-1.92x, sustaining 640-825 GB/s). Boundary: at C=8192 the bwd
+kernel's [N-block, S, C] tile exceeds the 16 MB scoped VMEM and fails
+to compile — use ``"gn"`` past ~4k channels.
 
 Layout: public API [..., S, C] with ``groups`` dividing C (the caller
 flattens spatial dims; models.resnet.Norm does the NHWC reshape).

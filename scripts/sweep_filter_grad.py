@@ -23,18 +23,20 @@ device-work floor (same machinery as scripts/sweep_s2d_attrib.py).
 Run on the real chip: python scripts/sweep_filter_grad.py
 """
 
+import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-FLOOR_S, TARGET_S = 0.4, 0.6
+from _timing import calibrated_ramp
+
 DN = ("NHWC", "HWIO", "NHWC")
 
 # (name, B, H, W, I, O): the s2d resnet56 stage shapes at bench batch.
@@ -44,30 +46,6 @@ SHAPES = [
     ("stage2 8x8 64ch", 256, 8, 8, 64, 64),
     ("stage3 4x4 128ch", 256, 4, 4, 128, 128),
 ]
-
-
-def calibrated(run):
-    """Median seconds/iter of run(iters) with the floor enforced; the
-    two-point fit cancels the tunnel's dispatch RTT."""
-    def call(iters):
-        t0 = time.perf_counter()
-        float(run(iters))
-        return time.perf_counter() - t0
-
-    call(1)
-    t1 = min(call(1) for _ in range(2))
-    t2 = min(call(5) for _ in range(2))
-    per_iter = max((t2 - t1) / 4, 1e-7)
-    rtt = max(t1 - per_iter, 0.0)
-    for _ in range(5):
-        iters = max(1, min(1 << 20, int(np.ceil(TARGET_S / per_iter))))
-        meds = sorted(call(iters) for _ in range(5))
-        med = meds[2]
-        refined = max((med - rtt) / iters, 1e-7)
-        if refined * iters >= FLOOR_S:
-            return refined
-        per_iter = refined
-    raise RuntimeError("floor not reached")
 
 
 def chain(f, out_reduce=jnp.sum):
@@ -116,7 +94,7 @@ def measure_shape(name, b, h, w, i, o):
     for label, f, fl in [("conv_dw", conv_dw, flops),
                          ("gemm_nat", gemm_nat, flops),
                          ("gemm_sq", gemm_sq, sq_flops)]:
-        sec = calibrated(chain(f))
+        sec = calibrated_ramp(chain(f))
         row[label + "_us"] = round(sec * 1e6, 2)
         row[label + "_tflops"] = round(fl / sec / 1e12, 2)
     row["dw_vs_nat"] = round(row["conv_dw_us"] / row["gemm_nat_us"], 2)
