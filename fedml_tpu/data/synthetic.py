@@ -127,3 +127,58 @@ def make_stackoverflow_nwp(
     edges = np.concatenate([[0], np.cumsum(counts)])
     parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(n_clients)}
     return x, y, parts
+
+
+def make_hetero_charlm(n_clients=256, seq_len=80, vocab=90, kgroup=16,
+                       seqs_per_client=4, peak=0.98, seed=0):
+    """Heterogeneity-boosted char-LM federation: ``kgroup`` DISJOINT
+    order-1 Markov chains over the vocab (client c follows table
+    c % kgroup), so sampled cohorts pull a shared model toward
+    incompatible local optima — the drift regime FedProx's μ targets.
+
+    Returns ``(x, y, parts)`` like the other builders here: [N, T]
+    inputs, [N, T] shifted targets, per-client index dict. Single
+    source for the FedProx reference-scale pin
+    (tests/test_repro_convergence.py) and its calibration sweep
+    (scripts/calibrate_prox_opt_pins.py) — the thresholds there are
+    only valid for THIS generator at these defaults.
+    """
+    rng = np.random.RandomState(seed)
+    succ = rng.randint(1, vocab, size=(kgroup, vocab))
+    n_seq = n_clients * seqs_per_client
+    group = (np.arange(n_seq) // seqs_per_client) % kgroup
+    seqs = np.empty((n_seq, seq_len + 1), np.int32)
+    state = rng.randint(1, vocab, size=n_seq)
+    for t in range(seq_len + 1):
+        seqs[:, t] = state
+        follow = rng.rand(n_seq) < peak
+        state = np.where(follow, succ[group, state],
+                         rng.randint(1, vocab, size=n_seq))
+    parts = {c: np.arange(c * seqs_per_client, (c + 1) * seqs_per_client)
+             for c in range(n_clients)}
+    return seqs[:, :seq_len], seqs[:, 1:], parts
+
+
+def make_femnist_shaped(n_clients=200, n_classes=62, alpha=0.6, per=22,
+                        maxper=None, n_test=2000, seed=0):
+    """FEMNIST-shaped synthetic federation: 28x28x1 class-conditional
+    Gaussian images with separation ``alpha``, lognormal power-law
+    client sizes (optionally capped at ``maxper`` to bound the cohort
+    step bucket — a bucket-4 round costs ~80 s on a 1-core CPU mesh).
+
+    Returns ``(x_train, y_train, parts, x_test, y_test)``. Single
+    source for the FedOpt reference-scale pin and its calibration
+    sweep (see make_hetero_charlm).
+    """
+    rng = np.random.RandomState(seed)
+    counts = np.maximum(4, rng.lognormal(np.log(per), 0.5,
+                                         n_clients).astype(int))
+    if maxper is not None:
+        counts = np.minimum(counts, maxper)
+    tot = int(counts.sum())
+    y = rng.randint(0, n_classes, size=tot + n_test).astype(np.int32)
+    protos = rng.randn(n_classes, 28, 28, 1).astype(np.float32)
+    x = alpha * protos[y] + rng.randn(len(y), 28, 28, 1).astype(np.float32)
+    edges = np.concatenate([[0], np.cumsum(counts)])
+    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(n_clients)}
+    return x[:tot], y[:tot], parts, x[tot:], y[tot:]

@@ -1,29 +1,45 @@
 """Calibrate the FedProx and FedOpt reference-scale pins (r4 VERDICT #3).
 
-Run on the 8-device CPU mesh:
-  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-  python scripts/calibrate_prox_opt_pins.py [prox|opt]
+Usage (runs on whatever backend is live — the sweeps below were run on
+the real v5e, ~40x faster per arm than the 1-core CPU mesh; the final
+thresholds were then validated once on the 8-device CPU mesh, the
+suite's environment):
 
-Prints the loss curves for each arm so the pin thresholds in
+  python scripts/calibrate_prox_opt_pins.py prox [epochs peak kgroup cpr rounds per]
+  python scripts/calibrate_prox_opt_pins.py opt  [lr alpha rounds server_lr per maxper]
+
+The shipped pins were calibrated with:
+  prox 6 0.98 16 10 12 4        (and the 2x-work cross-check: ... 24 8)
+  opt  0.003 1.0 30 0.05 22 20
+
+Prints per-arm loss curves AND the pin observables so the thresholds in
 tests/test_repro_convergence.py are measured numbers, not hopes — the
-same method the r4 pins used (module docstring there records the
-calibration sweeps).
+same method the r4 pins used.
 
 FedProx arm: the Shakespeare char-LM regime (2-layer LSTM, batch 4, SGD
 lr 1.0 — BASELINE.md row hyperparameters) with heterogeneity BOOSTED:
-clients are split into KGROUP disjoint order-1 Markov chains with
-different successor tables, so sampled cohorts pull the global model
-toward incompatible local optima. μ is the drift control; the pin
-asserts the documented FedProx effect (μ>0 tightens late-round loss
-variance and does not lose final loss) at reference scale.
+clients split over KGROUP disjoint order-1 Markov chains, so sampled
+cohorts pull toward incompatible optima. The pin observable is DRIFT:
+``w_{t+1} − w_t = avg_c(w_c − w_t)``, so the global update norm is the
+cohort-average client drift — the exact quantity μ penalizes. Measured
+(v5e 2026-07-31, E=6 peak=0.98 k=16 cpr=10, 24 rounds): mean drift
+1.538 (μ=0) / 1.467 (μ=0.01) / 1.048 (μ=0.1) — monotone, 0.68 ratio at
+μ=0.1, with bounded CE cost (final-5: 1.03 vs 1.64). Earlier attempts
+that asserted LOSS variance failed both directions: sampled-cohort loss
+reads HIGHER variance under μ>0 (clients held near the compromise model
+score worse on their own chain), so it is the wrong observable.
 
-FedOpt arm: the FEMNIST-CNN row's task shape (62-class CNNDropOut,
-batch 20, 10/round) with client lr and task separation tuned so plain
-FedAvg descends SLOWLY — the regime "Adaptive Federated Optimization"
-(Reddi'20) targets — and server-Adam at the reference's --server_lr 0.1
-(main_fedopt.py:54-60; adam eps=1e-3 per the paper) must descend
-measurably faster by the asserted round.
+FedOpt arm: the FEMNIST-CNN task shape (62-class CNNDropOut, batch 20,
+10/round) in the Reddi'20 regime — client steps too small to progress
+alone (SGD lr 0.003), server-Adam (eps 1e-3 per the paper) re-scales
+the pseudo-gradient per-coordinate and learns. Measured (v5e
+2026-07-31): at the pin's config (alpha=1.0, maxper=20, server_lr
+0.05) FedAvg is near chance through 30 rounds (acc 0.058) vs Adam acc
+0.33; the uncapped alpha=0.6 / server_lr 0.03 variant reaches Adam acc
+0.22 @ 40 / 0.49 @ 60 vs FedAvg 0.018. Negative results kept for the
+record: at the flag-default server_lr 0.1, server-Adam does NOT
+descend at any client lr tried (0.003/0.0316/0.1); at client lr 0.1
+plain FedAvg learns and needs no server optimizer.
 """
 
 import sys
@@ -33,29 +49,19 @@ from functools import partial
 import numpy as np
 
 
-def charlm_hetero_fed(C=256, T=80, V=90, batch=4, kgroup=8, seqs_per_client=8,
+def charlm_hetero_fed(C=256, batch=4, kgroup=8, seqs_per_client=8,
                       peak=0.95, seed=0):
-    """Heterogeneity-boosted char-LM federation: kgroup disjoint successor
-    tables; client c follows table c % kgroup."""
     from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.synthetic import make_hetero_charlm
 
-    rng = np.random.RandomState(seed)
-    succ = rng.randint(1, V, size=(kgroup, V))
-    n_seq = C * seqs_per_client
-    group = (np.arange(n_seq) // seqs_per_client) % kgroup
-    seqs = np.empty((n_seq, T + 1), np.int32)
-    state = rng.randint(1, V, size=n_seq)
-    for t in range(T + 1):
-        seqs[:, t] = state
-        follow = rng.rand(n_seq) < peak
-        state = np.where(follow, succ[group, state],
-                         rng.randint(1, V, size=n_seq))
-    parts = {c: np.arange(c * seqs_per_client, (c + 1) * seqs_per_client)
-             for c in range(C)}
-    return build_federated_arrays(seqs[:, :T], seqs[:, 1:], parts, batch)
+    x, y, parts = make_hetero_charlm(
+        n_clients=C, kgroup=kgroup, seqs_per_client=seqs_per_client,
+        peak=peak, seed=seed)
+    return build_federated_arrays(x, y, parts, batch)
 
 
-def run_prox(mu, rounds=40, epochs=2, C=256):
+def run_prox(mu, rounds=40, epochs=2, C=256, kgroup=8, peak=0.95, cpr=10,
+             per=8):
     import jax
 
     from fedml_tpu.algos.config import FedConfig
@@ -63,40 +69,50 @@ def run_prox(mu, rounds=40, epochs=2, C=256):
     from fedml_tpu.models.rnn import RNNOriginalFedAvg
     from fedml_tpu.trainer.local import seq_softmax_ce
 
-    fed = charlm_hetero_fed(C=C)
-    cfg = FedConfig(client_num_in_total=C, client_num_per_round=10,
+    fed = charlm_hetero_fed(C=C, kgroup=kgroup, peak=peak,
+                            seqs_per_client=per)
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=cpr,
                     comm_round=rounds, epochs=epochs, batch_size=4, lr=1.0,
                     fedprox_mu=mu, frequency_of_the_test=10_000)
     api = FedProxAPI(RNNOriginalFedAvg(vocab_size=90), fed, None, cfg,
                      loss_fn=partial(seq_softmax_ce, pad_id=0))
-    losses = [api.train_one_round(r)["train_loss"] for r in range(rounds)]
-    return np.asarray(losses)
+
+    def flat(net):
+        return np.concatenate([np.asarray(l).ravel()
+                               for l in jax.tree.leaves(net.params)])
+
+    losses, dnorms, prev = [], [], flat(api.net)
+    for r in range(rounds):
+        losses.append(api.train_one_round(r)["train_loss"])
+        cur = flat(api.net)
+        # ||w_{t+1} - w_t|| = ||avg_c(w_c - w_t)||: the global update
+        # norm IS the cohort-average client drift — the quantity mu
+        # penalizes, measured from outside the API.
+        dnorms.append(float(np.linalg.norm(cur - prev)))
+        prev = cur
+    return np.asarray(losses), np.asarray(dnorms)
 
 
-def femnist_shaped(C=200, K=62, batch=20, alpha=0.4, per=22, seed=0):
+def femnist_shaped(C=200, batch=20, alpha=0.4, per=22, seed=0,
+                   maxper=None):
     from fedml_tpu.data.batching import batch_global
     from fedml_tpu.data.store import FederatedStore
+    from fedml_tpu.data.synthetic import make_femnist_shaped
 
-    rng = np.random.RandomState(seed)
-    counts = np.maximum(4, rng.lognormal(np.log(per), 0.5, C).astype(int))
-    tot = int(counts.sum())
-    y = rng.randint(0, K, size=tot + 2000).astype(np.int32)
-    protos = rng.randn(K, 28, 28, 1).astype(np.float32)
-    x_all = alpha * protos[y] + rng.randn(len(y), 28, 28, 1).astype(np.float32)
-    edges = np.concatenate([[0], np.cumsum(counts)])
-    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(C)}
-    store = FederatedStore(x_all[:tot], y[:tot], parts, batch_size=batch)
-    test = batch_global(x_all[tot:], y[tot:], 100)
-    return store, test
+    x, y, parts, xt, yt = make_femnist_shaped(
+        n_clients=C, alpha=alpha, per=per, maxper=maxper, seed=seed)
+    store = FederatedStore(x, y, parts, batch_size=batch)
+    return store, batch_global(xt, yt, 100)
 
 
-def run_opt(server, rounds=40, lr=0.03, server_lr=0.1, alpha=0.4):
+def run_opt(server, rounds=40, lr=0.03, server_lr=0.1, alpha=0.4, per=22,
+            maxper=None):
     from fedml_tpu.algos.config import FedConfig
     from fedml_tpu.algos.fedavg import FedAvgAPI
     from fedml_tpu.algos.fedopt import FedOptAPI
     from fedml_tpu.models.cnn import CNNDropOut
 
-    store, test = femnist_shaped(alpha=alpha)
+    store, test = femnist_shaped(alpha=alpha, per=per, maxper=maxper)
     cfg = FedConfig(client_num_in_total=200, client_num_per_round=10,
                     comm_round=rounds, epochs=1, batch_size=20, lr=lr,
                     server_optimizer=server, server_lr=server_lr,
@@ -112,21 +128,43 @@ def fmt(a):
 
 
 if __name__ == "__main__":
-    which = sys.argv[1] if len(sys.argv) > 1 else "both"
-    if which in ("prox", "both"):
+    which = sys.argv[1] if len(sys.argv) > 1 else ""
+    if which not in ("prox", "opt"):
+        sys.exit("usage: calibrate_prox_opt_pins.py prox|opt [args] "
+                 "(the two modes take different positional args; "
+                 "no combined mode)")
+    if which == "prox":
+        epochs = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+        peak = float(sys.argv[3]) if len(sys.argv) > 3 else 0.95
+        kgroup = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+        cpr = int(sys.argv[5]) if len(sys.argv) > 5 else 10
+        rounds = int(sys.argv[6]) if len(sys.argv) > 6 else 40
+        per = int(sys.argv[7]) if len(sys.argv) > 7 else 8
         for mu in [0.0, 0.01, 0.1]:
             t0 = time.time()
-            ls = run_prox(mu)
+            ls, dn = run_prox(mu, epochs=epochs, peak=peak, kgroup=kgroup,
+                              cpr=cpr, rounds=rounds, per=per)
             late = ls[-10:]
-            print(f"prox mu={mu}: final10 mean={late.mean():.4f} "
+            print(f"prox mu={mu} E={epochs} peak={peak} k={kgroup} cpr={cpr}: "
+                  f"final10 mean={late.mean():.4f} "
                   f"std={late.std():.4f} max={late.max():.4f} "
+                  f"drift10={dn[-10:].mean():.4f} driftall={dn.mean():.4f} "
+                  f"drift4on={dn[4:].mean():.4f} last5={fmt(ls[-5:])} "
                   f"curve10={fmt(ls[::4])} ({time.time()-t0:.0f}s)",
                   flush=True)
-    if which in ("opt", "both"):
+    if which == "opt":
+        lr = float(sys.argv[2]) if len(sys.argv) > 2 else 0.03
+        alpha = float(sys.argv[3]) if len(sys.argv) > 3 else 0.4
+        rounds = int(sys.argv[4]) if len(sys.argv) > 4 else 40
+        server_lr = float(sys.argv[5]) if len(sys.argv) > 5 else 0.1
+        per = int(sys.argv[6]) if len(sys.argv) > 6 else 22
+        maxper = int(sys.argv[7]) if len(sys.argv) > 7 else None
         for server in ["none", "adam"]:
             t0 = time.time()
-            ls, acc = run_opt(server)
-            print(f"opt server={server}: acc={acc:.4f} "
-                  f"loss@10={ls[9]:.3f} loss@20={ls[19]:.3f} "
+            ls, acc = run_opt(server, rounds=rounds, lr=lr,
+                              server_lr=server_lr, alpha=alpha, per=per,
+                              maxper=maxper)
+            print(f"opt server={server} lr={lr} a={alpha} slr={server_lr}: acc={acc:.4f} "
+                  f"loss@10={ls[min(9, len(ls)-1)]:.3f} loss@20={ls[min(19, len(ls)-1)]:.3f} "
                   f"loss@40={ls[-1]:.3f} curve={fmt(ls[::4])} "
                   f"({time.time()-t0:.0f}s)", flush=True)

@@ -118,6 +118,137 @@ def test_femnist_cnn_shaped_convergence_60_rounds():
 
 
 @pytest.mark.slow
+def test_fedprox_controls_drift_at_reference_scale():
+    """FedProx pinned beyond 2-round sanity (r4 VERDICT #3a): the
+    Shakespeare row's optimizer regime (2-layer LSTM, batch 4, SGD
+    **lr 1.0**) on a heterogeneity-BOOSTED char task — 256 clients
+    split over 16 disjoint order-1 Markov chains (peak successor prob
+    0.98), 6 local epochs, 10/round, 12 rounds — so sampled cohorts
+    pull toward incompatible local optima and client drift is the
+    dominant dynamic.
+
+    The asserted quantity is drift itself: under FedAvg-style
+    aggregation, ``w_{t+1} − w_t = avg_c(w_c − w_t)``, so the global
+    update norm IS the cohort-average client drift — exactly what μ
+    penalizes. Calibrated on v5e (2026-07-31,
+    scripts/calibrate_prox_opt_pins.py `prox 6 0.98 16 10 12 4`):
+    mean drift over rounds 2..12 = 1.10 (μ=0) / 1.09 (μ=0.01, monotone)
+    / 0.855 (μ=0.1), a 0.78 ratio; last-3 CE 2.61 vs 2.72 (μ's bounded
+    regularization cost); both descend from ~3.5 first-round CE. At
+    2x the local work (per=8 seqs, 24 rounds) the same ordering holds
+    with a fatter 0.68 ratio — this trimmed config is sized for the
+    1-core suite box (r4 VERDICT #6: ~30 s/round there)."""
+    from functools import partial
+
+    import jax
+
+    from fedml_tpu.algos.fedprox import FedProxAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.synthetic import make_hetero_charlm
+    from fedml_tpu.models.rnn import RNNOriginalFedAvg
+    from fedml_tpu.trainer.local import seq_softmax_ce
+
+    C, V, rounds = 256, 90, 12
+    # Same generator + defaults as the calibration sweep — the
+    # thresholds below are only valid for make_hetero_charlm's output.
+    x, y, parts = make_hetero_charlm(n_clients=C)
+
+    def run(mu):
+        fed = build_federated_arrays(x, y, parts, 4)
+        cfg = FedConfig(client_num_in_total=C, client_num_per_round=10,
+                        comm_round=rounds, epochs=6, batch_size=4, lr=1.0,
+                        fedprox_mu=mu, frequency_of_the_test=10_000)
+        api = FedProxAPI(RNNOriginalFedAvg(vocab_size=V), fed, None, cfg,
+                         loss_fn=partial(seq_softmax_ce, pad_id=0))
+
+        def flat(net):
+            return np.concatenate([np.asarray(l).ravel()
+                                   for l in jax.tree.leaves(net.params)])
+
+        losses, dnorms, prev = [], [], flat(api.net)
+        for r in range(rounds):
+            losses.append(api.train_one_round(r)["train_loss"])
+            cur = flat(api.net)
+            dnorms.append(float(np.linalg.norm(cur - prev)))
+            prev = cur
+        return np.asarray(losses), np.asarray(dnorms)
+
+    loss0, drift0 = run(0.0)
+    loss1, drift1 = run(0.1)
+    assert np.isfinite(loss0).all() and np.isfinite(loss1).all()
+    # μ controls drift: 0.78 measured ratio, asserted with margin.
+    d0, d1 = drift0[2:].mean(), drift1[2:].mean()
+    assert d1 < 0.90 * d0, (d0, d1)
+    # Both arms DESCEND in this regime (lr=1.0 LSTM, boosted
+    # heterogeneity): from ~3.5 first-round CE toward the chain floor.
+    assert loss0[0] > 3.2 and loss1[0] > 3.2, (loss0[0], loss1[0])
+    assert np.mean(loss0[-3:]) < 3.0, loss0[-3:]
+    assert np.mean(loss1[-3:]) < 3.0, loss1[-3:]
+    # μ's regularization cost is bounded — no divergence either way.
+    assert np.mean(loss1[-3:]) < np.mean(loss0[-3:]) + 0.5
+
+
+@pytest.mark.slow
+def test_fedopt_server_adam_beats_fedavg_at_reference_scale():
+    """FedOpt pinned beyond 2-round sanity (r4 VERDICT #3b): the
+    FEMNIST-CNN task shape (62-class CNNDropOut, batch 20, 10/round,
+    200 power-law clients on the streaming store) in the regime
+    "Adaptive Federated Optimization" (Reddi'20) targets — client steps
+    too small to make progress on their own (SGD lr 0.003) — where the
+    server optimizer (--server_optimizer adam --server_lr, eps 1e-3
+    per the paper; reference flags fedopt/main_fedopt.py:54-60)
+    re-scales the aggregate pseudo-gradient per-coordinate and learns
+    anyway.
+
+    Calibrated on v5e (2026-07-31, scripts/calibrate_prox_opt_pins.py
+    `opt 0.003 1.0 30 0.05 22 20`): plain FedAvg stays near chance
+    through 30 rounds (loss 4.08-4.15 ~ ln 62, acc 0.058) while
+    FedOpt-Adam descends (loss 4.12 @ 10 → 3.78 @ 30, acc 0.33).
+    Client sizes capped at one batch-20 step so the cohort step bucket
+    stays 1 — at bucket 4 a round costs ~80 s on the 1-core suite box
+    (r4 VERDICT #6) and the pin would not fit any budget. Negative
+    results recorded in the calibration script: at the flag-default
+    server_lr 0.1, server-Adam does NOT descend at any client lr
+    tried; the pin runs the tuned point, like the paper."""
+    from fedml_tpu.algos.fedopt import FedOptAPI
+    from fedml_tpu.data.batching import batch_global
+    from fedml_tpu.data.synthetic import make_femnist_shaped
+    from fedml_tpu.models.cnn import CNNDropOut
+
+    C, K, batch, rounds = 200, 62, 20, 30
+    # Same generator + parameters as the calibration sweep — the
+    # thresholds below are only valid for make_femnist_shaped's output.
+    xtr, ytr, parts, xte, yte = make_femnist_shaped(
+        n_clients=C, alpha=1.0, maxper=20)
+
+    def run(server):
+        store = FederatedStore(xtr, ytr, parts, batch_size=batch)
+        test = batch_global(xte, yte, 100)
+        cfg = FedConfig(client_num_in_total=C, client_num_per_round=10,
+                        comm_round=rounds, epochs=1, batch_size=batch,
+                        lr=0.003, server_optimizer=server or "sgd",
+                        server_lr=0.05, frequency_of_the_test=10_000)
+        cls = FedOptAPI if server else FedAvgAPI
+        api = cls(CNNDropOut(num_classes=K), store, test, cfg)
+        losses = [api.train_one_round(r)["train_loss"]
+                  for r in range(rounds)]
+        return np.asarray(losses), api.evaluate()["accuracy"]
+
+    loss_avg, acc_avg = run(None)
+    loss_adam, acc_adam = run("adam")
+    assert np.isfinite(loss_avg).all() and np.isfinite(loss_adam).all()
+    # FedAvg at client lr 0.003: near chance after 30 rounds (measured
+    # acc 0.058; chance = 1/62 ≈ 0.016) and essentially flat.
+    assert acc_avg < 0.10, acc_avg
+    assert abs(loss_avg[-3:].mean() - loss_avg[9]) < 0.15, loss_avg
+    # Server-Adam: same client updates, decisively better model
+    # (measured acc 0.33, loss 4.12 → 3.78 and falling).
+    assert acc_adam > 0.15, acc_adam
+    assert loss_adam[-3:].mean() < loss_adam[9] - 0.15, loss_adam
+    assert acc_adam > 2.5 * acc_avg, (acc_avg, acc_adam)
+
+
+@pytest.mark.slow
 def test_charlm_shaped_descent_60_rounds():
     """The Shakespeare row's optimizer regime: 2-layer LSTM char-LM, 715
     clients, 10/round, batch 4, SGD **lr 1.0** — the high-lr recurrent
