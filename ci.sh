@@ -6,16 +6,18 @@
 #
 # Suite cost structure (r5, per r4 VERDICT #6 — measured on a 1-core box
 # with the 8-virtual-device CPU mesh; multiply down by your core count):
-#   fast lane   python -m pytest tests/ -m "not slow" -x -q   ~35-40 min
-#               (1-core; the lane is compile-dominated — a multi-core box
-#               runs it in well under 15 min)
+#   fast lane   python -m pytest tests/ -m "not slow" -x -q   ~30 min
+#               (measured 35:19 for 339 tests before the last two >2 min
+#               tests were slow-marked; 1-core and compile-dominated — a
+#               multi-core box runs it in well under 15 min)
 #   slow lane   python -m pytest tests/ -m slow -q            ~2.5-3 h
 #               (reference-round-count convergence pins: MNIST-LR 120r,
-#               FEMNIST-CNN 3400c/60r, char-LM 40r, FedProx drift 2x12r,
-#               FedOpt A/B 2x30r; the 32-device dryrun; comm soak tests)
+#               FEMNIST-CNN 3400c/60r, char-LM 40r, FedProx drift 2x12r
+#               6.8 min, FedOpt A/B 2x30r 18.6 min; the 32-device dryrun
+#               110 s; FedNAS 2nd-order 210 s; comm soak tests)
 #   this script                                               ~10 min
-# Every test >2 min on that box is slow-marked; the fast lane contains
-# no reference-scale loops.
+# Every test >2 min on that box is slow-marked (r5 fast-lane audit,
+# --durations=25); the fast lane contains no reference-scale loops.
 set -euo pipefail
 
 export PALLAS_AXON_POOL_IPS=

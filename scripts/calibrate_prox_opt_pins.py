@@ -14,7 +14,9 @@ The shipped pins were calibrated with:
 
 Prints per-arm loss curves AND the pin observables so the thresholds in
 tests/test_repro_convergence.py are measured numbers, not hopes — the
-same method the r4 pins used.
+same method the r4 pins used. The run harness itself is SHARED with
+the pins (tests/pin_harness.py), so sweep and suite cannot silently
+diverge.
 
 FedProx arm: the Shakespeare char-LM regime (2-layer LSTM, batch 4, SGD
 lr 1.0 — BASELINE.md row hyperparameters) with heterogeneity BOOSTED:
@@ -42,85 +44,15 @@ descend at any client lr tried (0.003/0.0316/0.1); at client lr 0.1
 plain FedAvg learns and needs no server optimizer.
 """
 
+import os
 import sys
 import time
-from functools import partial
 
 import numpy as np
 
-
-def charlm_hetero_fed(C=256, batch=4, kgroup=8, seqs_per_client=8,
-                      peak=0.95, seed=0):
-    from fedml_tpu.data.batching import build_federated_arrays
-    from fedml_tpu.data.synthetic import make_hetero_charlm
-
-    x, y, parts = make_hetero_charlm(
-        n_clients=C, kgroup=kgroup, seqs_per_client=seqs_per_client,
-        peak=peak, seed=seed)
-    return build_federated_arrays(x, y, parts, batch)
-
-
-def run_prox(mu, rounds=40, epochs=2, C=256, kgroup=8, peak=0.95, cpr=10,
-             per=8):
-    import jax
-
-    from fedml_tpu.algos.config import FedConfig
-    from fedml_tpu.algos.fedprox import FedProxAPI
-    from fedml_tpu.models.rnn import RNNOriginalFedAvg
-    from fedml_tpu.trainer.local import seq_softmax_ce
-
-    fed = charlm_hetero_fed(C=C, kgroup=kgroup, peak=peak,
-                            seqs_per_client=per)
-    cfg = FedConfig(client_num_in_total=C, client_num_per_round=cpr,
-                    comm_round=rounds, epochs=epochs, batch_size=4, lr=1.0,
-                    fedprox_mu=mu, frequency_of_the_test=10_000)
-    api = FedProxAPI(RNNOriginalFedAvg(vocab_size=90), fed, None, cfg,
-                     loss_fn=partial(seq_softmax_ce, pad_id=0))
-
-    def flat(net):
-        return np.concatenate([np.asarray(l).ravel()
-                               for l in jax.tree.leaves(net.params)])
-
-    losses, dnorms, prev = [], [], flat(api.net)
-    for r in range(rounds):
-        losses.append(api.train_one_round(r)["train_loss"])
-        cur = flat(api.net)
-        # ||w_{t+1} - w_t|| = ||avg_c(w_c - w_t)||: the global update
-        # norm IS the cohort-average client drift — the quantity mu
-        # penalizes, measured from outside the API.
-        dnorms.append(float(np.linalg.norm(cur - prev)))
-        prev = cur
-    return np.asarray(losses), np.asarray(dnorms)
-
-
-def femnist_shaped(C=200, batch=20, alpha=0.4, per=22, seed=0,
-                   maxper=None):
-    from fedml_tpu.data.batching import batch_global
-    from fedml_tpu.data.store import FederatedStore
-    from fedml_tpu.data.synthetic import make_femnist_shaped
-
-    x, y, parts, xt, yt = make_femnist_shaped(
-        n_clients=C, alpha=alpha, per=per, maxper=maxper, seed=seed)
-    store = FederatedStore(x, y, parts, batch_size=batch)
-    return store, batch_global(xt, yt, 100)
-
-
-def run_opt(server, rounds=40, lr=0.03, server_lr=0.1, alpha=0.4, per=22,
-            maxper=None):
-    from fedml_tpu.algos.config import FedConfig
-    from fedml_tpu.algos.fedavg import FedAvgAPI
-    from fedml_tpu.algos.fedopt import FedOptAPI
-    from fedml_tpu.models.cnn import CNNDropOut
-
-    store, test = femnist_shaped(alpha=alpha, per=per, maxper=maxper)
-    cfg = FedConfig(client_num_in_total=200, client_num_per_round=10,
-                    comm_round=rounds, epochs=1, batch_size=20, lr=lr,
-                    server_optimizer=server, server_lr=server_lr,
-                    frequency_of_the_test=10_000)
-    cls = FedAvgAPI if server == "none" else FedOptAPI
-    api = cls(CNNDropOut(num_classes=62), store, test, cfg)
-    losses = [api.train_one_round(r)["train_loss"] for r in range(rounds)]
-    return np.asarray(losses), api.evaluate()["accuracy"]
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests"))
+from pin_harness import run_opt, run_prox  # noqa: E402  (shared harness)
 
 
 def fmt(a):

@@ -1,0 +1,81 @@
+"""Shared run harness for the FedProx / FedOpt reference-scale pins.
+
+Single source for BOTH the suite pins (tests/test_repro_convergence.py)
+and the calibration sweeps (scripts/calibrate_prox_opt_pins.py): the
+thresholds asserted in the pins were measured by running EXACTLY these
+functions, so any change here re-calibrates or invalidates both sides
+together instead of silently decoupling them (r5 review finding). The
+data builders live in fedml_tpu.data.synthetic for the same reason.
+"""
+
+import numpy as np
+
+
+def run_prox(mu, rounds=40, epochs=2, C=256, kgroup=8, peak=0.95, cpr=10,
+             per=8):
+    """FedProx on the heterogeneity-boosted char-LM federation.
+
+    Returns ``(losses, dnorms)`` — per-round train CE and global update
+    norms. ``||w_{t+1} - w_t|| = ||avg_c(w_c - w_t)||``: the global
+    update norm IS the cohort-average client drift, the exact quantity
+    μ penalizes, measured from outside the API.
+    """
+    from functools import partial
+
+    import jax
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedprox import FedProxAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.synthetic import make_hetero_charlm
+    from fedml_tpu.models.rnn import RNNOriginalFedAvg
+    from fedml_tpu.trainer.local import seq_softmax_ce
+
+    x, y, parts = make_hetero_charlm(
+        n_clients=C, kgroup=kgroup, seqs_per_client=per, peak=peak)
+    fed = build_federated_arrays(x, y, parts, 4)
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=cpr,
+                    comm_round=rounds, epochs=epochs, batch_size=4, lr=1.0,
+                    fedprox_mu=mu, frequency_of_the_test=10_000)
+    api = FedProxAPI(RNNOriginalFedAvg(vocab_size=90), fed, None, cfg,
+                     loss_fn=partial(seq_softmax_ce, pad_id=0))
+
+    def flat(net):
+        return np.concatenate([np.asarray(l).ravel()
+                               for l in jax.tree.leaves(net.params)])
+
+    losses, dnorms, prev = [], [], flat(api.net)
+    for r in range(rounds):
+        losses.append(api.train_one_round(r)["train_loss"])
+        cur = flat(api.net)
+        dnorms.append(float(np.linalg.norm(cur - prev)))
+        prev = cur
+    return np.asarray(losses), np.asarray(dnorms)
+
+
+def run_opt(server, rounds=40, lr=0.03, server_lr=0.1, alpha=0.4, per=22,
+            maxper=None):
+    """FedAvg (``server=None``/``"none"``) vs FedOpt (server optimizer
+    name) on the FEMNIST-shaped federation. Returns ``(losses, acc)``.
+    """
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.algos.fedopt import FedOptAPI
+    from fedml_tpu.data.batching import batch_global
+    from fedml_tpu.data.store import FederatedStore
+    from fedml_tpu.data.synthetic import make_femnist_shaped
+    from fedml_tpu.models.cnn import CNNDropOut
+
+    x, y, parts, xt, yt = make_femnist_shaped(
+        n_clients=200, alpha=alpha, per=per, maxper=maxper)
+    store = FederatedStore(x, y, parts, batch_size=20)
+    test = batch_global(xt, yt, 100)
+    fedavg = server in (None, "none")
+    cfg = FedConfig(client_num_in_total=200, client_num_per_round=10,
+                    comm_round=rounds, epochs=1, batch_size=20, lr=lr,
+                    server_optimizer="sgd" if fedavg else server,
+                    server_lr=server_lr, frequency_of_the_test=10_000)
+    cls = FedAvgAPI if fedavg else FedOptAPI
+    api = cls(CNNDropOut(num_classes=62), store, test, cfg)
+    losses = [api.train_one_round(r)["train_loss"] for r in range(rounds)]
+    return np.asarray(losses), api.evaluate()["accuracy"]

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.fedavg import FedAvgAPI
@@ -164,6 +165,7 @@ def test_fednas_search_moves_alphas_and_weights():
     assert 0.0 <= acc <= 1.0
 
 
+@pytest.mark.slow  # 210 s on a 1-core box (r5 fast-lane audit)
 def test_fednas_unrolled_second_order_runs():
     rng = np.random.RandomState(0)
     n = 64

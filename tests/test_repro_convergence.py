@@ -132,49 +132,21 @@ def test_fedprox_controls_drift_at_reference_scale():
     update norm IS the cohort-average client drift — exactly what μ
     penalizes. Calibrated on v5e (2026-07-31,
     scripts/calibrate_prox_opt_pins.py `prox 6 0.98 16 10 12 4`):
-    mean drift over rounds 2..12 = 1.10 (μ=0) / 1.09 (μ=0.01, monotone)
-    / 0.855 (μ=0.1), a 0.78 ratio; last-3 CE 2.61 vs 2.72 (μ's bounded
-    regularization cost); both descend from ~3.5 first-round CE. At
-    2x the local work (per=8 seqs, 24 rounds) the same ordering holds
-    with a fatter 0.68 ratio — this trimmed config is sized for the
-    1-core suite box (r4 VERDICT #6: ~30 s/round there)."""
-    from functools import partial
+    mean drift over the last 10 of 12 rounds (``dnorms[2:]``) = 1.10
+    (μ=0) / 1.09 (μ=0.01, monotone) / 0.855 (μ=0.1), a 0.78 ratio;
+    last-3 CE 2.61 vs 2.72 (μ's bounded regularization cost); both
+    descend from ~3.5 first-round CE. At 2x the local work (per=8
+    seqs, 24 rounds) the same ordering holds with a fatter 0.68 ratio
+    — this trimmed config is sized for the 1-core suite box (r4
+    VERDICT #6: ~30 s/round there). Runs EXACTLY the calibration
+    sweep's harness (tests/pin_harness.py, shared with the script) so
+    the thresholds cannot silently decouple from their measurement."""
+    from pin_harness import run_prox
 
-    import jax
-
-    from fedml_tpu.algos.fedprox import FedProxAPI
-    from fedml_tpu.data.batching import build_federated_arrays
-    from fedml_tpu.data.synthetic import make_hetero_charlm
-    from fedml_tpu.models.rnn import RNNOriginalFedAvg
-    from fedml_tpu.trainer.local import seq_softmax_ce
-
-    C, V, rounds = 256, 90, 12
-    # Same generator + defaults as the calibration sweep — the
-    # thresholds below are only valid for make_hetero_charlm's output.
-    x, y, parts = make_hetero_charlm(n_clients=C)
-
-    def run(mu):
-        fed = build_federated_arrays(x, y, parts, 4)
-        cfg = FedConfig(client_num_in_total=C, client_num_per_round=10,
-                        comm_round=rounds, epochs=6, batch_size=4, lr=1.0,
-                        fedprox_mu=mu, frequency_of_the_test=10_000)
-        api = FedProxAPI(RNNOriginalFedAvg(vocab_size=V), fed, None, cfg,
-                         loss_fn=partial(seq_softmax_ce, pad_id=0))
-
-        def flat(net):
-            return np.concatenate([np.asarray(l).ravel()
-                                   for l in jax.tree.leaves(net.params)])
-
-        losses, dnorms, prev = [], [], flat(api.net)
-        for r in range(rounds):
-            losses.append(api.train_one_round(r)["train_loss"])
-            cur = flat(api.net)
-            dnorms.append(float(np.linalg.norm(cur - prev)))
-            prev = cur
-        return np.asarray(losses), np.asarray(dnorms)
-
-    loss0, drift0 = run(0.0)
-    loss1, drift1 = run(0.1)
+    loss0, drift0 = run_prox(0.0, epochs=6, peak=0.98, kgroup=16,
+                             cpr=10, rounds=12, per=4)
+    loss1, drift1 = run_prox(0.1, epochs=6, peak=0.98, kgroup=16,
+                             cpr=10, rounds=12, per=4)
     assert np.isfinite(loss0).all() and np.isfinite(loss1).all()
     # μ controls drift: 0.78 measured ratio, asserted with margin.
     d0, d1 = drift0[2:].mean(), drift1[2:].mean()
@@ -209,33 +181,15 @@ def test_fedopt_server_adam_beats_fedavg_at_reference_scale():
     (r4 VERDICT #6) and the pin would not fit any budget. Negative
     results recorded in the calibration script: at the flag-default
     server_lr 0.1, server-Adam does NOT descend at any client lr
-    tried; the pin runs the tuned point, like the paper."""
-    from fedml_tpu.algos.fedopt import FedOptAPI
-    from fedml_tpu.data.batching import batch_global
-    from fedml_tpu.data.synthetic import make_femnist_shaped
-    from fedml_tpu.models.cnn import CNNDropOut
+    tried; the pin runs the tuned point, like the paper. Runs EXACTLY
+    the calibration sweep's harness (tests/pin_harness.py, shared with
+    the script) so the thresholds cannot silently decouple from their
+    measurement."""
+    from pin_harness import run_opt
 
-    C, K, batch, rounds = 200, 62, 20, 30
-    # Same generator + parameters as the calibration sweep — the
-    # thresholds below are only valid for make_femnist_shaped's output.
-    xtr, ytr, parts, xte, yte = make_femnist_shaped(
-        n_clients=C, alpha=1.0, maxper=20)
-
-    def run(server):
-        store = FederatedStore(xtr, ytr, parts, batch_size=batch)
-        test = batch_global(xte, yte, 100)
-        cfg = FedConfig(client_num_in_total=C, client_num_per_round=10,
-                        comm_round=rounds, epochs=1, batch_size=batch,
-                        lr=0.003, server_optimizer=server or "sgd",
-                        server_lr=0.05, frequency_of_the_test=10_000)
-        cls = FedOptAPI if server else FedAvgAPI
-        api = cls(CNNDropOut(num_classes=K), store, test, cfg)
-        losses = [api.train_one_round(r)["train_loss"]
-                  for r in range(rounds)]
-        return np.asarray(losses), api.evaluate()["accuracy"]
-
-    loss_avg, acc_avg = run(None)
-    loss_adam, acc_adam = run("adam")
+    kw = dict(rounds=30, lr=0.003, server_lr=0.05, alpha=1.0, maxper=20)
+    loss_avg, acc_avg = run_opt("none", **kw)
+    loss_adam, acc_adam = run_opt("adam", **kw)
     assert np.isfinite(loss_avg).all() and np.isfinite(loss_adam).all()
     # FedAvg at client lr 0.003: near chance after 30 rounds (measured
     # acc 0.058; chance = 1/62 ≈ 0.016) and essentially flat.

@@ -6,6 +6,7 @@ test_store.py's 50k-client representability test."""
 
 import jax
 import numpy as np
+import pytest
 
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.fedavg import FedAvgAPI
@@ -29,6 +30,7 @@ def _writer_shaped_femnist(n_clients=3400, seed=0):
     return x, y, parts
 
 
+@pytest.mark.slow  # 127 s on a 1-core box (r5 fast-lane audit)
 def test_femnist_3400_clients_trains():
     """The BASELINE.md FEMNIST config at its true client count: 3400
     writers, 10 sampled per round, batch 20, the Reddi'20 CNN."""
