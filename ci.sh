@@ -10,11 +10,12 @@
 #               (measured 35:19 for 339 tests before the last two >2 min
 #               tests were slow-marked; 1-core and compile-dominated — a
 #               multi-core box runs it in well under 15 min)
-#   slow lane   python -m pytest tests/ -m slow -q            ~2.5-3 h
-#               (reference-round-count convergence pins: MNIST-LR 120r,
-#               FEMNIST-CNN 3400c/60r, char-LM 40r, FedProx drift 2x12r
-#               6.8 min, FedOpt A/B 2x30r 18.6 min; the 32-device dryrun
-#               110 s; FedNAS 2nd-order 210 s; comm soak tests)
+#   slow lane   python -m pytest tests/ -m slow -q            ~2.5 h
+#               (measured per-test on the 1-core box: FEMNIST-CNN
+#               3400c/60r convergence 71.5 min — the single long pole —
+#               FedOpt A/B 2x30r 18.6 min, FedProx drift 2x12r 6.8 min,
+#               char-LM 40r 4.2 min, FedNAS 2nd-order 189 s, 32-device
+#               dryrun 88 s, MNIST-LR 120r 14 s, comm soak tests <4 s)
 #   this script                                               ~10 min
 # Every test >2 min on that box is slow-marked (r5 fast-lane audit,
 # --durations=25); the fast lane contains no reference-scale loops.
