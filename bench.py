@@ -24,7 +24,9 @@ Accounting:
   deflating them 3-4x (r3 VERDICT #1);
 - secondary configs as sub-metrics in the SAME JSON object: the
   3400-client FEMNIST-CNN federation (BASELINE.md north-star scale, on
-  the host-resident FederatedStore), a ViT federation, the primary
+  the host-resident FederatedStore), the store_windowed A/B (windowed
+  superbatch execution vs the synced per-round loop on that same
+  config), a ViT federation, the primary
   config at the per-client-batch-128 tiling sweet spot, the shard_map
   round on a 1-device mesh (the multi-chip code path's single-chip
   throughput), the pallas flash-attention vs dense T-sweep (crossover +
@@ -311,18 +313,27 @@ def _timed_store_windows(api, store, windows=5, window=10,
         return time.perf_counter() - t0, samples
 
     # Calibrate: grow the window until a single window carries
-    # min_window_s of wall work (measured, not assumed — and asserted
-    # below, like every other floor in this file).
+    # min_window_s of wall work, then VERIFY on a second window before
+    # accepting (r5 ADVICE: the old loop could exit on an unprobed
+    # growth, or on a first crossing inflated by one-time warmup — a
+    # compile tail or allocator growth — leaving the steady-state
+    # windows under the floor the timed runs are asserted against).
     r = 1
-    for _ in range(4):
+    for _ in range(5):
         dt, _ = run_window(r, window)
         r += window
         if dt >= min_window_s:
-            break
+            dt2, _ = run_window(r, window)
+            r += window
+            if dt2 >= window_floor_s:
+                break
+            dt = dt2  # steady-state is faster than the first crossing
         window = max(window + 5,
                      int(np.ceil(window * min_window_s * 1.2 / dt)))
-    assert dt >= window_floor_s, (
-        f"calibration window {dt:.2f}s below the {window_floor_s:.1f}s floor")
+    else:
+        raise AssertionError(
+            f"window calibration could not reach the {min_window_s:.1f}s "
+            f"target (last window {window} rounds, {dt:.2f}s)")
 
     rps_w, sps_w, window_s = [], [], []
     for _ in range(windows):
@@ -331,7 +342,11 @@ def _timed_store_windows(api, store, windows=5, window=10,
         sps_w.append(samples / dt)
         window_s.append(dt)
         r += window
-    assert statistics.median(window_s) >= window_floor_s, window_s
+    # EVERY timed window must clear the floor, not just the median — with
+    # median-only, 2 of 5 windows could sit inside the RTT noise band
+    # unflagged (r5 ADVICE; the committed r5 femnist median was 5.99s vs
+    # a 6.0s target, so the margin is real).
+    assert min(window_s) >= window_floor_s, window_s
     rps_med, rps_iqr = _med_iqr(rps_w)
     out = {"loop": "pipelined" if attached else "synced",
            "rounds_per_sec": round(rps_med, 3),
@@ -346,13 +361,22 @@ def _timed_store_windows(api, store, windows=5, window=10,
     return out
 
 
-def bench_femnist_cnn_3400():
-    """BASELINE.md shallow-NN row at its TRUE client count: 3400 writers,
-    10/round, batch 20, Reddi'20 CNN — host-resident FederatedStore
-    streaming each round's cohort (the configuration VERDICT r1 flagged as
-    never actually executed)."""
-    import jax
+# Shared between the femnist submetric and the store_windowed A/B (they
+# run back-to-back over the SAME federation): one store/api build + bucket
+# warmup + synced measurement instead of two — duplicated minutes here are
+# exactly what would push later sections past the wall-clock budget.
+_femnist_state = {}
 
+
+def _femnist_3400_setup():
+    """The FEMNIST-3400 streaming configuration (BASELINE.md shallow-NN
+    row at its TRUE client count: 3400 writers, 10/round, batch 20,
+    Reddi'20 CNN, power-law-ish counts) — built once, cached in
+    ``_femnist_state`` for the store_windowed section."""
+    if "api" in _femnist_state:
+        return (_femnist_state["api"], _femnist_state["store"],
+                _femnist_state["counts"], _femnist_state["cpr"],
+                _femnist_state["batch"])
     from fedml_tpu.algos.config import FedConfig
     from fedml_tpu.algos.fedavg import FedAvgAPI
     from fedml_tpu.data.store import FederatedStore
@@ -375,9 +399,110 @@ def bench_femnist_cnn_3400():
                     comm_round=100_000, epochs=1, batch_size=batch, lr=0.1)
     api = FedAvgAPI(CNNDropOut(num_classes=62), store, None, cfg)
     _warm_store_buckets(api, store, counts, cpr, batch)
+    _femnist_state.update(api=api, store=store, counts=counts, cpr=cpr,
+                          batch=batch)
+    return api, store, counts, cpr, batch
+
+
+def bench_femnist_cnn_3400():
+    """FEMNIST-3400 streaming throughput (the configuration VERDICT r1
+    flagged as never actually executed), synced per-round loop."""
+    api, store, counts, cpr, batch = _femnist_3400_setup()
     timed = _timed_store_windows(api, store, count_samples=True)
-    return {"clients": n_clients, **timed,
+    _femnist_state["synced"] = timed  # store_windowed's A/B denominator
+    return {"clients": 3400, **timed,
             "host_dataset_mb": round(store.nbytes() / 1e6, 1)}
+
+
+def _timed_windowed_blocks(api, window, blocks=3, min_block_s=4.0,
+                           start_round=1):
+    """Median rounds/sec over ``blocks`` timed blocks of
+    ``train_rounds_windowed`` calls, block length floor-calibrated like
+    every other timed section (the block's trailing loss fetch is the
+    windowed tier's natural sync cadence, so it belongs on the clock)."""
+    floor_s = min_block_s * 2.0 / 3.0
+    rounds, r = 4 * window, start_round
+
+    def run_block(r, rounds):
+        t0 = time.perf_counter()
+        losses = api.train_rounds_windowed(rounds, start_round=r,
+                                           window=window)
+        dt = time.perf_counter() - t0
+        assert np.isfinite(losses).all()
+        return dt
+
+    # Same grow-then-verify calibration discipline as
+    # _timed_store_windows: the first crossing can ride one-time warmup
+    # (the window-scan compile lands in the first probe).
+    for _ in range(5):
+        dt = run_block(r, rounds)
+        r += rounds
+        if dt >= min_block_s:
+            dt2 = run_block(r, rounds)
+            r += rounds
+            if dt2 >= floor_s:
+                break
+            dt = dt2
+        # Grow to a MULTIPLE of window: a remainder would run per-round
+        # through the host loop inside every timed block, silently
+        # diluting the windowed throughput this section exists to report.
+        rounds = max(rounds + window,
+                     int(np.ceil(rounds * min_block_s * 1.2 / dt)))
+        rounds = -(-rounds // window) * window
+    else:
+        raise AssertionError(
+            f"block calibration could not reach the {min_block_s:.1f}s "
+            f"target (last block {rounds} rounds, {dt:.2f}s)")
+
+    rps, block_s = [], []
+    for _ in range(blocks):
+        dt = run_block(r, rounds)
+        rps.append(rounds / dt)
+        block_s.append(dt)
+        r += rounds
+    assert min(block_s) >= floor_s, block_s
+    med, iqr = _med_iqr(rps)
+    # Block lengths are window multiples, so every timed round rides a
+    # scan by construction (api._window_stats would report coverage 1.0
+    # tautologically — not a measurement, so not a metric).
+    return {"rounds_per_sec": round(med, 3), "rounds_per_sec_iqr": iqr,
+            "block_rounds": rounds, "blocks": blocks}
+
+
+def bench_store_windowed():
+    """Windowed vs synced streaming A/B on the FEMNIST-3400 config — the
+    windowed execution tier's headline evidence. Synced: per-round host
+    loop (one dispatch + one loss sync per round, prefetcher overlapping
+    the next gather). Windowed: ``train_rounds_windowed`` — the next W
+    same-bucket rounds' cohorts gathered as ONE superbatch, one H2D
+    transfer, one lax.scan dispatch, host syncs amortized 1/W. Both sides
+    measure the SAME store/model/config — the api/store build, bucket
+    warmup, and the synced measurement are REUSED from the femnist
+    section when it ran (one federation, one baseline; duplicating them
+    is what would push later sections past the wall-clock budget). The
+    timed blocks are window multiples, so every timed round rides a
+    scan."""
+    try:
+        api, store, counts, cpr, batch = _femnist_3400_setup()
+        window = 16
+        synced = _femnist_state.get("synced")
+        if synced is None:  # femnist section skipped/errored: own baseline
+            synced = _timed_store_windows(api, store, windows=3,
+                                          min_window_s=4.0)
+        windowed = _timed_windowed_blocks(api, window, blocks=3,
+                                          min_block_s=4.0)
+        return {"clients": 3400, "window": window,
+                "synced_rounds_per_sec": synced["rounds_per_sec"],
+                "synced_rounds_per_sec_iqr": synced["rounds_per_sec_iqr"],
+                "windowed_rounds_per_sec": windowed["rounds_per_sec"],
+                "windowed_rounds_per_sec_iqr":
+                    windowed["rounds_per_sec_iqr"],
+                "block_rounds": windowed["block_rounds"],
+                "speedup": round(windowed["rounds_per_sec"]
+                                 / synced["rounds_per_sec"], 3)}
+    finally:
+        # Free the GB-scale host store before the later sections run.
+        _femnist_state.clear()
 
 
 def bench_stackoverflow_342k():
@@ -767,11 +892,21 @@ def main():
     profile_dir = ("runs/bench_profile"
                    if (os.environ.get("BENCH_PROFILE") == "1" or attached)
                    else None)
+    # Wall-clock budget over the SECONDARY sections (r5 satellite: the
+    # r5 run hit the driver timeout inside transformer_flash_e2e — rc
+    # 124, parsed: null — and the headline line never printed). The check
+    # runs before each section starts, so the worst case is budget + one
+    # section (~350 s measured max) + the JSON dump, which must stay
+    # inside the driver's kill timer. Sections the budget skips are
+    # recorded as {"skipped": ...} in the blob — an explicit hole, not a
+    # silent one — and the headline ALWAYS lands as the final line.
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1350"))
     _t0 = time.perf_counter()
     primary = bench_cifar_resnet56(profile_dir=profile_dir)
     _log("primary done")
     sub = {}
     for name, fn in (("femnist_cnn_3400clients", bench_femnist_cnn_3400),
+                     ("store_windowed", bench_store_windowed),
                      ("stackoverflow_342k", bench_stackoverflow_342k),
                      ("vit_cifar_shaped", bench_vit),
                      ("resnet56_batch128_tuned", bench_resnet56_b128),
@@ -780,6 +915,12 @@ def main():
                      ("flash_attention_sweep", bench_flash_attention_sweep),
                      ("transformer_fed_mfu", bench_transformer_fed_mfu),
                      ("transformer_flash_e2e", bench_transformer_flash_e2e)):
+        elapsed = time.perf_counter() - _t0
+        if elapsed > budget_s:
+            sub[name] = {"skipped": (f"wall-clock budget {budget_s:.0f}s "
+                                     f"exhausted at +{elapsed:.0f}s")}
+            _log(f"{name} SKIPPED (budget)")
+            continue
         try:
             sub[name] = fn()
         except Exception as e:  # one broken submetric must not kill the line
@@ -815,20 +956,24 @@ def main():
         "submetrics": sub,
     }
     # Full blob → a file the repo keeps (round-over-round comparison
-    # material), plus stdout for anyone reading the whole log. Anchored
-    # to THIS file's directory, not the cwd, so the headline's "full"
-    # pointer is honest wherever bench.py is launched from.
+    # material), plus stdout for anyone reading the whole log. The local
+    # open() is anchored to THIS file's directory so it lands in the repo
+    # wherever bench.py is launched from, but the HEADLINE records the
+    # stable repo-relative pointer, not a machine-specific absolute path
+    # (r5 ADVICE: the final stdout line is an artifact other machines
+    # read).
+    blob_rel = "docs/bench_r5_local.json"
     blob_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "docs", "bench_r5_local.json")
+                             *blob_rel.split("/"))
     try:
         with open(blob_path, "w") as f:
             json.dump(out, f, indent=1)
     except OSError as e:
         print(f"[bench] could not write {blob_path}: {e}", file=sys.stderr)
-        blob_path = None
+        blob_rel = None
     print(json.dumps(out))
     sys.stdout.flush()
-    print(json.dumps(build_headline(out, full_path=blob_path)))
+    print(json.dumps(build_headline(out, full_path=blob_rel)))
 
 
 def build_headline(out, full_path="docs/bench_r5_local.json"):
@@ -861,6 +1006,9 @@ def build_headline(out, full_path="docs/bench_r5_local.json"):
         "sub": {
             "femnist_3400_rps": _scalar("femnist_cnn_3400clients",
                                         "rounds_per_sec"),
+            "store_windowed_rps": _scalar("store_windowed",
+                                          "windowed_rounds_per_sec"),
+            "store_windowed_speedup": _scalar("store_windowed", "speedup"),
             "stackoverflow_342k_rps": _scalar("stackoverflow_342k",
                                               "rounds_per_sec"),
             "vit_sps": _scalar("vit_cifar_shaped", "samples_per_sec"),

@@ -4,21 +4,22 @@
 # The pytest suite (python -m pytest tests/ -x -q) is the primary gate; this
 # script is the fast end-to-end sanity layer.
 #
-# Suite cost structure (r5, per r4 VERDICT #6 — measured on a 1-core box
-# with the 8-virtual-device CPU mesh; multiply down by your core count):
-#   fast lane   python -m pytest tests/ -m "not slow" -x -q   ~30 min
-#               (measured 35:19 for 339 tests before the last two >2 min
-#               tests were slow-marked; 1-core and compile-dominated — a
-#               multi-core box runs it in well under 15 min)
-#   slow lane   python -m pytest tests/ -m slow -q            ~2.5 h
-#               (measured per-test on the 1-core box: FEMNIST-CNN
-#               3400c/60r convergence 71.5 min — the single long pole —
-#               FedOpt A/B 2x30r 18.6 min, FedProx drift 2x12r 6.8 min,
-#               char-LM 40r 4.2 min, FedNAS 2nd-order 189 s, 32-device
-#               dryrun 88 s, MNIST-LR 120r 14 s, comm soak tests <4 s)
+# Suite cost structure (r6 re-audit on the 2-core box, where the tier-1
+# verify runs under a hard `timeout 870`; r5 numbers were from a 1-core
+# box):
+#   fast lane   python -m pytest tests/ -m "not slow" -x -q   ~12 min
+#               (must FIT the 870 s tier-1 budget with margin: every
+#               test >20 s on the 2-core box was slow-marked in r6 —
+#               --durations=40 audit — including the 342k-client store
+#               instantiation, remat/bf16/fedgkt/fednas exact-match
+#               runs, and the fedseg/fedgan/sequence CLI e2e tests)
+#   slow lane   python -m pytest tests/ -m slow -q            ~2.5-3 h
+#               (FEMNIST-CNN 3400c/60r convergence ~70 min is the long
+#               pole; plus everything moved down in the r6 audit)
 #   this script                                               ~10 min
-# Every test >2 min on that box is slow-marked (r5 fast-lane audit,
-# --durations=25); the fast lane contains no reference-scale loops.
+# The fast lane keeps full algorithmic coverage (every algorithm still
+# trains 2-4 tiny rounds there) and the windowed/streaming bit-equality
+# pins; reference-scale loops and >20 s exact-match runs live slow.
 set -euo pipefail
 
 export PALLAS_AXON_POOL_IPS=

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.algos.config import FedConfig
-from fedml_tpu.algos.loop import FederatedLoop
+from fedml_tpu.algos.loop import FederatedLoop, eval_segments
 from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
 from fedml_tpu.trainer.local import (
@@ -29,6 +29,32 @@ from fedml_tpu.trainer.local import (
     model_fns,
     softmax_ce,
 )
+
+
+def plan_window_spans(buckets, window: int):
+    """Split a run of rounds (given each round's cohort step bucket) into
+    execution spans ``(offset, length, steps-or-None)`` covering the
+    rounds in order: consecutive chunks of exactly ``window`` rounds
+    become scan spans whose shared step bucket is the chunk's MAX bucket
+    (every round's cohort fits; smaller rounds get extra masked pad —
+    exact training no-ops under the trainer's prefix-stable rng streams,
+    see ``trainer.local.make_epoch_shuffle``); the remainder (< window
+    rounds) falls to the per-round host loop (``steps=None``).
+
+    Fixing every scan's length at ``window`` and quantizing its step
+    shape to the chunk-max power-of-two bucket bounds compilation at one
+    scan executable per DISTINCT max bucket — a handful, like the
+    per-round path's shape buckets."""
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    spans, n = [], len(buckets)
+    lo = 0
+    while n - lo >= window:
+        spans.append((lo, window, max(buckets[lo:lo + window])))
+        lo += window
+    if lo < n:
+        spans.append((lo, n - lo, None))
+    return spans
 
 
 class FedAvgAPI(FederatedLoop):
@@ -125,6 +151,7 @@ class FedAvgAPI(FederatedLoop):
             return
         self._client_lr = lr
         self._rounds_scan_fn = None  # round_fn changes → cached scan stale
+        self._window_scan_fn = None  # windowed scan rides round_fn too
         self._on_client_lr_change()  # subclasses drop their own cached jits
         cfg, mesh = self.cfg, self.mesh
         optimizer = make_client_optimizer(
@@ -580,6 +607,156 @@ class FedAvgAPI(FederatedLoop):
             self.net = self._server_update(self.net, avg)
             losses.append(loss)
         return [float(l) for l in losses]
+
+    def _check_windowed_supported(self):
+        """Shared guard for the windowed streaming tier."""
+        if not self._streaming:
+            raise NotImplementedError(
+                "windowed execution streams window superbatches from a "
+                "FederatedStore; the resident layout already has the "
+                "stronger train_rounds_on_device scan")
+        if (type(self).train_one_round is not FedAvgAPI.train_one_round
+                or type(self).run_round is not FederatedLoop.run_round
+                or type(self)._server_update is not FedAvgAPI._server_update):
+            raise NotImplementedError(
+                f"{type(self).__name__} customizes the round or server "
+                "update; the windowed scan applies plain-FedAvg server "
+                "updates (net' = round average) between its rounds")
+        if self.cfg.client_selection != "random":
+            raise NotImplementedError(
+                "windowed execution gathers the next W rounds' cohorts in "
+                "advance, which only seeded-random selection permits; "
+                "pow_d/oort depend on the current net — use the per-round "
+                "host loop")
+
+    def _get_window_scan(self):
+        fn = self._window_scan_fn
+        if fn is None:
+            from fedml_tpu.parallel.shard import make_window_scan
+
+            # Donate the incoming net (always replaced by the scan's
+            # output) so XLA reuses the old params' buffers.
+            fn = jax.jit(make_window_scan(self.round_fn),
+                         donate_argnums=(0,))
+            self._window_scan_fn = fn
+        return fn
+
+    def train_rounds_windowed(self, n_rounds: int, start_round: int = 0,
+                              window: int = 8):
+        """Windowed streaming execution: run ``n_rounds`` store-backed
+        rounds with host syncs amortized over windows of ``window``
+        rounds. Seeded-random selection makes every upcoming cohort known
+        in advance, so each window's cohorts are gathered into ONE
+        ``[W, k, S, B, ...]`` superbatch (``FederatedStore.gather_window``
+        — single fancy-index gather + single H2D transfer, double-
+        buffered against the previous window's compute by
+        ``WindowPrefetcher``) and the W rounds run in one jitted
+        ``lax.scan`` dispatch — host round-trips drop from O(rounds) to
+        O(rounds/window).
+
+        BIT-EQUAL to the per-round host loop under the same seeds (tested,
+        including on a client mesh and with a window the round count
+        doesn't divide): each window forces its rounds onto the window's
+        MAX step bucket, which is an exact training no-op — pad slots all
+        hold the client's own (masked) first sample, all-masked tail
+        steps are ``tree_select``-gated out, and the trainer's rng
+        streams are prefix-stable in the step count
+        (``trainer.local.make_epoch_shuffle``) — and the per-round rng
+        chain (``jax.random.split`` per round, in round order) is
+        reproduced exactly. Remainder rounds (< window) run through the
+        ordinary host loop (``run_round``). Compilation stays bounded at
+        one scan executable per distinct window-max bucket.
+        ``self._window_stats`` records the split for introspection.
+
+        Returns the per-round losses as floats — ONE host sync at the
+        end, like :meth:`train_rounds_pipelined`. Eval-cadence-aware
+        splitting lives in :meth:`train_windowed`."""
+        from fedml_tpu.data.store import WindowPrefetcher
+
+        self._check_windowed_supported()
+        store = self.train_fed
+        counts = self._host_counts()
+
+        # Plan: every round's cohort (seeded → known now) and its bucket.
+        cohorts = [self.sample_round(start_round + t)
+                   for t in range(n_rounds)]
+        buckets = [store.cohort_steps(idx) for idx, _ in cohorts]
+        spans = plan_window_spans(buckets, window)
+        scan_spans = [s for s in spans if s[2] is not None]
+        self._window_stats = {
+            "windows": len(scan_spans),
+            "scanned_rounds": sum(s[1] for s in scan_spans),
+            "host_rounds": n_rounds - sum(s[1] for s in scan_spans),
+        }
+
+        put = None
+        if self.mesh is not None:
+            put = getattr(self, "_window_put", None)
+            if put is None:
+                from fedml_tpu.parallel.shard import window_put
+
+                put = self._window_put = window_put(
+                    self.mesh, self.mesh.axis_names[0])
+        pf = getattr(self, "_window_prefetcher", None)
+        if pf is None or pf.store is not store or pf.put is not put:
+            pf = self._window_prefetcher = WindowPrefetcher(store, put=put)
+
+        def span_args(span):
+            off, length, steps = span
+            idx2d = np.stack([cohorts[off + t][0] for t in range(length)])
+            return start_round + off, idx2d, steps
+
+        if scan_spans:  # overlap the first gather with nothing-yet: cheap
+            pf.prefetch(*span_args(scan_spans[0]))
+
+        losses = []
+        for off, length, steps in spans:
+            if steps is None:  # host-loop leftover rounds (run_round
+                for t in range(length):  # splits the rng chain itself)
+                    avg, loss = self.run_round(start_round + off + t)
+                    self.net = self._server_update(self.net, avg)
+                    losses.append(loss)
+                continue
+            key, idx2d, _ = span_args((off, length, steps))
+            batch = pf.get(key, idx2d, steps)
+            # Kick the NEXT window's gather + H2D before dispatching this
+            # window's scan, so it overlaps the scan's compute.
+            later = [s for s in scan_spans if s[0] > off]
+            if later:
+                pf.prefetch(*span_args(later[0]))
+            # Reproduce the host loop's per-round rng chain exactly.
+            keys = []
+            for _ in range(length):
+                self.rng, rnd = jax.random.split(self.rng)
+                keys.append(rnd)
+            wmask2d = np.stack([cohorts[off + t][1] for t in range(length)])
+            weights = counts[idx2d].astype(np.float32) * wmask2d
+            weights = put(weights) if put is not None \
+                else jnp.asarray(weights)
+            scan = self._get_window_scan()
+            self.net, span_losses = scan(self.net, batch.x, batch.y,
+                                         batch.mask, weights,
+                                         jnp.stack(keys))
+            losses.extend(list(span_losses))
+        return [float(l) for l in losses]
+
+    def train_windowed(self, window: int = 8):
+        """The full training loop (:meth:`FederatedLoop.train` semantics —
+        per-round history, eval every ``frequency_of_the_test`` rounds and
+        on the last round) on the windowed streaming tier: rounds between
+        eval points run through :meth:`train_rounds_windowed`, with window
+        splitting aware of the eval cadence (a scan never crosses a round
+        the host must stop at to evaluate)."""
+        self._check_windowed_supported()
+        history = []
+        for lo, hi in eval_segments(self.cfg.comm_round,
+                                    self.cfg.frequency_of_the_test):
+            seg = self.train_rounds_windowed(hi - lo + 1, start_round=lo,
+                                             window=window)
+            for i, loss in enumerate(seg):
+                history.append({"round": lo + i, "train_loss": loss})
+            history[-1].update(self.evaluate())
+        return history
 
     def train_rounds_on_device(self, n_rounds: int):
         """Run ``n_rounds`` WHOLE federated rounds in one jit: a

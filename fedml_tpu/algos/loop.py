@@ -14,6 +14,24 @@ import jax
 import jax.numpy as jnp
 
 
+def eval_segments(comm_round: int, frequency_of_the_test: int,
+                  start: int = 0):
+    """Split ``[start, comm_round)`` into inclusive ``(lo, hi)`` spans
+    each ending exactly at an eval round — the rounds
+    :meth:`FederatedLoop.train` evaluates after (``round_idx % freq == 0``
+    or the last round). Windowed execution plans its windows WITHIN these
+    spans (``FedAvgAPI.train_windowed``) so a multi-round scan never runs
+    past a point where the host must stop and evaluate."""
+    freq = max(int(frequency_of_the_test), 1)
+    r = start
+    while r < comm_round:
+        e = r
+        while not (e % freq == 0 or e == comm_round - 1):
+            e += 1
+        yield r, e
+        r = e + 1
+
+
 class FederatedLoop:
     """Mixin. Subclasses provide ``cfg``, ``train_one_round(round_idx)``,
     ``eval_fn``, ``test_global``, and ``_eval_net()``. Subclasses that also

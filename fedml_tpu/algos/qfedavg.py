@@ -35,7 +35,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from fedml_tpu.parallel.compat import shard_map
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.parallel.shard import client_rngs, run_clients_guarded

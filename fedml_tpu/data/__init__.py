@@ -4,7 +4,12 @@ from fedml_tpu.data.partition import (
     partition_power_law,
     record_data_stats,
 )
-from fedml_tpu.data.batching import FederatedArrays, build_federated_arrays, gather_clients
+from fedml_tpu.data.batching import (
+    FederatedArrays,
+    WindowBatch,
+    build_federated_arrays,
+    gather_clients,
+)
 
 __all__ = [
     "partition_dirichlet",
@@ -12,6 +17,7 @@ __all__ = [
     "partition_power_law",
     "record_data_stats",
     "FederatedArrays",
+    "WindowBatch",
     "build_federated_arrays",
     "gather_clients",
 ]

@@ -45,6 +45,34 @@ class FederatedArrays:
         return self.x.shape[2]
 
 
+@struct.dataclass
+class WindowBatch:
+    """W communication rounds' cohorts stacked on a leading round axis —
+    the superbatch the windowed execution tier ships in ONE H2D transfer
+    and consumes with one ``lax.scan`` dispatch (``data.store.
+    gather_window`` builds it; ``parallel.shard.make_window_scan`` runs
+    it). Round ``w``'s slice is exactly the ``FederatedArrays`` the
+    per-round host loop would have gathered for that round."""
+
+    x: jax.Array  # [W, C, S, B, ...]
+    y: jax.Array  # [W, C, S, B] (int labels) or [W, C, S, B, ...]
+    mask: jax.Array  # [W, C, S, B] float32
+    counts: jax.Array  # [W, C] int32 true sample counts
+
+    @property
+    def num_rounds(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def num_clients(self) -> int:
+        return self.x.shape[1]
+
+    def round_arrays(self, w: int) -> FederatedArrays:
+        """One round's cohort as a regular ``FederatedArrays``."""
+        return FederatedArrays(x=self.x[w], y=self.y[w],
+                               mask=self.mask[w], counts=self.counts[w])
+
+
 def build_federated_arrays(
     x: np.ndarray,
     y: np.ndarray,

@@ -22,7 +22,13 @@ sampled cohort per round:
   - ``CohortPrefetcher`` overlaps the next round's host gather + H2D
     transfer with the current round's compute (double buffering): JAX
     dispatch is async, so ``jnp.asarray`` from the worker thread starts
-    the copy immediately.
+    the copy immediately;
+  - ``gather_window`` stacks W precomputed cohorts into ONE
+    ``[W, k, S, B, ...]`` superbatch (a single fancy-index gather into
+    reused staging buffers + one H2D transfer per field) for the windowed
+    execution tier (``FedAvgAPI.train_rounds_windowed``), with
+    ``WindowPrefetcher`` double-buffering the next window's gather + H2D
+    against the current window's scan.
 """
 
 from __future__ import annotations
@@ -30,10 +36,11 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fedml_tpu.data.batching import FederatedArrays
+from fedml_tpu.data.batching import FederatedArrays, WindowBatch
 
 
 def _bucket_steps(steps: int) -> int:
@@ -76,6 +83,14 @@ class FederatedStore:
         self.batch_size = int(batch_size)
         self.max_steps = max_steps
         self.num_clients = n_clients
+        # Reused host staging buffers for window superbatches (one buffer
+        # per (field, shape) — windows of the same span length and bucket
+        # refill the same memory instead of re-faulting fresh pages every
+        # window). Guarded by a lock: gather_window publishes its device
+        # copies BEFORE releasing, so a concurrent gather can never
+        # overwrite a buffer an in-flight H2D transfer still reads.
+        self._staging: Dict[tuple, np.ndarray] = {}
+        self._staging_lock = threading.Lock()
 
     def example_input(self) -> np.ndarray:
         """One zero batch with the store's sample shape/dtype — what model
@@ -85,12 +100,54 @@ class FederatedStore:
     def nbytes(self) -> int:
         return self._x.nbytes + self._y.nbytes
 
+    def cohort_steps(self, indices) -> int:
+        """The power-of-two step bucket a cohort needs — the same number
+        ``gather_cohort`` computes internally, exposed so window planning
+        (``FedAvgAPI.train_rounds_windowed``) can group upcoming rounds by
+        bucket WITHOUT gathering them."""
+        ccounts = self.counts[np.asarray(indices)]
+        return _bucket_steps(
+            int(np.ceil(max(int(ccounts.max()), 1) / self.batch_size)))
+
+    def _resolve_steps(self, ccounts: np.ndarray, steps: Optional[int]):
+        """Validate/derive the step bucket for a gather over clients with
+        per-client counts ``ccounts`` (any shape)."""
+        bs = self.batch_size
+        need = _bucket_steps(int(np.ceil(max(int(ccounts.max()), 1) / bs)))
+        if steps is None:
+            return need
+        if steps < need:
+            raise ValueError(
+                f"forced steps {steps} < cohort need {need} "
+                f"(max client count {int(ccounts.max())}, batch {bs})")
+        return int(steps)
+
+    def _rowmap(self, idx: np.ndarray, cap: int):
+        """Precomputed row map for a fancy-index gather: for every cohort
+        slot and sample position, the row of the flat CSR arrays to copy.
+        Positions past a client's count repeat its FIRST row (the masked
+        own-first-sample pad rule of ``build_federated_arrays``). Returns
+        ``(rows [*idx.shape, cap] int64, empty [*idx.shape] bool)`` —
+        rows of ``empty`` (zero-count) clients point at row 0 and must be
+        zeroed after the gather (the loop reference leaves them zero)."""
+        lo = self.offsets[idx].astype(np.int64)
+        n = (self.offsets[idx + 1] - self.offsets[idx]).astype(np.int64)
+        pos = np.arange(cap, dtype=np.int64)
+        rows = lo[..., None] + np.where(pos < n[..., None], pos, 0)
+        empty = n == 0
+        if empty.any():
+            rows = np.where(empty[..., None], 0, rows)
+        return rows, empty
+
     def gather_cohort(self, indices,
                       steps: Optional[int] = None) -> FederatedArrays:
         """Materialize the sampled clients as a device-resident
         ``FederatedArrays`` padded to the COHORT max count (power-of-two
         step bucket). Duplicate indices are fine (pad_to_multiple repeats
-        index 0 with weight 0).
+        index 0 with weight 0). One vectorized fancy-index gather per
+        field (byte-identical to :meth:`_gather_cohort_loop`, the scalar
+        reference the tests pin it against — the per-client Python copy
+        loop cost O(k) interpreter trips per round at reference scale).
 
         ``steps`` forces the step bucket (must cover the cohort's own
         need): multi-host runs, where each host holds only its
@@ -101,15 +158,38 @@ class FederatedStore:
         idx = np.asarray(indices)
         k = len(idx)
         ccounts = self.counts[idx]
-        bs = self.batch_size
-        need = _bucket_steps(int(np.ceil(max(int(ccounts.max()), 1) / bs)))
-        if steps is None:
-            steps = need
-        elif steps < need:
-            raise ValueError(
-                f"forced steps {steps} < cohort need {need} "
-                f"(max client count {int(ccounts.max())}, batch {bs})")
-        cap = steps * bs
+        steps = self._resolve_steps(ccounts, steps)
+        cap = steps * self.batch_size
+
+        rows, empty = self._rowmap(idx, cap)
+        xs = self._x[rows]
+        ys = self._y[rows]
+        mask = (np.arange(cap) < ccounts[:, None]).astype(np.float32)
+        if empty.any():
+            xs[empty] = 0
+            ys[empty] = 0
+
+        def split(a):
+            return a.reshape((k, steps, self.batch_size) + a.shape[2:])
+
+        return FederatedArrays(
+            x=jnp.asarray(split(xs)),
+            y=jnp.asarray(split(ys)),
+            mask=jnp.asarray(split(mask)),
+            counts=jnp.asarray(ccounts, jnp.int32),
+        )
+
+    def _gather_cohort_loop(self, indices,
+                            steps: Optional[int] = None) -> FederatedArrays:
+        """The original per-client copy-loop gather, kept as the scalar
+        REFERENCE implementation: tests assert ``gather_cohort``'s
+        vectorized fancy-index path stays byte-identical to it. Not used
+        on any hot path."""
+        idx = np.asarray(indices)
+        k = len(idx)
+        ccounts = self.counts[idx]
+        steps = self._resolve_steps(ccounts, steps)
+        cap = steps * self.batch_size
 
         xs = np.zeros((k, cap) + self._x.shape[1:], self._x.dtype)
         ys = np.zeros((k, cap) + self._y.shape[1:], self._y.dtype)
@@ -127,7 +207,7 @@ class FederatedStore:
                 ys[j, n:] = self._y[lo]
 
         def split(a):
-            return a.reshape((k, steps, bs) + a.shape[2:])
+            return a.reshape((k, steps, self.batch_size) + a.shape[2:])
 
         return FederatedArrays(
             x=jnp.asarray(split(xs)),
@@ -135,6 +215,80 @@ class FederatedStore:
             mask=jnp.asarray(split(mask)),
             counts=jnp.asarray(ccounts, jnp.int32),
         )
+
+    def _staged(self, field: str, shape: tuple, dtype) -> np.ndarray:
+        """Reused staging buffer, one per (field, shape, dtype) — keyed
+        by the full shape so alternating window-max buckets (giant
+        client in/out of the window) each keep their own buffer instead
+        of thrashing a single slot with reallocations. Shape count is
+        bounded by the power-of-two bucket count. Caller must hold
+        ``_staging_lock``."""
+        key = (field, shape, np.dtype(dtype).str)
+        buf = self._staging.get(key)
+        if buf is None:
+            buf = np.empty(shape, dtype)
+            self._staging[key] = buf
+        return buf
+
+    def gather_window(self, window_indices, steps: int,
+                      put=None) -> WindowBatch:
+        """Gather W rounds' cohorts into ONE ``[W, k, S, B, ...]``
+        superbatch: a single fancy-index gather per field (precomputed row
+        maps, reused staging buffers) and a single H2D transfer per field,
+        instead of W per-round gather + transfer round-trips.
+
+        ``window_indices`` is the ``[W, k]`` array of per-round padded
+        cohort indices (known in advance under seeded-random selection).
+        ``steps`` is the window's SHARED step bucket and must cover every
+        round's own need; the windowed executor passes the window-max
+        bucket, so a round whose natural bucket is smaller gets extra
+        masked pad rows — its slice equals its own
+        ``gather_cohort(idx, steps=steps)`` with the same forced bucket
+        (tested), and training on it is an exact no-op relative to the
+        natural bucket because the trainer's rng streams are
+        prefix-stable in the step count (``trainer.local``).
+
+        ``put`` maps each staged host array to the device (default
+        ``jnp.array`` — an EXPLICIT copy: the CPU backend may otherwise
+        alias numpy memory zero-copy, and the staging buffers are
+        refilled next window); mesh runs pass a sharded ``device_put``.
+        The device arrays are blocked on before the staging lock is
+        released, so buffer reuse can never race an in-flight transfer."""
+        idx = np.asarray(window_indices)
+        if idx.ndim != 2:
+            raise ValueError(f"window_indices must be [W, k], got {idx.shape}")
+        w, k = idx.shape
+        ccounts = self.counts[idx]
+        steps = self._resolve_steps(ccounts, steps)
+        cap = steps * self.batch_size
+        put = put if put is not None else jnp.array
+
+        rows, empty = self._rowmap(idx, cap)
+        with self._staging_lock:
+            xs = self._staged("x", (w, k, cap) + self._x.shape[1:],
+                              self._x.dtype)
+            ys = self._staged("y", (w, k, cap) + self._y.shape[1:],
+                              self._y.dtype)
+            np.take(self._x, rows, axis=0, out=xs)
+            np.take(self._y, rows, axis=0, out=ys)
+            if empty.any():
+                xs[empty] = 0
+                ys[empty] = 0
+            mask = (np.arange(cap) < ccounts[..., None]).astype(np.float32)
+
+            def split(a):
+                return a.reshape((w, k, steps, self.batch_size) + a.shape[3:])
+
+            batch = WindowBatch(
+                x=put(split(xs)),
+                y=put(split(ys)),
+                mask=put(split(mask)),
+                counts=jnp.asarray(ccounts, jnp.int32),
+            )
+            # Block INSIDE the lock: once we release, the next window may
+            # refill xs/ys while these transfers still read them.
+            jax.block_until_ready((batch.x, batch.y, batch.mask))
+        return batch
 
 
 class CohortPrefetcher:
@@ -190,3 +344,60 @@ class CohortPrefetcher:
         if hit is not None and np.array_equal(hit[0], np.asarray(indices)):
             return hit[1]
         return self.store.gather_cohort(indices)
+
+
+class WindowPrefetcher:
+    """Double buffer for window superbatches: gather + H2D of window w+1
+    on a worker thread while window w's scan computes. A worker failure
+    (host OOM, bad index) is CONTAINED: the exception is captured and
+    re-raised in the caller's ``get`` — never a deadlock, never a
+    silently-dropped window — and the prefetcher stays usable afterwards
+    (subsequent gets fall through to a synchronous gather)."""
+
+    def __init__(self, store: FederatedStore, put=None):
+        self.store = store
+        self.put = put
+        self._pending: Dict[int, threading.Thread] = {}
+        # key -> ("ok", (indices, steps, batch)) | ("err", exception)
+        self._done: Dict[int, tuple] = {}
+        self._lock = threading.Lock()
+
+    def prefetch(self, key: int, window_indices, steps: int) -> None:
+        indices = np.asarray(window_indices)
+
+        def work():
+            try:
+                res = ("ok", (indices, steps,
+                              self.store.gather_window(
+                                  indices, steps, put=self.put)))
+            except BaseException as e:  # surfaces in get(), not the log
+                res = ("err", e)
+            with self._lock:
+                self._done[key] = res
+                self._pending.pop(key, None)
+
+        t = threading.Thread(target=work, daemon=True)
+        with self._lock:
+            if key in self._pending or key in self._done:
+                return
+            self._pending[key] = t
+        t.start()
+
+    def get(self, key: int, window_indices, steps: int) -> WindowBatch:
+        with self._lock:
+            t = self._pending.get(key)
+        if t is not None:
+            t.join()
+        with self._lock:
+            hit = self._done.pop(key, None)
+            for stale in [s for s in self._done if s < key]:
+                self._done.pop(stale)  # skipped windows must not leak
+        if hit is not None:
+            tag, val = hit
+            if tag == "err":
+                raise val
+            pidx, psteps, batch = val
+            if psteps == steps and np.array_equal(
+                    pidx, np.asarray(window_indices)):
+                return batch
+        return self.store.gather_window(window_indices, steps, put=self.put)

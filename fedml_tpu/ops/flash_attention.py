@@ -35,10 +35,14 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 _SUB = 8  # sublane replication for per-row vectors
 
+from fedml_tpu.parallel.compat import pallas_tpu_compiler_params
+
+_CompilerParams = pallas_tpu_compiler_params()
+
 # Grid = (batch·heads, outer block dim, contraction block dim). Only the
 # innermost (contraction) dim is sequential — scratch accumulators carry
 # across it; telling Mosaic the outer two are parallel frees its scheduler.
-_DIMS = pltpu.CompilerParams(
+_DIMS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 

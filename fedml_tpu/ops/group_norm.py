@@ -51,6 +51,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fedml_tpu.parallel.compat import pallas_tpu_compiler_params
+
+_CompilerParams = pallas_tpu_compiler_params()
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -165,7 +169,7 @@ def _fwd(x3, gamma, beta, groups, eps):
 def _bwd(x3, dy3, gamma, groups, eps):
     n, s, c = x3.shape
     bn = _block_n(n, s, c)
-    dims = pltpu.CompilerParams(dimension_semantics=("arbitrary",))
+    dims = _CompilerParams(dimension_semantics=("arbitrary",))
     return pl.pallas_call(
         functools.partial(_bwd_kernel, groups=groups, eps=eps),
         grid=(n // bn,),

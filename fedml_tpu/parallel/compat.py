@@ -1,0 +1,41 @@
+"""jax API-version compatibility shims for the parallel machinery.
+
+The codebase targets the current jax spelling — top-level
+``jax.shard_map`` with the ``check_vma`` replication-check kwarg. Older
+jaxlibs (< 0.6, e.g. the 0.4.x baked into some containers) keep
+shard_map in ``jax.experimental.shard_map`` and call the same kwarg
+``check_rep``. This wrapper keeps every call site on the new spelling
+and translates once, here, instead of try/excepting in six modules.
+"""
+
+from __future__ import annotations
+
+try:  # current jax: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _LEGACY = False
+except ImportError:  # jax < 0.6: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY = True
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the modern keyword surface on any
+    supported jax. Call-site pattern is always keyword-only after ``f``
+    (``partial(shard_map, mesh=..., in_specs=..., out_specs=...,
+    check_vma=False)``), which both generations accept."""
+    if _LEGACY and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)
+
+
+def pallas_tpu_compiler_params():
+    """The pallas-TPU CompilerParams class under its current name —
+    jax < 0.6 spells it ``TPUCompilerParams`` (same fields). Imported by
+    the pallas kernels (ops/flash_attention.py, ops/group_norm.py) so
+    the next rename is a one-place fix."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return getattr(pltpu, "CompilerParams", None) or \
+        pltpu.TPUCompilerParams

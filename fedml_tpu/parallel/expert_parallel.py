@@ -21,7 +21,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from fedml_tpu.parallel.compat import shard_map
 
 
 class MoEParams(NamedTuple):

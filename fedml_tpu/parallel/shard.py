@@ -17,7 +17,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from fedml_tpu.parallel.compat import shard_map
 
 from fedml_tpu.core.tree import tree_weighted_mean
 
@@ -180,6 +180,58 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
         return avg, loss
 
     return round_fn
+
+
+def make_window_scan(round_fn):
+    """``lax.scan`` over a window of PRE-GATHERED rounds: one jitted
+    dispatch runs W whole FedAvg rounds back-to-back with plain-FedAvg
+    server updates (net' = round average) between them — the windowed
+    execution tier's device side (host syncs drop from O(rounds) to
+    O(rounds/W); see ``FedAvgAPI.train_rounds_windowed``).
+
+    ``round_fn`` is the SAME per-round function the host loop dispatches
+    (vmap round on one chip, shard_map round on a client mesh — jitted is
+    fine, jit-under-scan inlines), so windowed rounds are bit-equal to
+    host-loop rounds fed the same cohorts, weights, and rng keys.
+
+    Returns ``scan_fn(net, x, y, mask, weights, keys) -> (net', losses)``
+    with ``x/y/mask [W, C, S, B, ...]``, ``weights [W, C]`` (sample
+    counts x pad mask — used for BOTH the model average and the loss
+    weighting, as the streaming host loop does), ``keys [W, 2]`` the
+    per-round rng keys in round order."""
+
+    def scan_fn(net, x, y, mask, weights, keys):
+        def body(net, inp):
+            xw, yw, mw, ww, kw = inp
+            avg, loss = round_fn(net, xw, yw, mw, ww, ww, kw)
+            return avg, loss
+
+        return jax.lax.scan(body, net, (x, y, mask, weights, keys))
+
+    return scan_fn
+
+
+def window_put(mesh, axis: str = "clients"):
+    """``put`` callable for ``FederatedStore.gather_window`` on a client
+    mesh: lays each ``[W, C, ...]`` superbatch field out with the client
+    axis (dim 1) sharded over ``mesh[axis]`` and the window axis
+    replicated, so every scanned round slice arrives already
+    client-sharded for the shard_map round.
+
+    The ``np.array`` copy is load-bearing: ``device_put`` of a large
+    aligned numpy array ZERO-COPY aliases its memory on the CPU backend
+    (reproduced: mutate after put → the device array changes; today's
+    sharded put happens to copy, but that is backend behavior, not a
+    contract), and gather_window hands this callable a VIEW of its
+    reused staging buffers — an aliased put would let the next window's
+    refill silently corrupt this window's in-flight superbatch. Aliasing
+    the fresh copy instead is fine: nobody ever mutates it, and jax
+    keeps it alive for the device array's lifetime."""
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, P(None, axis))
+    return lambda a: jax.device_put(np.array(a), sharding)
 
 
 def make_stateful_client_round(body, mesh, axis: str = "clients"):

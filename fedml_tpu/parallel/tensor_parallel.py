@@ -24,7 +24,7 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from fedml_tpu.parallel.compat import shard_map
 
 
 def _split(arr, n, axis):

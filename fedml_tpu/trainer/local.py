@@ -120,10 +120,23 @@ def make_epoch_shuffle(mask, epoch_rng):
     optimizer-step count stays exactly ``epochs x ceil(n_i/B)`` (FedNova's τ
     depends on this) and at most one batch per epoch mixes real samples
     with padding. Returns ``reshuffle(a)`` applicable to every per-sample
-    array of the pack (x, y, mask, teacher logits, ...)."""
+    array of the pack (x, y, mask, teacher logits, ...).
+
+    The per-slot keys are drawn PREFIX-STABLY — slot ``i``'s key depends
+    only on ``(epoch_rng, i)``, via fold_in, never on the total slot
+    count (a single batched ``uniform(epoch_rng, (S*B,))`` draw would
+    change EVERY key when S changes). This is what makes a larger forced
+    step bucket an exact training no-op: the real samples draw the same
+    keys, so they permute identically, and the extra pad slots (copies of
+    the client's first sample, masked) extend only the tail. The windowed
+    execution tier (``FedAvgAPI.train_rounds_windowed``) forces a shared
+    per-window bucket and leans on exactly this property for its
+    bit-equality with the per-round host loop."""
     n_steps, batch = mask.shape[0], mask.shape[1]
     flat_mask = mask.reshape(n_steps * batch)
-    keys = jax.random.uniform(epoch_rng, (n_steps * batch,))
+    keys = jax.vmap(
+        lambda i: jax.random.uniform(jax.random.fold_in(epoch_rng, i))
+    )(jnp.arange(n_steps * batch))
     # Padded slots get keys > 1 so argsort sends them to the tail.
     perm = jnp.argsort(keys + (1.0 - flat_mask) * 2.0)
 
@@ -200,9 +213,14 @@ def make_corrected_local_train(apply_fn, local_epochs: int, loss_fn,
 
     def local_train(net: "NetState", aux, x, y, mask, rng):
         def step(carry, inputs):
-            net, rng = carry
-            xb, yb, mb = inputs
-            rng, sub = jax.random.split(rng)
+            net, step_base = carry
+            xb, yb, mb, idx = inputs
+            # EXACTLY make_local_train_fn's per-step key derivation (the
+            # prefix-stable fold_in discipline): the "first round with
+            # zero corrections == plain FedAvg" equivalences (SCAFFOLD,
+            # FedDyn) hold bit-wise only while the two trainers draw
+            # identical streams.
+            sub = jax.random.fold_in(jax.random.fold_in(step_base, idx), 0)
 
             def masked_loss(p):
                 logits, new_state = apply_fn(
@@ -219,12 +237,18 @@ def make_corrected_local_train(apply_fn, local_epochs: int, loss_fn,
             nb = jnp.sum(mb)
             new_net = tree_select(nb > 0, NetState(new_params, new_state),
                                   net)
-            return (new_net, rng), (loss, nb)
+            return (new_net, step_base), (loss, nb)
 
         def epoch(carry, epoch_rng):
-            reshuffle = make_epoch_shuffle(mask, epoch_rng)
+            # Same fold_in(·, 0)/(·, 1) forks as make_local_train_fn.
+            reshuffle = make_epoch_shuffle(
+                mask, jax.random.fold_in(epoch_rng, 0))
             ex, ey, em = reshuffle(x), reshuffle(y), reshuffle(mask)
-            carry, (losses, ns) = jax.lax.scan(step, carry, (ex, ey, em))
+            net, _ = carry
+            step_base = jax.random.fold_in(epoch_rng, 1)
+            carry, (losses, ns) = jax.lax.scan(
+                step, (net, step_base),
+                (ex, ey, em, jnp.arange(ex.shape[0])))
             return carry, jnp.sum(losses * ns) / jnp.maximum(jnp.sum(ns), 1.0)
 
         rng, shuffle_rng = jax.random.split(rng)
@@ -295,13 +319,17 @@ def make_local_train_fn(
         n_steps, batch = x.shape[0], x.shape[1]
 
         def step(carry, inputs):
-            net, opt_state, rng = carry
-            xb, yb, mb = inputs
-            if dp:  # extra noise key; non-DP keeps its original rng stream
-                rng, sub, noise_rng = jax.random.split(rng, 3)
-            else:
-                rng, sub = jax.random.split(rng)
-                noise_rng = None
+            net, opt_state, step_base = carry
+            xb, yb, mb, idx = inputs
+            # Per-step keys by fold_in on the STEP INDEX, not a carried
+            # split chain: step s draws the same dropout/DP-noise keys
+            # whatever the total step count, so the all-masked tail steps
+            # a forced bucket appends never shift a later epoch's streams
+            # (the prefix-stability the windowed tier's bit-equality
+            # rests on — see make_epoch_shuffle).
+            per_step = jax.random.fold_in(step_base, idx)
+            sub = jax.random.fold_in(per_step, 0)
+            noise_rng = jax.random.fold_in(per_step, 1) if dp else None
 
             def masked_loss(p):
                 logits, new_state = apply_fn(
@@ -333,15 +361,22 @@ def make_local_train_fn(
             new_net = NetState(new_params, new_state)
             net = tree_select(nonempty, new_net, net)
             opt_state = tree_select(nonempty, new_opt, opt_state)
-            return (net, opt_state, rng), (loss, nb)
+            return (net, opt_state, step_base), (loss, nb)
 
         def epoch(carry, epoch_rng):
             if shuffle:
-                reshuffle = make_epoch_shuffle(mask, epoch_rng)
+                # fold_in(·, 0): the shuffle keys and the step streams
+                # must fork from DISJOINT children of the epoch key.
+                reshuffle = make_epoch_shuffle(
+                    mask, jax.random.fold_in(epoch_rng, 0))
                 ex, ey, em = reshuffle(x), reshuffle(y), reshuffle(mask)
             else:
                 ex, ey, em = x, y, mask
-            carry, (losses, ns) = jax.lax.scan(step, carry, (ex, ey, em))
+            net, opt_state, _ = carry
+            step_base = jax.random.fold_in(epoch_rng, 1)
+            carry, (losses, ns) = jax.lax.scan(
+                step, (net, opt_state, step_base),
+                (ex, ey, em, jnp.arange(ex.shape[0])))
             # Sample-weighted epoch loss: padded (all-masked) steps carry
             # weight 0, so small clients are not diluted by padding steps.
             return carry, jnp.sum(losses * ns) / jnp.maximum(jnp.sum(ns), 1.0)

@@ -29,10 +29,17 @@ def calibrated_ramp(run, floor_s=0.4, target_s=0.6, ramp_cap=1 << 22,
     call(1)  # compile
     n_prev, t_prev = 1, min(call(1) for _ in range(2))
     n, ramp = 8, []
+    # Ramp-exit thresholds derived from the caller's floor/target (r5
+    # ADVICE: hardcoded 0.5/0.2 ignored a larger requested floor_s, so
+    # the slope could be fitted from calls below the device-work floor
+    # the caller asked for): the call must carry most of the target's
+    # work AND the last quadrupling must have added clearly more than
+    # the RTT band before the two-point fit is trusted.
+    exit_t, exit_dt = target_s * 0.8, floor_s / 2
     while n <= ramp_cap:
         t = min(call(n) for _ in range(2))
         ramp.append((n, t))
-        if t >= 0.5 and t - t_prev > 0.2:
+        if t >= exit_t and t - t_prev > exit_dt:
             break
         n_prev, t_prev = n, t
         n *= 4

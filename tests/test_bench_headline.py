@@ -53,3 +53,24 @@ def test_headline_roundtrips_and_tolerates_errored_submetrics():
     assert h["value"] == 1.0
     assert h["sub"]["femnist_3400_rps"] is None
     assert len(json.dumps(h)) < 1024
+
+
+def test_headline_tolerates_budget_skipped_submetrics():
+    """Sections the wall-clock budget skips land as {"skipped": ...} in
+    the blob; the headline must still build, carry None scalars for
+    them, and stay under the tail-capture size."""
+    out = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 2.0,
+           "submetrics": {
+               "store_windowed": {"windowed_rounds_per_sec": 12.5,
+                                  "speedup": 1.7},
+               "flash_attention_sweep":
+                   {"skipped": "wall-clock budget 1350s exhausted"},
+               "transformer_fed_mfu":
+                   {"skipped": "wall-clock budget 1350s exhausted"}},
+           "tuned_best": None}
+    h = json.loads(json.dumps(bench.build_headline(out)))
+    assert h["sub"]["store_windowed_rps"] == 12.5
+    assert h["sub"]["store_windowed_speedup"] == 1.7
+    assert h["sub"]["flash_speedup_t16384"] is None
+    assert h["sub"]["transformer_mfu"] is None
+    assert len(json.dumps(h)) < 1024

@@ -33,6 +33,8 @@ def test_run_hierarchical():
     assert np.isfinite(history[-1]["train_loss"])
 
 
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
+
 def test_run_sequence_dataset():
     args = parse_args([
         "--model", "rnn", "--dataset", "shakespeare",
@@ -79,6 +81,8 @@ def test_cli_subprocess_north_star():
     last = json.loads(out.stdout.strip().splitlines()[-1])
     assert "train_loss" in last
 
+
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
 
 def test_run_fedseg_cli():
     args = parse_args([

@@ -39,6 +39,8 @@ def test_main_decentralized():
     assert np.isfinite(hist[-1]["train_loss"])
 
 
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
+
 def test_main_fedgan():
     hist = main([
         "--algorithm", "FedGAN",

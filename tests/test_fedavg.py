@@ -1,5 +1,6 @@
 import jax
 import numpy as np
+import pytest
 
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.fedavg import FedAvgAPI
@@ -71,6 +72,8 @@ def test_fedavg_padded_sampling_unbiased():
     for a, b in zip(jax.tree.leaves(api_local.net.params), jax.tree.leaves(api_shard.net.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
+
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
 
 def test_remat_matches_no_remat_exactly():
     """jax.checkpoint changes memory, not math: identical trained params."""

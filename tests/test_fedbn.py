@@ -89,6 +89,8 @@ def test_global_norm_leaves_stay_at_init_and_locals_specialize():
         pytest.fail("no per-client norm divergence found")
 
 
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
+
 def test_fedbn_beats_fedavg_under_feature_shift():
     fed = _scale_shifted_clients()
     rounds = 8

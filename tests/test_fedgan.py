@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.fedgan import FedGanAPI
@@ -38,6 +39,8 @@ def test_fedgan_round_runs_and_generates():
     assert imgs.shape == (3, 28, 28, 1)
     assert np.abs(np.asarray(imgs)).max() <= 1.0
 
+
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
 
 def test_fedgan_sharded_matches_vmap():
     """Same round on an 8-device client mesh == single-device vmap

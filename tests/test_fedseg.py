@@ -2,6 +2,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fedml_tpu.algos import FedConfig, FedSegAPI
 from fedml_tpu.algos.fedseg import (
@@ -76,6 +77,8 @@ def test_metrics_keeper_aggregates():
     agg = k.aggregate()
     assert abs(agg["mIoU"] - 0.3) < 1e-9 and abs(agg["acc"] - 0.6) < 1e-9
 
+
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
 
 def test_fedseg_learns():
     n_clients, per = 4, 24

@@ -17,8 +17,12 @@ from fedml_tpu.trainer.local import model_fns
         ("resnet18_gn", dict(num_classes=100), (2, 32, 32, 3), 100),
         ("vgg11", dict(num_classes=10, classifier_width=64), (2, 32, 32, 3), 10),
         ("vgg11_gn", dict(num_classes=10, classifier_width=64), (2, 32, 32, 3), 10),
-        ("mobilenet_v3", dict(num_classes=10, model_mode="SMALL"), (2, 32, 32, 3), 10),
-        ("efficientnet", dict(num_classes=10, variant="b0"), (2, 32, 32, 3), 10),
+        pytest.param("mobilenet_v3", dict(num_classes=10, model_mode="SMALL"),
+                     (2, 32, 32, 3), 10,
+                     marks=pytest.mark.slow),  # ~28 s compile (r6 audit)
+        pytest.param("efficientnet", dict(num_classes=10, variant="b0"),
+                     (2, 32, 32, 3), 10,
+                     marks=pytest.mark.slow),  # ~33 s compile (r6 audit)
     ],
 )
 def test_model_forward_shapes(name, kwargs, shape, classes):
@@ -72,6 +76,8 @@ def test_bn_variant_carries_batch_stats():
         not np.allclose(np.asarray(a), np.asarray(b)) for a, b in zip(before, after)
     )
 
+
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
 
 def test_resnet_bf16_mixed_precision_trains():
     """bf16 compute dtype: params/grads stay f32, forward runs bf16, and a

@@ -141,6 +141,8 @@ def test_derive_genotype_shape():
         assert name in PRIMITIVES and name != "none"
 
 
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
+
 def test_fednas_search_moves_alphas_and_weights():
     rng = np.random.RandomState(0)
     n, side, k = 128, 8, 4
@@ -227,6 +229,8 @@ def test_lcc_alpha_beta_disjoint_privacy():
         for k in range(2):
             assert not np.array_equal(shares[w], chunks[k])
 
+
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
 
 def test_genotype_network_search_to_retrain_pipeline():
     """Full DARTS pipeline: search → derive genotype → build the discrete

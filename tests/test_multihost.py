@@ -39,6 +39,24 @@ def test_hybrid_mesh_validates_ranks():
         hybrid_mesh((2, 2), (4,), ("hosts", "clients"))
 
 
+def _reap_workers(procs, timeout=600):
+    """Collect every worker's combined output, killing any still-running
+    siblings if one hangs or errors mid-reap (r5 ADVICE: a sequential
+    communicate loop that raises TimeoutExpired on worker k leaves
+    workers k+1.. alive — leaked gloo/coordinator subprocesses then
+    interfere with later multihost tests' ports and devices)."""
+    logs = []
+    try:
+        for p in procs:
+            logs.append(p.communicate(timeout=timeout)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()  # reap: no zombies, fds closed
+    return logs
+
+
 def _run_store_workers(nprocs, local_devices, ref_leaves, ref_losses):
     """Spawn ``nprocs`` workers × ``local_devices`` virtual CPU devices
     each (an 8-device global mesh either way) and compare the sharded
@@ -64,7 +82,7 @@ def _run_store_workers(nprocs, local_devices, ref_leaves, ref_losses):
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True)
         for pid in range(nprocs)]
-    logs = [p.communicate(timeout=600)[0] for p in procs]
+    logs = _reap_workers(procs)
     for p, log in zip(procs, logs):
         assert p.returncode == 0, f"worker failed:\n{log[-3000:]}"
 
@@ -164,7 +182,7 @@ def test_two_process_spmd_round_matches_single_process():
         [sys.executable, str(worker), str(pid), "2", str(port), str(out)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for pid in range(2)]
-    logs = [p.communicate(timeout=600)[0] for p in procs]
+    logs = _reap_workers(procs)
     for p, log in zip(procs, logs):
         assert p.returncode == 0, f"worker failed:\n{log[-3000:]}"
 

@@ -32,6 +32,8 @@ def test_ring_attention_matches_dense(causal, n_dev):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
+
 def test_ring_attention_grads_match_dense():
     """Backward pass through the ring (ppermute differentiates) must equal
     dense attention grads — training correctness, not just inference."""
@@ -52,6 +54,8 @@ def test_ring_attention_grads_match_dense():
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
                                    rtol=5e-5, atol=5e-5)
 
+
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
 
 def test_transformer_lm_with_ring_attention_trains():
     """Tiny causal LM: loss falls with ring attention and matches the dense

@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.fedavg import FedAvgAPI
@@ -19,6 +20,8 @@ def test_rnn_shapes():
         logits, _ = fns.apply(net, x)
         assert logits.shape == (2, 12, vocab)
 
+
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
 
 def test_federated_char_lm_learns():
     """Tiny synthetic char-LM: predictable periodic sequences; FedAvg over

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.fedgkt import FedGKTAPI, kl_loss
@@ -74,6 +75,8 @@ def test_kl_loss_zero_for_identical_logits():
     np.testing.assert_allclose(np.asarray(kl_loss(logits, logits)), 0.0,
                                atol=1e-5)
 
+
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
 
 def test_fedgkt_round_and_distillation():
     fed, test = _image_task()

@@ -71,6 +71,31 @@ def test_gather_cohort_forced_steps():
         store.gather_cohort(idx, steps=s_own // 2)
 
 
+def test_gather_cohort_vectorized_matches_loop_reference():
+    """The vectorized fancy-index gather must stay BYTE-identical to the
+    retained per-client copy-loop reference (_gather_cohort_loop) — on a
+    power-law partition with a giant, an EMPTY client (rows must stay
+    zero, not clamp to another client's data), duplicates, and a forced
+    larger bucket."""
+    rng = np.random.RandomState(0)
+    counts = [1024, 17, 0, 30, 12, 25, 8, 21]
+    tot = sum(counts)
+    x = rng.randn(tot, 4).astype(np.float32)
+    y = (rng.rand(tot) > 0.5).astype(np.int32)
+    edges = np.cumsum([0] + counts)
+    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(8)}
+    store = FederatedStore(x, y, parts, batch_size=32)
+    for idx, steps in ((np.array([1, 3, 5]), None),
+                       (np.array([0, 2, 4]), None),  # giant + empty
+                       (np.array([7, 7, 1]), None),  # duplicates
+                       (np.array([2]), None),        # only the empty one
+                       (np.array([1, 3]), 8)):       # forced bucket
+        a = store.gather_cohort(idx, steps=steps)
+        b = store._gather_cohort_loop(idx, steps=steps)
+        for lhs, rhs in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(lhs), np.asarray(rhs))
+
+
 def test_streaming_rounds_equal_resident_rounds():
     """Equal-count clients (steps already a power of two) → the streaming
     cohort is identical to the resident gather, so whole training rounds
@@ -331,6 +356,8 @@ def test_streaming_serves_qfedavg_and_robust():
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-6, atol=1e-7)
 
+
+@pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
 
 def test_full_stackoverflow_scale_342477_clients():
     """The reference's LARGEST federation, actually instantiated
