@@ -454,19 +454,31 @@ def _timed_windowed_blocks(api, window, blocks=3, min_block_s=4.0,
             f"block calibration could not reach the {min_block_s:.1f}s "
             f"target (last block {rounds} rounds, {dt:.2f}s)")
 
+    # Timed blocks run SANITIZED (obs.sanitizer): the transfer guard
+    # makes any unplanned host<->device copy raise mid-block (the store's
+    # staging H2D and the trailing loss fetch are marked planned), and
+    # the compile counter reports whether the steady state re-traced.
+    # Non-strict: on the power-law federation a late window can
+    # legitimately surface a not-yet-seen window-max bucket (one fresh
+    # scan executable) — that is a number to REPORT here, and a hard
+    # zero to assert in tests/test_fedlint.py's uniform-bucket pin.
+    from fedml_tpu.obs.sanitizer import sanitized
+
     rps, block_s = [], []
-    for _ in range(blocks):
-        dt = run_block(r, rounds)
-        rps.append(rounds / dt)
-        block_s.append(dt)
-        r += rounds
+    with sanitized(strict=False) as san:
+        for _ in range(blocks):
+            dt = run_block(r, rounds)
+            rps.append(rounds / dt)
+            block_s.append(dt)
+            r += rounds
     assert min(block_s) >= floor_s, block_s
     med, iqr = _med_iqr(rps)
     # Block lengths are window multiples, so every timed round rides a
     # scan by construction (api._window_stats would report coverage 1.0
     # tautologically — not a measurement, so not a metric).
     return {"rounds_per_sec": round(med, 3), "rounds_per_sec_iqr": iqr,
-            "block_rounds": rounds, "blocks": blocks}
+            "block_rounds": rounds, "blocks": blocks,
+            "steady_state_compiles": san.compiles}
 
 
 def bench_store_windowed():
@@ -498,6 +510,7 @@ def bench_store_windowed():
                 "windowed_rounds_per_sec_iqr":
                     windowed["rounds_per_sec_iqr"],
                 "block_rounds": windowed["block_rounds"],
+                "steady_state_compiles": windowed["steady_state_compiles"],
                 "speedup": round(windowed["rounds_per_sec"]
                                  / synced["rounds_per_sec"], 3)}
     finally:

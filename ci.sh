@@ -26,8 +26,13 @@ export PALLAS_AXON_POOL_IPS=
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-echo "== static check (compileall; the reference ran pyflakes) =="
+echo "== static check (compileall + fedlint; the reference ran pyflakes) =="
 python -m compileall -q fedml_tpu
+# fedlint: the repo's own AST analyzer for the JAX pitfalls PR 1 shipped
+# (carried rng chains, staging aliasing, host syncs in hot paths,
+# recompile hazards, donation misuse — docs/LINT.md). Exits nonzero on
+# any finding not covered by fedlint.baseline.json (kept empty: clean).
+python scripts/fedlint.py fedml_tpu --format=text
 
 common="--client_num_in_total 4 --client_num_per_round 4 --batch_size 8 \
         --comm_round 2 --epochs 1 --ci 1"

@@ -70,9 +70,15 @@ def make_gan_local_train(module, lr: float, local_epochs: int,
         g_state = opt_g.init(net.params)
 
         def step(carry, inputs):
-            net, d_state, g_state, rng = carry
-            xb, mb = inputs
-            rng, zd, zg = jax.random.split(rng, 3)
+            net, d_state, g_state, step_base = carry
+            xb, mb, idx = inputs
+            # Per-step noise keys by fold_in on the STEP INDEX (fedlint
+            # R1): the D and G draws fork from disjoint children of the
+            # per-step key, and the streams are prefix-stable in the
+            # step count (a forced step bucket never shifts them).
+            per_step = jax.random.fold_in(step_base, idx)
+            zd = jax.random.fold_in(per_step, 0)
+            zg = jax.random.fold_in(per_step, 1)
             nb = jnp.maximum(jnp.sum(mb), 1.0)
 
             def d_loss_fn(p):
@@ -118,12 +124,19 @@ def make_gan_local_train(module, lr: float, local_epochs: int,
             net = tree_select(nonempty, new_net, net)
             d_state = tree_select(nonempty, new_d_state, d_state)
             g_state = tree_select(nonempty, new_g_state, g_state)
-            return (net, d_state, g_state, rng), (d_loss + g_loss, jnp.sum(mb))
+            return (net, d_state, g_state, step_base), (d_loss + g_loss,
+                                                        jnp.sum(mb))
 
         def epoch(carry, epoch_rng):
-            reshuffle = make_epoch_shuffle(mask, epoch_rng)
+            # Shuffle keys and step streams fork from DISJOINT children
+            # of the epoch key (trainer/local.py discipline).
+            reshuffle = make_epoch_shuffle(
+                mask, jax.random.fold_in(epoch_rng, 0))
+            net, d_state, g_state, _ = carry
+            step_base = jax.random.fold_in(epoch_rng, 1)
             carry, (losses, ns) = jax.lax.scan(
-                step, carry, (reshuffle(x), reshuffle(mask)))
+                step, (net, d_state, g_state, step_base),
+                (reshuffle(x), reshuffle(mask), jnp.arange(x.shape[0])))
             return carry, jnp.sum(losses * ns) / jnp.maximum(jnp.sum(ns), 1.0)
 
         rng, shuffle_rng = jax.random.split(rng)

@@ -108,9 +108,12 @@ class FedGKTAPI:
             opt_state = opt.init(net.params)
 
             def step(carry, inputs):
-                net, opt_state, rng = carry
-                xb, yb, mb, tb = inputs
-                rng, sub = jax.random.split(rng)
+                net, opt_state, step_base = carry
+                xb, yb, mb, tb, idx = inputs
+                # Per-step key by fold_in on the STEP INDEX, not a carried
+                # split chain: prefix-stable in the step count, same
+                # discipline as trainer/local.py (fedlint R1).
+                sub = jax.random.fold_in(step_base, idx)
 
                 def loss_fn(p):
                     (logits, _), state = apply_fn(
@@ -129,14 +132,19 @@ class FedGKTAPI:
                     NetState(optax.apply_updates(net.params, updates), state),
                     net)
                 opt_state = tree_select(nonempty, new_opt, opt_state)
-                return (net, opt_state, rng), (loss, jnp.sum(mb))
+                return (net, opt_state, step_base), (loss, jnp.sum(mb))
 
             def epoch(carry, epoch_rng):
-                reshuffle = make_epoch_shuffle(mc, epoch_rng)
+                # fold_in(·, 0)/(·, 1): shuffle keys and step streams fork
+                # from DISJOINT children of the epoch key (local.py idiom).
+                reshuffle = make_epoch_shuffle(
+                    mc, jax.random.fold_in(epoch_rng, 0))
+                net, opt_state, _ = carry
+                step_base = jax.random.fold_in(epoch_rng, 1)
                 carry, (losses, ns) = jax.lax.scan(
-                    step, carry,
+                    step, (net, opt_state, step_base),
                     (reshuffle(xc), reshuffle(yc), reshuffle(mc),
-                     reshuffle(teacher)))
+                     reshuffle(teacher), jnp.arange(xc.shape[0])))
                 # Sample-weighted: padded all-masked steps carry weight 0.
                 return carry, jnp.sum(losses * ns) / jnp.maximum(
                     jnp.sum(ns), 1.0)
@@ -177,9 +185,11 @@ class FedGKTAPI:
             mm = mask.reshape((CS,) + mask.shape[2:])
 
             def step(carry, inputs):
-                net, opt_state, rng = carry
-                fb, clb, yb, mb = inputs
-                rng, sub = jax.random.split(rng)
+                net, opt_state, step_base = carry
+                fb, clb, yb, mb, idx = inputs
+                # fold_in on the step index (fedlint R1) — prefix-stable
+                # whatever the flattened client x batch axis length.
+                sub = jax.random.fold_in(step_base, idx)
 
                 def loss_fn(p):
                     logits, state = apply_fn(
@@ -197,15 +207,19 @@ class FedGKTAPI:
                     NetState(optax.apply_updates(net.params, updates), state),
                     net)
                 opt_state = tree_select(nonempty, new_opt, opt_state)
-                return (net, opt_state, rng), (loss, jnp.sum(mb))
+                return (net, opt_state, step_base), (loss, jnp.sum(mb))
 
-            def epoch(carry, _):
-                carry, (losses, ns) = jax.lax.scan(step, carry, (f, cl, yy, mm))
-                return carry, jnp.sum(losses * ns) / jnp.maximum(
+            def epoch(carry, e):
+                net, opt_state = carry
+                step_base = jax.random.fold_in(rng, e)
+                (net, opt_state, _), (losses, ns) = jax.lax.scan(
+                    step, (net, opt_state, step_base),
+                    (f, cl, yy, mm, jnp.arange(f.shape[0])))
+                return (net, opt_state), jnp.sum(losses * ns) / jnp.maximum(
                     jnp.sum(ns), 1.0)
 
-            (server_net, opt_state, _), losses = jax.lax.scan(
-                epoch, (server_net, opt_state, rng), None, length=epochs)
+            (server_net, opt_state), losses = jax.lax.scan(
+                epoch, (server_net, opt_state), jnp.arange(epochs))
 
             # Fresh server logits for every client batch (next-round teacher).
             def relabel(_, fb):

@@ -114,9 +114,15 @@ class FedNASAPI(FederatedLoop):
             amask, wmask = _split_mask(net.params)
 
             def step(carry, inputs):
-                net, rng = carry
-                (xt, yt, mt), (xv, yv, mv) = inputs
-                rng, r1, r2, r3 = jax.random.split(rng, 4)
+                net, step_base = carry
+                (xt, yt, mt), (xv, yv, mv), idx = inputs
+                # Three per-step keys fork from disjoint children of the
+                # fold_in-on-index key (fedlint R1): prefix-stable in the
+                # step count, unlike the carried split chain it replaces.
+                per_step = jax.random.fold_in(step_base, idx)
+                r1 = jax.random.fold_in(per_step, 0)
+                r2 = jax.random.fold_in(per_step, 1)
+                r3 = jax.random.fold_in(per_step, 2)
 
                 # --- architecture step on the valid half ---------------
                 def val_loss_wrt_alpha(p):
@@ -142,19 +148,22 @@ class FedNASAPI(FederatedLoop):
 
                 ns = jnp.sum(mt)
                 net = tree_select(ns > 0, NetState(params, new_state), net)
-                return (net, rng), (loss, ns)
+                return (net, step_base), (loss, ns)
 
-            def epoch(carry, _):
+            def epoch(carry, e):
                 # Sample-weighted epoch loss: padded all-masked steps return
                 # loss 0 and must not dilute the reported search_loss.
+                net, _ = carry
+                step_base = jax.random.fold_in(rng, e)
                 carry, (losses, ns) = jax.lax.scan(
-                    step, carry,
+                    step, (net, step_base),
                     ((x[:half], y[:half], mask[:half]),
-                     (x[half:2 * half], y[half:2 * half], mask[half:2 * half])))
+                     (x[half:2 * half], y[half:2 * half], mask[half:2 * half]),
+                     jnp.arange(half)))
                 return carry, jnp.sum(losses * ns) / jnp.maximum(jnp.sum(ns), 1.0)
 
             (net, _), losses = jax.lax.scan(
-                epoch, (net, rng), None, length=epochs)
+                epoch, (net, rng), jnp.arange(epochs))
             return net, jnp.mean(losses)
 
         def round_fn(net, x, y, mask, weights, rng):

@@ -59,6 +59,7 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
             weights = sub.counts.astype(jnp.float32) * jnp.asarray(g_mask)
             net_g = self.net
             for _ in range(self.cfg.group_comm_round):
+                # fedlint: disable=R1(deliberate round-order chain: group sub-rounds consume the same stream the flat host loop would, in round order; prefix-stable in the round count)
                 self.rng, rnd_rng = jax.random.split(self.rng)
                 net_g, loss = self.round_fn(
                     net_g, sub.x, sub.y, sub.mask, weights, weights, rnd_rng

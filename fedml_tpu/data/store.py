@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.data.batching import FederatedArrays, WindowBatch
+from fedml_tpu.obs.sanitizer import planned_transfer
 
 
 def _bucket_steps(steps: int) -> int:
@@ -172,12 +173,16 @@ class FederatedStore:
         def split(a):
             return a.reshape((k, steps, self.batch_size) + a.shape[2:])
 
-        return FederatedArrays(
-            x=jnp.asarray(split(xs)),
-            y=jnp.asarray(split(ys)),
-            mask=jnp.asarray(split(mask)),
-            counts=jnp.asarray(ccounts, jnp.int32),
-        )
+        # planned_transfer: the cohort H2D is the streaming tier's ONE
+        # deliberate staging copy per round — mark it so the whole round
+        # loop can run under obs.sanitizer.sanitized()'s transfer guard.
+        with planned_transfer():
+            return FederatedArrays(
+                x=jnp.asarray(split(xs)),
+                y=jnp.asarray(split(ys)),
+                mask=jnp.asarray(split(mask)),
+                counts=jnp.asarray(ccounts, jnp.int32),
+            )
 
     def _gather_cohort_loop(self, indices,
                             steps: Optional[int] = None) -> FederatedArrays:
@@ -252,6 +257,11 @@ class FederatedStore:
         ``jnp.array`` — an EXPLICIT copy: the CPU backend may otherwise
         alias numpy memory zero-copy, and the staging buffers are
         refilled next window); mesh runs pass a sharded ``device_put``.
+        A custom ``put`` must either copy before putting and declare it
+        (``put.copies = True``, as ``parallel.shard.window_put`` does)
+        or accept the defensive ``np.array`` copy this method inserts —
+        the PR-1 aliasing bug class (fedlint R2) is a put that zero-copy
+        aliases a staging buffer the next window refills.
         The device arrays are blocked on before the staging lock is
         released, so buffer reuse can never race an in-flight transfer."""
         idx = np.asarray(window_indices)
@@ -261,7 +271,10 @@ class FederatedStore:
         ccounts = self.counts[idx]
         steps = self._resolve_steps(ccounts, steps)
         cap = steps * self.batch_size
-        put = put if put is not None else jnp.array
+        if put is None:
+            put, put_copies = jnp.array, True  # jnp.array copies by default
+        else:
+            put_copies = bool(getattr(put, "copies", False))
 
         rows, empty = self._rowmap(idx, cap)
         with self._staging_lock:
@@ -279,15 +292,28 @@ class FederatedStore:
             def split(a):
                 return a.reshape((w, k, steps, self.batch_size) + a.shape[3:])
 
-            batch = WindowBatch(
-                x=put(split(xs)),
-                y=put(split(ys)),
-                mask=put(split(mask)),
-                counts=jnp.asarray(ccounts, jnp.int32),
-            )
-            # Block INSIDE the lock: once we release, the next window may
-            # refill xs/ys while these transfers still read them.
-            jax.block_until_ready((batch.x, batch.y, batch.mask))
+            def staged_put(a):
+                # R2 staging-alias guard: a put that has not declared
+                # ``copies = True`` may alias the reused staging buffer
+                # zero-copy (jax.device_put does, on the CPU backend) —
+                # hand it a fresh copy, the same guard window_put carries
+                # internally. ``mask`` is freshly allocated per call, so
+                # only the staged x/y fields need it.
+                return put(a if put_copies else np.array(a))
+
+            # planned_transfer: the window superbatch H2D is THE
+            # deliberate staging copy of the windowed tier (one per
+            # window) — mark it for obs.sanitizer.sanitized() regions.
+            with planned_transfer():
+                batch = WindowBatch(
+                    x=staged_put(split(xs)),
+                    y=staged_put(split(ys)),
+                    mask=put(split(mask)),
+                    counts=jnp.asarray(ccounts, jnp.int32),
+                )
+                # Block INSIDE the lock: once we release, the next window
+                # may refill xs/ys while these transfers still read them.
+                jax.block_until_ready((batch.x, batch.y, batch.mask))
         return batch
 
 

@@ -1,0 +1,52 @@
+"""fedlint — AST static analysis for the JAX pitfalls this codebase has hit.
+
+PR 1 shipped two bug classes found only by hand-auditing: ``device_put``
+zero-copy aliasing of reused host staging buffers, and rng streams that
+were not prefix-stable in the step count (carried ``random.split``
+chains inside scan bodies). Both silently break the bit-equality the
+windowed/streaming execution tiers rest on. ``fedlint`` walks the
+package AST and flags those classes before review has to:
+
+- **R1** carried ``random.split`` chains inside scan-or-loop bodies
+  (fold_in-on-index is required for prefix stability);
+- **R2** ``device_put``/``window_put`` of a buffer mutated later in the
+  same scope (staging-buffer aliasing);
+- **R3** host syncs inside jit/scan/shard_map-reachable functions
+  (``.item()``, ``float()``/``int()``/``np.asarray`` on device values);
+- **R4** recompile hazards (Python branches on tracer values, unhashable
+  static args, ``print``/Python-state mutation inside traced code);
+- **R5** donation misuse (reading an argument after it was donated).
+
+Every finding carries a ``# fedlint: disable=RULE(reason)`` suppression
+syntax, a severity, and a file:line report; ``scripts/fedlint.py`` is
+the CLI (text/json output, baseline-gated exit status, ``--fix`` for
+the mechanical R1 rewrite). The runtime complement — transfer-guard +
+recompile counting for the steady-state round loop — lives in
+``fedml_tpu.obs.sanitizer``. See docs/LINT.md.
+"""
+
+from fedml_tpu.lint.analyzer import (
+    RULES,
+    Violation,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+)
+from fedml_tpu.lint.baseline import (
+    fingerprint,
+    load_baseline,
+    new_violations,
+    write_baseline,
+)
+
+__all__ = [
+    "RULES",
+    "Violation",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "fingerprint",
+    "load_baseline",
+    "new_violations",
+    "write_baseline",
+]

@@ -1,0 +1,729 @@
+"""The fedlint AST analyzer: rules R1–R5 over one module at a time.
+
+Scope and honesty notes. This is a *project* linter, not a general JAX
+verifier: resolution is per-module and name-based (a function passed to
+``lax.scan`` in another module is invisible), and the rules encode the
+failure modes this repo has actually shipped, with allowlists tuned to
+its idioms (shape/ndim/len reads are static, ``is None`` tests are
+static, ...). False negatives are accepted; false positives are meant
+to be rare enough that ``# fedlint: disable=RULE(reason)`` stays an
+explicit, reviewed act rather than reflex.
+
+Traced-context discovery: a function is **hot** when it is (a) passed
+to / decorated with a tracing entry point (``jit``, ``pmap``, ``vmap``,
+``grad``, ``value_and_grad``, ``checkpoint``/``remat``, ``shard_map``),
+(b) passed to a structured-control primitive (``lax.scan``,
+``fori_loop``, ``while_loop``, ``cond``, ``switch``, ``associative_
+scan`` — additionally marked as a *scan body*), or (c) called by a hot
+function defined in the same module. R1 severities key off this: a
+carried split chain inside a scan body or a loop in hot code is an
+error (its stream depends on the traced trip count — PR 1's bug); the
+same chain in a host-side loop is a warning (prefix-stable in round
+order, but worth an explicit suppression where it is deliberate).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: rule id -> (slug, default severity, one-line description)
+RULES: Dict[str, Tuple[str, str, str]] = {
+    "R1": (
+        "carried-rng-chain",
+        "error",
+        "carried random.split chain / key reuse in a scan-or-loop body; "
+        "derive per-step keys with fold_in on the step index",
+    ),
+    "R2": (
+        "staging-alias",
+        "error",
+        "device_put/window_put of a buffer that is mutated later in the "
+        "same scope (zero-copy aliasing corrupts the device array)",
+    ),
+    "R3": (
+        "host-sync-in-hot-path",
+        "error",
+        "host synchronization inside a jit/scan/shard_map-reachable "
+        "function (.item(), float()/int()/np.asarray of device values)",
+    ),
+    "R4": (
+        "recompile-hazard",
+        "warning",
+        "recompile/trace hazard inside traced code (Python branch on a "
+        "tracer, unhashable static arg, print, Python-state mutation)",
+    ),
+    "R5": (
+        "donation-misuse",
+        "error",
+        "argument read after being passed in a donate_argnums position "
+        "(the buffer is deleted by donation)",
+    ),
+}
+
+_TRACING = {"jit", "pjit", "pmap", "vmap", "grad", "value_and_grad",
+            "checkpoint", "remat", "shard_map"}
+_LOOPING = {"scan", "fori_loop", "while_loop", "associative_scan",
+            "cond", "switch"}
+# NOTE: no "update" — optax GradientTransformation.update is a pure
+# function and is everywhere in this codebase's hot bodies.
+_MUTATORS = {"append", "extend", "insert", "add", "setdefault",
+             "pop", "popitem", "remove", "discard", "clear", "write"}
+_STATIC_CALLS = {"len", "isinstance", "issubclass", "list", "tuple",
+                 "dict", "set", "type", "getattr", "hasattr", "sorted",
+                 "range", "enumerate", "zip", "min", "max", "str",
+                 "repr", "format"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "keys", "items",
+                 "values", "axis_names"}
+_PUT_NAMES = {"device_put", "window_put", "put"}
+
+_SUPPRESS_RE = re.compile(r"#\s*fedlint:\s*disable=(.+)$")
+_SUPPRESS_ITEM_RE = re.compile(r"([A-Z]\d+)\s*(?:\(([^)]*)\))?")
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: str
+    source_line: str = ""
+    suppressed: bool = False
+    suppress_reason: Optional[str] = None
+    #: R1 straight-line autofix payload: (loop_var, key_repr, sub_repr)
+    fix: Optional[Tuple[str, str, str]] = None
+
+    def format(self) -> str:
+        tag = " (suppressed: %s)" % (self.suppress_reason or "no reason") \
+            if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.severity}] {self.message}{tag}")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_tail(call: ast.Call) -> Optional[str]:
+    d = _dotted(call.func)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _contains_static_escape(node: ast.AST) -> bool:
+    """True when the expression reads only trace-static facts (shapes,
+    dtypes, lengths) or routes through static-returning builtins."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(n, ast.Call):
+            tail = _call_tail(n)
+            if tail in _STATIC_CALLS:
+                return True
+    return False
+
+
+def _is_staticish(node: ast.AST) -> bool:
+    """Conservative 'this cannot be a live device value' check."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.UnaryOp,)):
+        return _is_staticish(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_staticish(node.left) and _is_staticish(node.right)
+    return _contains_static_escape(node)
+
+
+def _dynamic_test_names(test: ast.AST) -> Set[str]:
+    """Names that appear inside a dynamic comparison or arithmetic in a
+    branch test (Compare with value ops, or BinOp) — the concretization
+    shape, as opposed to static truthiness/identity checks."""
+    out: Set[str] = set()
+    for n in ast.walk(test):
+        if isinstance(n, ast.Compare) and not all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+            out |= _names_in(n)
+        elif isinstance(n, ast.BinOp):
+            out |= _names_in(n)
+    return out
+
+
+def _parse_suppressions(source: str) -> Dict[int, Dict[str, Optional[str]]]:
+    """line -> {rule: reason}. A directive suppresses findings on its own
+    line; a comment-only directive line also covers the next line."""
+    out: Dict[int, Dict[str, Optional[str]]] = {}
+    for i, raw in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        rules = {r: reason or None
+                 for r, reason in _SUPPRESS_ITEM_RE.findall(m.group(1))}
+        if not rules:
+            continue
+        out.setdefault(i, {}).update(rules)
+        if raw.lstrip().startswith("#"):  # standalone: covers next line
+            out.setdefault(i + 1, {}).update(rules)
+    return out
+
+
+@dataclass
+class _FuncInfo:
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    name: str
+    params: Set[str] = field(default_factory=set)
+    #: params annotated with Python scalar types (int/float/bool/str):
+    #: trace-static by declaration, never tainted as tracers
+    static_params: Set[str] = field(default_factory=set)
+    calls: Set[str] = field(default_factory=set)  # simple callee names
+    hot: bool = False
+    scan_body: bool = False
+
+
+class _Analyzer:
+    def __init__(self, tree: ast.Module, path: str, source: str):
+        self.tree = tree
+        self.path = path
+        self.lines = source.splitlines()
+        self.suppressions = _parse_suppressions(source)
+        self.violations: List[Violation] = []
+        self.funcs: List[_FuncInfo] = []
+        self._func_of_node: Dict[ast.AST, _FuncInfo] = {}
+        self._by_name: Dict[str, List[_FuncInfo]] = {}
+
+    # -- plumbing ------------------------------------------------------
+    def report(self, rule: str, node: ast.AST, message: str,
+               severity: Optional[str] = None,
+               fix: Optional[Tuple[str, str, str]] = None) -> None:
+        line, col = node.lineno, getattr(node, "col_offset", 0)
+        sup = self.suppressions.get(line, {})
+        v = Violation(
+            rule=rule, path=self.path, line=line, col=col, message=message,
+            severity=severity or RULES[rule][1],
+            source_line=(self.lines[line - 1].strip()
+                         if 0 < line <= len(self.lines) else ""),
+            fix=fix,
+        )
+        if rule in sup:
+            v.suppressed = True
+            v.suppress_reason = sup[rule]
+        self.violations.append(v)
+
+    # -- pass 1: function table + traced roots -------------------------
+    def _collect_functions(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                name = getattr(node, "name", "<lambda>")
+                info = _FuncInfo(node=node, name=name)
+                a = node.args
+                for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                            + ([a.vararg] if a.vararg else [])
+                            + ([a.kwarg] if a.kwarg else [])):
+                    info.params.add(arg.arg)
+                    ann = getattr(arg, "annotation", None)
+                    if isinstance(ann, ast.Name) \
+                            and ann.id in {"int", "float", "bool", "str"}:
+                        info.static_params.add(arg.arg)
+                body = node.body if isinstance(node.body, list) \
+                    else [node.body]
+                for sub in body:
+                    for n in ast.walk(sub):
+                        if isinstance(n, ast.Call):
+                            d = _dotted(n.func)
+                            if d and "." not in d:
+                                info.calls.add(d)
+                self.funcs.append(info)
+                self._func_of_node[node] = info
+                self._by_name.setdefault(name, []).append(info)
+
+    def _mark_roots(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    names = {_call_tail(dec)} if isinstance(dec, ast.Call) \
+                        else {_dotted(dec) and _dotted(dec).rsplit(".", 1)[-1]}
+                    if isinstance(dec, ast.Call):
+                        # @partial(jax.jit, ...) / @partial(shard_map, ...)
+                        for a in dec.args:
+                            d = _dotted(a)
+                            if d:
+                                names.add(d.rsplit(".", 1)[-1])
+                    if names & _TRACING:
+                        self._func_of_node[node].hot = True
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _call_tail(node)
+            if tail not in _TRACING and tail not in _LOOPING:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                target: Optional[_FuncInfo] = None
+                if isinstance(arg, ast.Lambda):
+                    target = self._func_of_node.get(arg)
+                else:
+                    d = _dotted(arg)
+                    if d and "." not in d and d in self._by_name:
+                        # name-based: every local def with that name
+                        for cand in self._by_name[d]:
+                            cand.hot = True
+                            if tail in _LOOPING:
+                                cand.scan_body = True
+                        continue
+                if target is not None:
+                    target.hot = True
+                    if tail in _LOOPING:
+                        target.scan_body = True
+
+    def _propagate(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for f in self.funcs:
+                if not f.hot:
+                    continue
+                for callee in f.calls:
+                    for cand in self._by_name.get(callee, []):
+                        if not cand.hot:
+                            cand.hot = True
+                            changed = True
+
+    # -- R1 ------------------------------------------------------------
+    def _check_r1(self) -> None:
+        for f in self.funcs:
+            body = f.node.body if isinstance(f.node.body, list) \
+                else [f.node.body]
+            for stmt in body:
+                self._r1_walk(stmt, f, loops=[])
+
+    def _r1_walk(self, node: ast.AST, f: _FuncInfo,
+                 loops: List[ast.AST]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not f.node:
+            return  # nested functions get their own _FuncInfo pass
+        if isinstance(node, (ast.For, ast.While)):
+            for child in ast.iter_child_nodes(node):
+                self._r1_walk(child, f, loops + [node])
+            return
+        if isinstance(node, ast.Assign):
+            self._r1_check_assign(node, f, loops)
+        for child in ast.iter_child_nodes(node):
+            self._r1_walk(child, f, loops)
+
+    def _r1_check_assign(self, node: ast.Assign, f: _FuncInfo,
+                         loops: List[ast.AST]) -> None:
+        call = node.value
+        if not isinstance(call, ast.Call):
+            return
+        d = _dotted(call.func)
+        if not d or not d.endswith("split") or "random" not in d:
+            return
+        if not call.args:
+            return
+        key = _dotted(call.args[0])
+        if key is None:
+            return
+        targets: List[str] = []
+        for t in node.targets:
+            if isinstance(t, ast.Tuple):
+                targets.extend(_dotted(e) or "" for e in t.elts)
+            else:
+                targets.append(_dotted(t) or "")
+        if key not in targets:
+            return
+        in_scan = f.scan_body
+        in_loop = bool(loops)
+        if not in_scan and not in_loop:
+            return
+        fix = None
+        if (in_loop and not in_scan and isinstance(loops[-1], ast.For)
+                and isinstance(loops[-1].target, ast.Name)
+                and len(targets) == 2 and "." not in key
+                and len(call.args) == 1):
+            others = [t for t in targets if t != key]
+            # Straight-line only: the carried key must not be read
+            # anywhere else in the loop body, or dropping its rebinding
+            # would change more than the stream derivation.
+            other_uses = [n for n in ast.walk(loops[-1])
+                          if _dotted(n) == key
+                          and getattr(n, "lineno", node.lineno)
+                          != node.lineno]
+            if len(others) == 1 and "." not in others[0] and not other_uses:
+                fix = (loops[-1].target.id, key, others[0])
+        if in_scan:
+            self.report(
+                "R1", node,
+                f"carried random.split chain on {key!r} inside a scan "
+                "body: the stream depends on the traced trip count and is "
+                "not prefix-stable in the step count; fold_in on the step "
+                "index instead (see trainer/local.py)",
+                severity="error")
+        else:
+            self.report(
+                "R1", node,
+                f"carried random.split chain on {key!r} in a "
+                f"{'hot ' if f.hot else ''}loop body: round/iteration "
+                "streams depend on every prior iteration; prefer fold_in "
+                "on the loop index (or suppress where the chain is a "
+                "pinned, deliberate round-order stream)",
+                severity="error" if f.hot else "warning",
+                fix=fix)
+
+    # -- R2 ------------------------------------------------------------
+    def _scopes(self):
+        yield None, self.tree.body
+        for f in self.funcs:
+            body = f.node.body if isinstance(f.node.body, list) \
+                else [f.node.body]
+            yield f, body
+
+    @staticmethod
+    def _walk_scope(body: Sequence[ast.AST], yield_nested: bool = False):
+        """Walk a scope's statements WITHOUT descending into nested
+        function/lambda bodies — those are their own scopes (every
+        FunctionDef gets its own _scopes()/_FuncInfo entry), and
+        descending here double-reports their findings at the enclosing
+        scope. ``yield_nested`` yields the nested def node itself
+        (callers that need its NAME, e.g. for local-binding sets)
+        while still not descending into it."""
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                if yield_nested:
+                    yield n
+                continue  # a nested scope: do not descend
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _check_r2(self) -> None:
+        for f, body in self._scopes():
+            puts: List[Tuple[ast.Call, Set[str]]] = []
+            mutations: List[Tuple[int, str, ast.AST]] = []
+            for n in self._walk_scope(body):
+                if isinstance(n, ast.Call):
+                    tail = _call_tail(n)
+                    if tail in _PUT_NAMES and n.args:
+                        names = set()
+                        for a in n.args:
+                            names |= _names_in(a)
+                        puts.append((n, names))
+                    # out=<name> keyword writes (np.take(..., out=x))
+                    for kw in n.keywords:
+                        if kw.arg == "out":
+                            d = _dotted(kw.value)
+                            if d:
+                                mutations.append((n.lineno, d, n))
+                    if (isinstance(n.func, ast.Attribute)
+                            and n.func.attr in {"fill", "sort",
+                                                "resize", "itemset"}):
+                        d = _dotted(n.func.value)
+                        if d:
+                            mutations.append((n.lineno, d, n))
+                if isinstance(n, (ast.Assign, ast.AugAssign)):
+                    targets = n.targets if isinstance(n, ast.Assign) \
+                        else [n.target]
+                    for t in targets:
+                        if isinstance(t, ast.Subscript):
+                            d = _dotted(t.value)
+                            if d:
+                                mutations.append((n.lineno, d, n))
+            for call, names in puts:
+                later = [(ln, nm) for ln, nm, _ in mutations
+                         if ln > call.lineno and nm in names]
+                if later:
+                    ln, nm = later[0]
+                    self.report(
+                        "R2", call,
+                        f"{_call_tail(call)} of {nm!r} which is mutated "
+                        f"later in the same scope (line {ln}): device_put "
+                        "may alias host memory zero-copy — copy before "
+                        "the put (np.array) or restructure",
+                    )
+
+    # -- R3 / R4 -------------------------------------------------------
+    def _check_hot_bodies(self) -> None:
+        for f in self.funcs:
+            if not f.hot:
+                continue
+            tainted = set(f.params) - {"self", "cls"} - f.static_params
+            body = f.node.body if isinstance(f.node.body, list) \
+                else [f.node.body]
+            local_binds = set(f.params)
+            # Scope-pruned walks (nested defs are their own _FuncInfo
+            # pass — walking into them here would double-report their
+            # findings AND judge them against the wrong tainted/
+            # local_binds sets).
+            for n in self._walk_scope(body, yield_nested=True):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    local_binds.add(n.name)
+                    continue
+                if isinstance(n, ast.Lambda):
+                    continue
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        for nm in ast.walk(t):
+                            if isinstance(nm, ast.Name):
+                                local_binds.add(nm.id)
+                    if (_names_in(n.value) & tainted
+                            and not _is_staticish(n.value)):
+                        for t in n.targets:
+                            for nm in ast.walk(t):
+                                if isinstance(nm, ast.Name):
+                                    tainted.add(nm.id)
+                if isinstance(n, (ast.For,)):
+                    for nm in ast.walk(n.target):
+                        if isinstance(nm, ast.Name):
+                            local_binds.add(nm.id)
+            for n in self._walk_scope(body):
+                self._r3_node(n, f, tainted)
+                self._r4_node(n, f, tainted, local_binds)
+
+    def _r3_node(self, n: ast.AST, f: _FuncInfo, tainted: Set[str]) -> None:
+        if not isinstance(n, ast.Call):
+            return
+        d = _dotted(n.func)
+        tail = _call_tail(n)
+        if tail in {"float", "int", "bool"} and d == tail and n.args:
+            if not _is_staticish(n.args[0]) and _names_in(n.args[0]) & tainted:
+                self.report(
+                    "R3", n,
+                    f"{tail}() of a traced value inside hot function "
+                    f"{f.name!r}: forces a device sync (or a "
+                    "ConcretizationError under trace); keep the value on "
+                    "device or move the sync outside the hot path")
+            return
+        if d and tail in {"asarray", "array"} and (
+                d.startswith("np.") or d.startswith("numpy.")
+                or d.startswith("onp.")):
+            if n.args and _names_in(n.args[0]) & tainted \
+                    and not _is_staticish(n.args[0]):
+                self.report(
+                    "R3", n,
+                    f"{d} of a traced value inside hot function "
+                    f"{f.name!r}: device-to-host copy in a hot path")
+            return
+        if d and d.endswith("device_get"):
+            self.report(
+                "R3", n,
+                f"jax.device_get inside hot function {f.name!r}: "
+                "device-to-host copy in a hot path")
+            return
+        if isinstance(n.func, ast.Attribute) \
+                and n.func.attr in {"item", "tolist"}:
+            base = _names_in(n.func.value)
+            if base & tainted or not base:
+                self.report(
+                    "R3", n,
+                    f".{n.func.attr}() inside hot function {f.name!r}: "
+                    "blocks on the device value (host sync per call)")
+
+    def _r4_node(self, n: ast.AST, f: _FuncInfo, tainted: Set[str],
+                 local_binds: Set[str]) -> None:
+        if isinstance(n, ast.Call):
+            d = _dotted(n.func)
+            if d == "print":
+                self.report(
+                    "R4", n,
+                    f"print() inside hot function {f.name!r}: runs at "
+                    "trace time only (or forces a sync via callbacks); "
+                    "use jax.debug.print for traced values")
+            elif (isinstance(n.func, ast.Attribute)
+                  and n.func.attr in _MUTATORS):
+                base = _dotted(n.func.value)
+                if base and "." not in base and base not in local_binds:
+                    self.report(
+                        "R4", n,
+                        f"mutation of closed-over Python state "
+                        f"{base!r}.{n.func.attr}() inside hot function "
+                        f"{f.name!r}: runs once at trace time, not per "
+                        "step — a silent correctness/recompile hazard")
+            return
+        if isinstance(n, (ast.Global, ast.Nonlocal)):
+            self.report(
+                "R4", n,
+                f"{'global' if isinstance(n, ast.Global) else 'nonlocal'} "
+                f"state mutation inside hot function {f.name!r}: runs at "
+                "trace time, not per executed step")
+            return
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Attribute) \
+                        and _dotted(t.value) == "self":
+                    self.report(
+                        "R4", n,
+                        f"assignment to self.{t.attr} inside hot function "
+                        f"{f.name!r}: Python-state mutation under trace "
+                        "happens once per (re)compilation, not per call")
+            return
+        if isinstance(n, (ast.If, ast.While)) or isinstance(n, ast.IfExp):
+            test = n.test
+            if _is_staticish(test):
+                return
+            # Bare-name truthiness (`if remat:`, `if not nan_guard:`) is
+            # overwhelmingly static builder config in this codebase; the
+            # tracer hazard we have actually hit is a *dynamic
+            # comparison/arithmetic* on a traced value (`if nb > 0:`).
+            hits = _dynamic_test_names(test) & tainted
+            if hits:
+                self.report(
+                    "R4", n,
+                    "Python branch on a possibly-traced value "
+                    f"({', '.join(sorted(hits))}) inside hot function "
+                    f"{f.name!r}: concretizes the tracer (error under "
+                    "jit) or forks compilation per value; use "
+                    "lax.cond/jnp.where or hoist the branch")
+
+    # -- R4d: unhashable static args; R5: donation ---------------------
+    def _check_jit_bindings(self) -> None:
+        for f, body in self._scopes():
+            static_of: Dict[str, Set[int]] = {}
+            donate_of: Dict[str, Set[int]] = {}
+            stmts: List[ast.AST] = list(self._walk_scope(body))
+            for n in stmts:
+                if not isinstance(n, ast.Assign) \
+                        or not isinstance(n.value, ast.Call):
+                    continue
+                call = n.value
+                if _call_tail(call) not in {"jit", "pjit"}:
+                    continue
+                statics, donated = set(), set()
+                for kw in call.keywords:
+                    if kw.arg in {"static_argnums", "static_argnames"}:
+                        statics |= self._int_elems(kw.value)
+                    if kw.arg == "donate_argnums":
+                        donated |= self._int_elems(kw.value)
+                for t in n.targets:
+                    d = _dotted(t)
+                    if d is None:
+                        continue
+                    if statics:
+                        static_of[d] = statics
+                    if donated:
+                        donate_of[d] = donated
+            for n in stmts:
+                if not isinstance(n, ast.Call):
+                    continue
+                d = _dotted(n.func)
+                if d in static_of:
+                    for pos in static_of[d]:
+                        if pos < len(n.args) and isinstance(
+                                n.args[pos], (ast.List, ast.Dict, ast.Set)):
+                            self.report(
+                                "R4", n.args[pos],
+                                f"unhashable literal passed in static arg "
+                                f"position {pos} of jitted {d!r}: every "
+                                "call re-traces (lists/dicts never hash-"
+                                "hit the jit cache); pass a tuple or "
+                                "hashable config object")
+                if d in donate_of:
+                    self._r5_check_call(n, d, donate_of[d], body)
+
+    @staticmethod
+    def _int_elems(node: ast.AST) -> Set[int]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return {node.value}
+        out: Set[int] = set()
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.add(e.value)
+        return out
+
+    def _r5_check_call(self, call: ast.Call, fname: str,
+                       donated: Set[int], scope_body: Sequence[ast.AST]):
+        rebound_same_stmt: Set[str] = set()
+        assign_of_call = None
+        for n in self._walk_scope(scope_body):
+            if isinstance(n, ast.Assign) and n.value is call:
+                assign_of_call = n
+        if assign_of_call is not None:
+            for t in assign_of_call.targets:
+                for e in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                    d = _dotted(e)
+                    if d:
+                        rebound_same_stmt.add(d)
+        for pos in donated:
+            if pos >= len(call.args):
+                continue
+            arg = _dotted(call.args[pos])
+            if arg is None or arg in rebound_same_stmt:
+                continue
+            # any Load of `arg` after the call line, with no rebinding
+            # assignment in between, is a read of a donated buffer
+            loads: List[int] = []
+            stores: List[int] = []
+            for n in self._walk_scope(scope_body):
+                if _dotted(n) == arg and hasattr(n, "lineno") \
+                        and n.lineno > call.lineno:
+                    ctx = getattr(n, "ctx", None)
+                    (stores if isinstance(ctx, ast.Store)
+                     else loads).append(n.lineno)
+            for ln in sorted(loads):
+                if not any(s <= ln for s in stores):
+                    self.report(
+                        "R5", call,
+                        f"{arg!r} is donated to {fname!r} "
+                        f"(donate_argnums={sorted(donated)}) but read "
+                        f"again at line {ln}: donated buffers are "
+                        "deleted — copy first or drop the donation")
+                    break
+
+    # -- driver --------------------------------------------------------
+    def run(self) -> List[Violation]:
+        self._collect_functions()
+        self._mark_roots()
+        self._propagate()
+        self._check_r1()
+        self._check_r2()
+        self._check_hot_bodies()
+        self._check_jit_bindings()
+        self.violations.sort(key=lambda v: (v.line, v.col, v.rule))
+        return self.violations
+
+
+def analyze_source(source: str, path: str = "<string>") -> List[Violation]:
+    tree = ast.parse(source)
+    return _Analyzer(tree, path, source).run()
+
+
+def analyze_file(path: str) -> List[Violation]:
+    with open(path, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    return analyze_source(src, path)
+
+
+def analyze_paths(paths: Sequence[str]) -> List[Violation]:
+    """Walk files/dirs (``.py`` only, ``__pycache__`` skipped). A path
+    that does not exist (or is a non-.py file) raises — a typo'd path in
+    a CI gate must fail loudly, not report a clean run over nothing."""
+    import os
+
+    out: List[Violation] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.extend(analyze_file(os.path.join(root, f)))
+        elif os.path.isfile(p) and p.endswith(".py"):
+            out.extend(analyze_file(p))
+        else:
+            raise FileNotFoundError(
+                f"fedlint: {p!r} is not a directory or .py file")
+    return out

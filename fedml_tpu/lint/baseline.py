@@ -1,0 +1,62 @@
+"""Baseline workflow: the CLI exits nonzero only on NEW violations.
+
+A baseline entry fingerprints a finding by ``(rule, path, normalized
+source line, occurrence index)`` — deliberately NOT the line number, so
+unrelated edits above a grandfathered finding do not churn the file.
+The checked-in ``fedlint.baseline.json`` is the debt ledger: an empty
+one (the state this repo keeps) means the tree is clean and every new
+finding fails CI immediately.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Sequence
+
+from fedml_tpu.lint.analyzer import Violation
+
+_VERSION = 1
+
+
+def _norm_path(path: str) -> str:
+    return os.path.relpath(path).replace(os.sep, "/")
+
+
+def fingerprint(violations: Sequence[Violation]) -> List[str]:
+    """Stable ids, disambiguating repeats of the same source line with
+    an occurrence counter."""
+    seen: Dict[str, int] = {}
+    out = []
+    for v in violations:
+        base = f"{v.rule}|{_norm_path(v.path)}|{' '.join(v.source_line.split())}"
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        out.append(f"{base}|#{n}")
+    return out
+
+
+def load_baseline(path: str) -> List[str]:
+    """Missing file == empty baseline (a fresh tree owes nothing)."""
+    if not path or not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != _VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r}")
+    return list(data.get("violations", []))
+
+
+def write_baseline(path: str, violations: Sequence[Violation]) -> None:
+    data = {"version": _VERSION, "violations": fingerprint(violations)}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def new_violations(violations: Sequence[Violation],
+                   baseline: Iterable[str]) -> List[Violation]:
+    known = set(baseline)
+    fps = fingerprint(violations)
+    return [v for v, fp in zip(violations, fps) if fp not in known]

@@ -1,0 +1,141 @@
+"""fedlint command-line interface (``scripts/fedlint.py`` is the entry).
+
+Exit status: 0 when every unsuppressed finding is covered by the
+baseline, 1 when new findings exist (for ``--fix``: when new,
+non-baselined findings remain that it could not rewrite), 2 on usage
+errors, including paths that do not exist — a typo'd gate path must
+fail loudly. ``--write-baseline`` snapshots the current findings as
+the new debt ledger.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from fedml_tpu.lint.analyzer import RULES, Violation, analyze_paths
+from fedml_tpu.lint.baseline import (
+    load_baseline,
+    new_violations,
+    write_baseline,
+)
+from fedml_tpu.lint.fix import apply_fixes, plan_fixes
+
+DEFAULT_BASELINE = "fedlint.baseline.json"
+
+
+def _to_json(violations: List[Violation]) -> str:
+    return json.dumps(
+        [
+            {
+                "rule": v.rule,
+                "slug": RULES[v.rule][0],
+                "path": v.path,
+                "line": v.line,
+                "col": v.col,
+                "severity": v.severity,
+                "message": v.message,
+                "suppressed": v.suppressed,
+                "suppress_reason": v.suppress_reason,
+                "fixable": v.fix is not None,
+            }
+            for v in violations
+        ],
+        indent=2,
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fedlint",
+        description="AST analysis for the JAX pitfalls this codebase has "
+                    "hit (R1 carried rng chains, R2 staging aliasing, R3 "
+                    "host syncs in hot paths, R4 recompile hazards, R5 "
+                    "donation misuse). See docs/LINT.md.")
+    ap.add_argument("paths", nargs="+", help="files or directories")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: ./{DEFAULT_BASELINE} "
+                         "when it exists; missing file == empty)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="snapshot current findings as the baseline and "
+                         "exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset, e.g. R1,R3")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    ap.add_argument("--fix", action="store_true",
+                    help="apply the mechanical R1 rewrite "
+                         "(split-chain -> fold_in-on-index)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="with --fix: print the diff, change nothing")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        unknown = wanted - set(RULES)
+        if unknown:
+            ap.error(f"unknown rules: {', '.join(sorted(unknown))}")
+    else:
+        wanted = set(RULES)
+
+    try:
+        all_v = [v for v in analyze_paths(args.paths) if v.rule in wanted]
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    active = [v for v in all_v if not v.suppressed]
+
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+
+    if args.write_baseline:
+        path = baseline_path or DEFAULT_BASELINE
+        write_baseline(path, active)
+        print(f"fedlint: wrote baseline with {len(active)} finding(s) "
+              f"to {path}")
+        return 0
+
+    if args.fix:
+        plans = plan_fixes(active)
+        n = sum(len(e) for e in plans.values())
+        diff = apply_fixes(plans, dry_run=args.dry_run)
+        if diff:
+            sys.stdout.write(diff)
+        verb = "would rewrite" if args.dry_run else "rewrote"
+        print(f"fedlint --fix: {verb} {n} R1 site(s) in "
+              f"{len(plans)} file(s)")
+        rest = [v for v in active if not (v.rule == "R1" and v.fix)]
+        if rest:
+            print(f"fedlint --fix: {len(rest)} finding(s) need manual "
+                  "attention:")
+            for v in rest:
+                print("  " + v.format())
+        # Exit status mirrors the gate: only findings NOT covered by the
+        # baseline fail the command (grandfathered debt stays exit 0).
+        rest_new = new_violations(rest, load_baseline(baseline_path or ""))
+        return 0 if not rest_new else 1
+
+    fresh = new_violations(active, load_baseline(baseline_path or ""))
+    shown = all_v if args.show_suppressed else active
+    if args.format == "json":
+        print(_to_json(shown))
+    else:
+        for v in shown:
+            print(v.format())
+        known = len(active) - len(fresh)
+        summary = (f"fedlint: {len(fresh)} new finding(s), {known} "
+                   f"baselined, "
+                   f"{sum(1 for v in all_v if v.suppressed)} suppressed "
+                   f"across {len(set(v.path for v in all_v)) or 0} "
+                   "file(s)")
+        print(summary)
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""``fedlint --fix``: the mechanical R1 rewrite, straight-line cases only.
+
+Rewrites
+
+    for i in ...:
+        rng, sub = jax.random.split(rng)        # carried chain
+        ...
+
+to
+
+    for i in ...:
+        sub = jax.random.fold_in(rng, i)        # prefix-stable
+        ...
+
+The analyzer only attaches a fix payload when the case is genuinely
+mechanical: a Python ``for`` loop with a simple index variable, a
+two-target split of a plain local name, and no other use of the carried
+key inside the loop body (checked here against the raw line text of the
+loop span — conservative, so a miss means "no fix", never a wrong fix).
+
+NOTE the rewrite is a *migration*, not an identity: fold_in draws a
+different stream than the carried chain, so pinned trajectories change.
+That is the point — the new stream is prefix-stable — but it is why the
+default mode is a dry-run diff and tests/bit-pins must be recalibrated
+by the caller.
+"""
+
+from __future__ import annotations
+
+import difflib
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from fedml_tpu.lint.analyzer import Violation
+
+
+def plan_fixes(violations: Sequence[Violation]
+               ) -> Dict[str, List[Tuple[int, str]]]:
+    """path -> [(line, replacement_source_line)]. Only R1 violations that
+    carry a fix payload and whose source line round-trips the expected
+    shape are planned; everything else is left for a human."""
+    out: Dict[str, List[Tuple[int, str]]] = {}
+    for v in violations:
+        if v.rule != "R1" or v.fix is None or v.suppressed:
+            continue
+        loop_var, key, sub = v.fix
+        # Expected shape: "<key>, <sub> = <mod>.split(<key>)" (module
+        # path free; trailing comment preserved).
+        m = re.match(
+            rf"^(\s*){re.escape(key)}\s*,\s*{re.escape(sub)}\s*=\s*"
+            rf"([\w.]*?)split\(\s*{re.escape(key)}\s*\)\s*(#.*)?$",
+            _line_at(v))
+        if not m:
+            continue
+        indent, mod, comment = m.group(1), m.group(2), m.group(3) or ""
+        mod = mod[:-1] if mod.endswith(".") else mod
+        fold = f"{mod}.fold_in" if mod else "fold_in"
+        new = f"{indent}{sub} = {fold}({key}, {loop_var})"
+        if comment:
+            new += f"  {comment}"
+        out.setdefault(v.path, []).append((v.line, new))
+    return out
+
+
+def _line_at(v: Violation) -> str:
+    with open(v.path, "r", encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    return lines[v.line - 1] if 0 < v.line <= len(lines) else ""
+
+
+def apply_fixes(plans: Dict[str, List[Tuple[int, str]]],
+                dry_run: bool = True) -> str:
+    """Apply (or just diff, when ``dry_run``) the planned rewrites.
+    Returns the unified diff across all touched files."""
+    diffs: List[str] = []
+    for path, edits in sorted(plans.items()):
+        with open(path, "r", encoding="utf-8") as fh:
+            old = fh.read().splitlines(keepends=True)
+        new = list(old)
+        for line, repl in edits:
+            new[line - 1] = repl + "\n"
+        diff = difflib.unified_diff(old, new, fromfile=f"a/{path}",
+                                    tofile=f"b/{path}")
+        diffs.extend(diff)
+        if not dry_run:
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.writelines(new)
+    return "".join(diffs)

@@ -9,6 +9,13 @@ from fedml_tpu.obs.logger import JsonlSink, MetricsLogger, StdoutSink, WandbSink
 from fedml_tpu.obs.timing import RoundTimer, trace
 from fedml_tpu.obs.checkpoint import CheckpointManager, RunState, restore_run, save_run
 from fedml_tpu.obs.flops import count_params, flops_str, model_cost
+from fedml_tpu.obs.sanitizer import (
+    SanitizerError,
+    SanitizerReport,
+    compile_count,
+    planned_transfer,
+    sanitized,
+)
 
 __all__ = [
     "JsonlSink",
@@ -24,4 +31,9 @@ __all__ = [
     "count_params",
     "flops_str",
     "model_cost",
+    "SanitizerError",
+    "SanitizerReport",
+    "compile_count",
+    "planned_transfer",
+    "sanitized",
 ]

@@ -1,0 +1,138 @@
+"""Runtime sanitizer: the dynamic complement to fedlint's static rules.
+
+fedlint (fedml_tpu.lint) catches the pitfall *patterns* in the AST; this
+module catches the two **runtime symptoms** those pitfalls produce in a
+steady-state round loop, cheaply enough to leave on in tests and bench:
+
+- **unplanned transfers** — ``sanitized()`` arms
+  ``jax.transfer_guard("disallow")``, so any *implicit* host<->device
+  copy (a numpy argument leaking into a jitted call, eager mixing of
+  host and device operands — the R3 class at runtime) raises inside the
+  guarded region. Deliberate staging transfers (the streaming store's
+  H2D of gathered cohorts) are marked with ``planned_transfer()``,
+  which locally re-allows them: "zero unplanned transfers" then means
+  exactly what it says.
+- **recompiles** — a process-wide ``jax.monitoring`` listener counts
+  backend-compile events (they fire only on true cache misses, never on
+  hits). ``sanitized()`` snapshots the counter around its body and, in
+  strict mode, raises ``SanitizerError`` if the steady-state region
+  compiled anything (the R4 class at runtime).
+
+Both guards are thread-scoped the way JAX scopes them: the transfer
+guard is a thread-local context, so prefetcher worker threads (whose
+staging H2D is planned by construction) are unaffected; the compile
+counter is global, so a recompile triggered from any thread inside the
+region is charged to it — which is the honest accounting for "zero
+recompiles after warmup".
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+import jax
+
+
+class SanitizerError(AssertionError):
+    """Steady-state contract violated (recompiles in a sanitized region)."""
+
+
+class _CompileCounter:
+    """Process-wide compile-event counter. jax.monitoring listeners
+    cannot be unregistered individually, so install exactly one for the
+    process lifetime and read deltas."""
+
+    _instance = None
+    _install_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+        def _on_duration(name: str, duration: float, **kw) -> None:
+            # '/jax/core/compile/backend_compile_duration' fires once per
+            # actual XLA compilation; jit cache hits record nothing.
+            if name.endswith("backend_compile_duration"):
+                with self._lock:
+                    self._count += 1
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+
+    @classmethod
+    def install(cls) -> "_CompileCounter":
+        with cls._install_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+
+def compile_count() -> int:
+    """Monotonic count of XLA compilations since the counter was first
+    installed (installs it on first use)."""
+    return _CompileCounter.install().count
+
+
+@dataclass
+class SanitizerReport:
+    """What the sanitized region observed. ``compiles`` is filled in on
+    exit; inside the region it reads the running delta."""
+
+    transfer: str = "disallow"
+    max_compiles: int = 0
+    compiles: int = 0
+    _start: int = field(default=0, repr=False)
+    _counter: object = field(default=None, repr=False)
+    _closed: bool = field(default=False, repr=False)
+
+    def compiles_so_far(self) -> int:
+        if self._closed:
+            return self.compiles
+        return self._counter.count - self._start
+
+    def assert_clean(self) -> None:
+        n = self.compiles_so_far()
+        if n > self.max_compiles:
+            raise SanitizerError(
+                f"sanitized region compiled {n} executable(s) "
+                f"(allowed: {self.max_compiles}): the steady-state loop "
+                "is re-tracing — look for shape churn (unbucketed step "
+                "counts), unhashable static args, or weak_type/dtype "
+                "drift (fedlint R4; docs/LINT.md)")
+
+
+@contextmanager
+def sanitized(transfer: str = "disallow", max_compiles: int = 0,
+              strict: bool = True):
+    """Run the body as a steady-state region: implicit transfers raise
+    immediately (``jax.transfer_guard(transfer)``), and on exit the
+    region must not have compiled more than ``max_compiles`` executables
+    (``SanitizerError`` when ``strict``; inspect the yielded report when
+    not). Warm the loop up OUTSIDE the region first — compilation of the
+    first window/round is planned, re-compilation afterwards is the bug.
+    """
+    counter = _CompileCounter.install()
+    report = SanitizerReport(transfer=transfer, max_compiles=max_compiles,
+                             _start=counter.count, _counter=counter)
+    with jax.transfer_guard(transfer):
+        yield report
+    report.compiles = counter.count - report._start
+    report._closed = True
+    if strict:
+        report.assert_clean()
+
+
+@contextmanager
+def planned_transfer():
+    """Mark a deliberate host<->device staging copy inside a
+    ``sanitized()`` region (the streaming store's cohort/window H2D, the
+    end-of-loop loss fetch). Locally re-allows transfers; a no-op when
+    no sanitizer is active."""
+    with jax.transfer_guard("allow"):
+        yield

@@ -231,7 +231,15 @@ def window_put(mesh, axis: str = "clients"):
     from jax.sharding import NamedSharding
 
     sharding = NamedSharding(mesh, P(None, axis))
-    return lambda a: jax.device_put(np.array(a), sharding)
+
+    def put(a):
+        return jax.device_put(np.array(a), sharding)
+
+    # Contract with FederatedStore.gather_window (fedlint R2): this put
+    # copies before putting, so the store must not insert a second
+    # defensive copy of its staging buffers.
+    put.copies = True
+    return put
 
 
 def make_stateful_client_round(body, mesh, axis: str = "clients"):

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""fedlint CLI — AST analysis for the JAX pitfalls this repo has hit.
+
+Usage:
+    python scripts/fedlint.py fedml_tpu                # gate (baseline)
+    python scripts/fedlint.py fedml_tpu --format=json
+    python scripts/fedlint.py fedml_tpu --fix --dry-run
+    python scripts/fedlint.py fedml_tpu --write-baseline
+
+Exit 0 when every unsuppressed finding is covered by the checked-in
+``fedlint.baseline.json`` (kept empty: the tree is clean); nonzero on
+any new finding. See docs/LINT.md for the rules and workflow.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fedml_tpu.lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,452 @@
+"""fedlint: the static analyzer (R1–R5), its CLI/baseline gate, the R1
+autofix, and the runtime sanitizer pin on the steady-state FedAvg loop.
+
+Each rule gets one tiny positive fixture (the analyzer must find the
+seeded pitfall) and one suppressed fixture (the same pitfall under
+``# fedlint: disable=RULE(reason)`` must be reported suppressed, not
+counted). The package-wide smoke test is the tier-1 lint gate: the
+cleaned tree must stay clean.
+"""
+
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.lint import analyze_paths, analyze_source
+from fedml_tpu.lint.analyzer import RULES
+from fedml_tpu.lint.cli import main as fedlint_main
+from fedml_tpu.lint.fix import apply_fixes, plan_fixes
+from fedml_tpu.obs.sanitizer import SanitizerError, compile_count, sanitized
+
+PKG_DIR = os.path.dirname(os.path.abspath(fedml_tpu.__file__))
+
+
+def _findings(src, rule=None, suppressed=False):
+    out = [v for v in analyze_source(textwrap.dedent(src), "fixture.py")
+           if v.suppressed == suppressed]
+    return [v for v in out if v.rule == rule] if rule else out
+
+
+# ---------------------------------------------------------------------------
+# R1 — carried random.split chains
+
+
+R1_SCAN = """
+    import jax
+
+    def local(xs, rng):
+        def step(carry, xb):
+            net, rng = carry
+            rng, sub = jax.random.split(rng)
+            return (net, rng), sub
+        return jax.lax.scan(step, (0, rng), xs)
+"""
+
+R1_LOOP = """
+    import jax
+
+    def make_keys(rng, n):
+        keys = []
+        for i in range(n):
+            rng, sub = jax.random.split(rng)
+            keys.append(sub)
+        return keys
+"""
+
+
+def test_r1_carried_chain_in_scan_body_is_error():
+    vs = _findings(R1_SCAN, "R1")
+    assert len(vs) == 1 and vs[0].severity == "error"
+    assert "prefix-stable" in vs[0].message
+
+
+def test_r1_carried_chain_in_host_loop_is_warning_with_fix():
+    vs = _findings(R1_LOOP, "R1")
+    assert len(vs) == 1 and vs[0].severity == "warning"
+    assert vs[0].fix == ("i", "rng", "sub")
+
+
+def test_r1_fold_in_pattern_is_clean():
+    clean = """
+        import jax
+
+        def local(xs, rng):
+            def step(carry, inp):
+                xb, idx = inp
+                sub = jax.random.fold_in(carry, idx)
+                return carry, sub
+            return jax.lax.scan(step, rng, xs)
+    """
+    assert not _findings(clean, "R1")
+
+
+def test_r1_suppression():
+    src = R1_SCAN.replace(
+        "rng, sub = jax.random.split(rng)",
+        "rng, sub = jax.random.split(rng)  "
+        "# fedlint: disable=R1(fixture reason)")
+    assert not _findings(src, "R1")
+    sup = _findings(src, "R1", suppressed=True)
+    assert len(sup) == 1 and sup[0].suppress_reason == "fixture reason"
+
+
+# ---------------------------------------------------------------------------
+# R2 — staging-buffer aliasing
+
+
+R2_SRC = """
+    import jax
+    import numpy as np
+
+    def stage(src):
+        buf = np.empty((4,), np.float32)
+        dev = jax.device_put(buf)
+        buf[:] = src
+        return dev
+"""
+
+
+def test_r2_put_then_mutate_flagged():
+    vs = _findings(R2_SRC, "R2")
+    assert len(vs) == 1 and "alias" in vs[0].message
+
+
+def test_r2_mutate_before_put_is_clean():
+    clean = """
+        import jax
+        import numpy as np
+
+        def stage(src):
+            buf = np.empty((4,), np.float32)
+            buf[:] = src
+            return jax.device_put(buf)
+    """
+    assert not _findings(clean, "R2")
+
+
+def test_r2_suppression():
+    src = R2_SRC.replace("dev = jax.device_put(buf)",
+                         "dev = jax.device_put(buf)  "
+                         "# fedlint: disable=R2(copied downstream)")
+    assert not _findings(src, "R2")
+    assert len(_findings(src, "R2", suppressed=True)) == 1
+
+
+# ---------------------------------------------------------------------------
+# R3 — host syncs in hot paths
+
+
+R3_SRC = """
+    import jax
+
+    def hot(x):
+        return float(x) + 1.0
+
+    jitted = jax.jit(hot)
+"""
+
+
+def test_r3_float_of_traced_value_flagged():
+    vs = _findings(R3_SRC, "R3")
+    assert len(vs) == 1 and "float()" in vs[0].message
+
+
+def test_r3_static_shape_reads_are_clean():
+    clean = """
+        import jax
+
+        def hot(x):
+            return x.reshape((int(x.shape[0]), -1))
+
+        jitted = jax.jit(hot)
+    """
+    assert not _findings(clean, "R3")
+
+
+def test_r3_cold_function_not_flagged():
+    cold = """
+        def host_only(x):
+            return float(x)
+    """
+    assert not _findings(cold, "R3")
+
+
+def test_r3_suppression():
+    src = R3_SRC.replace(
+        "return float(x) + 1.0",
+        "return float(x) + 1.0  # fedlint: disable=R3(fixture)")
+    assert not _findings(src, "R3")
+    assert len(_findings(src, "R3", suppressed=True)) == 1
+
+
+# ---------------------------------------------------------------------------
+# R4 — recompile hazards
+
+
+R4_BRANCH = """
+    import jax
+
+    def hot(x):
+        if x > 0:
+            print("positive")
+        return x
+
+    jitted = jax.jit(hot)
+"""
+
+R4_STATIC = """
+    import jax
+
+    def f(x, opts):
+        return x
+
+    g = jax.jit(f, static_argnums=(1,))
+    out = g(1.0, [1, 2])
+"""
+
+
+def test_r4_branch_and_print_flagged():
+    vs = _findings(R4_BRANCH, "R4")
+    msgs = " | ".join(v.message for v in vs)
+    assert "branch on a possibly-traced value" in msgs
+    assert "print()" in msgs
+
+
+def test_r4_unhashable_static_arg_flagged():
+    vs = _findings(R4_STATIC, "R4")
+    assert len(vs) == 1 and "unhashable" in vs[0].message
+
+
+def test_r4_static_config_truthiness_is_clean():
+    clean = """
+        import jax
+
+        def hot(x, remat):
+            if remat:
+                x = x * 2
+            return x
+
+        jitted = jax.jit(hot)
+    """
+    assert not _findings(clean, "R4")
+
+
+def test_r4_suppression():
+    src = R4_STATIC.replace("out = g(1.0, [1, 2])",
+                            "out = g(1.0, [1, 2])  "
+                            "# fedlint: disable=R4(fixture)")
+    assert not _findings(src, "R4")
+    assert len(_findings(src, "R4", suppressed=True)) == 1
+
+
+# ---------------------------------------------------------------------------
+# R5 — donation misuse
+
+
+R5_SRC = """
+    import jax
+
+    def run(x):
+        g = jax.jit(lambda a: a + 1, donate_argnums=(0,))
+        y = g(x)
+        return x + y
+"""
+
+
+def test_r5_read_after_donation_flagged():
+    vs = _findings(R5_SRC, "R5")
+    assert len(vs) == 1 and "donate" in vs[0].message
+
+
+def test_r5_rebinding_target_is_clean():
+    # the codebase idiom: `self.net, losses = scan(self.net, ...)` —
+    # the donated name is rebound by the very call statement
+    clean = """
+        import jax
+
+        def run(x, xs):
+            g = jax.jit(lambda a, b: (a + 1, b), donate_argnums=(0,))
+            x, ys = g(x, xs)
+            return x + ys
+    """
+    assert not _findings(clean, "R5")
+
+
+def test_r5_suppression():
+    src = R5_SRC.replace("y = g(x)",
+                         "y = g(x)  # fedlint: disable=R5(fixture)")
+    assert not _findings(src, "R5")
+    assert len(_findings(src, "R5", suppressed=True)) == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI: baseline gate + --fix
+
+
+def test_baseline_gate_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "mod.py"
+    bad.write_text(textwrap.dedent(R1_SCAN))
+    baseline = tmp_path / "base.json"
+
+    # seeded violation, no baseline -> nonzero
+    assert fedlint_main([str(bad), "--baseline", str(baseline)]) == 1
+    # snapshot the debt -> subsequent runs pass
+    assert fedlint_main([str(bad), "--baseline", str(baseline),
+                         "--write-baseline"]) == 0
+    assert fedlint_main([str(bad), "--baseline", str(baseline)]) == 0
+    # a NEW violation on top of the baselined one fails again
+    bad.write_text(textwrap.dedent(R1_SCAN) + textwrap.dedent(R3_SRC))
+    assert fedlint_main([str(bad), "--baseline", str(baseline)]) == 1
+    capsys.readouterr()
+
+
+def test_cli_nonexistent_path_is_an_error(tmp_path, capsys):
+    # a typo'd path in the ci.sh gate must fail loudly, not report a
+    # clean run over zero files
+    assert fedlint_main([str(tmp_path / "no_such_pkg")]) == 2
+    capsys.readouterr()
+
+
+def test_fix_exit_status_respects_baseline(tmp_path, capsys):
+    # unfixable findings that are grandfathered in the baseline must not
+    # fail --fix (exit mirrors the gate: only NEW findings fail)
+    bad = tmp_path / "mod.py"
+    bad.write_text(textwrap.dedent(R3_SRC))  # R3: never auto-fixable
+    baseline = tmp_path / "base.json"
+    assert fedlint_main([str(bad), "--baseline", str(baseline),
+                         "--write-baseline"]) == 0
+    assert fedlint_main([str(bad), "--baseline", str(baseline),
+                         "--fix", "--dry-run"]) == 0
+    # without the baseline the same unfixable finding fails --fix
+    assert fedlint_main([str(bad), "--baseline",
+                         str(tmp_path / "empty.json"),
+                         "--fix", "--dry-run"]) == 1
+    capsys.readouterr()
+
+
+def test_nested_hot_function_findings_not_duplicated():
+    # R3/R4 findings inside a nested hot function must be reported once,
+    # by the nested function's own pass — not re-reported (against the
+    # wrong taint sets) by the enclosing hot function's walk
+    src = """
+        import jax
+
+        def outer(xs, rng):
+            def body(carry, xb):
+                print("per step")
+                return carry, xb
+            return jax.lax.scan(body, rng, xs)
+
+        jitted = jax.jit(outer)
+    """
+    vs = [v for v in _findings(src, "R4") if "print()" in v.message]
+    assert len(vs) == 1, [v.format() for v in vs]
+
+
+def test_cli_json_format(tmp_path, capsys):
+    bad = tmp_path / "mod.py"
+    bad.write_text(textwrap.dedent(R3_SRC))
+    assert fedlint_main([str(bad), "--format=json"]) == 1
+    out = capsys.readouterr().out
+    import json
+
+    data = json.loads(out[:out.rindex("]") + 1])
+    assert data and data[0]["rule"] == "R3" \
+        and data[0]["slug"] == RULES["R3"][0]
+
+
+def test_fix_rewrites_straight_line_r1(tmp_path):
+    mod = tmp_path / "loops.py"
+    mod.write_text(textwrap.dedent(R1_LOOP))
+    vs = analyze_paths([str(mod)])
+    plans = plan_fixes(vs)
+    diff = apply_fixes(plans, dry_run=True)  # dry run: diff, no change
+    assert "jax.random.fold_in(rng, i)" in diff
+    assert "split(rng)" in mod.read_text()  # untouched
+    apply_fixes(plans, dry_run=False)
+    assert "fold_in(rng, i)" in mod.read_text()
+    assert not [v for v in analyze_paths([str(mod)]) if v.rule == "R1"]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 lint gate: the cleaned tree stays clean
+
+
+def test_package_has_no_unsuppressed_findings():
+    vs = [v for v in analyze_paths([PKG_DIR]) if not v.suppressed]
+    assert not vs, "fedlint regressions:\n" + "\n".join(
+        v.format() for v in vs)
+
+
+def test_package_suppressions_all_carry_reasons():
+    sup = [v for v in analyze_paths([PKG_DIR]) if v.suppressed]
+    assert sup, "expected the documented deliberate suppressions"
+    missing = [v for v in sup if not v.suppress_reason]
+    assert not missing, "suppressions without reasons:\n" + "\n".join(
+        v.format() for v in missing)
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+
+
+def test_sanitized_counts_recompiles():
+    f = jax.jit(lambda a: a * 2)
+    warm, fresh = jnp.ones(3), jnp.ones(11)  # args made OUTSIDE the
+    f(warm)                                  # guard (eager jnp.ones is
+    with sanitized() as rep:                 # itself an implicit h2d)
+        f(warm)
+    assert rep.compiles == 0
+    with pytest.raises(SanitizerError, match="re-tracing"):
+        with sanitized():
+            f(fresh)  # fresh shape -> cache miss
+
+
+def test_sanitized_blocks_implicit_transfer():
+    f = jax.jit(lambda a: a * 2)
+    f(jnp.ones(3, jnp.float32))  # warmup
+    with pytest.raises(Exception, match="[Dd]isallowed"):
+        with sanitized(strict=False):
+            f(np.ones(3, np.float32))  # numpy leaks into the hot call
+
+
+def _uniform_store(n_clients=12, per=32, d=6, batch=8, seed=0):
+    from fedml_tpu.data.store import FederatedStore
+
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n_clients * per, d).astype(np.float32)
+    y = (x @ rng.randn(d) > 0).astype(np.int32)
+    parts = {c: np.arange(c * per, (c + 1) * per) for c in range(n_clients)}
+    return FederatedStore(x, y, parts, batch_size=batch)
+
+
+def test_windowed_steady_state_sanitized():
+    """THE acceptance pin: after warmup, the windowed streaming FedAvg
+    round loop runs under transfer_guard('disallow') with zero jit-cache
+    misses — every host<->device copy it performs is a planned staging
+    transfer, and the scan executable is reused across windows."""
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.models.lr import LogisticRegression
+
+    store = _uniform_store()
+    cfg = FedConfig(client_num_in_total=12, client_num_per_round=4,
+                    comm_round=32, epochs=1, batch_size=8, lr=0.3,
+                    frequency_of_the_test=1000)
+    api = FedAvgAPI(LogisticRegression(num_classes=2), store, None, cfg)
+    api.train_rounds_windowed(8, start_round=0, window=4)  # warmup
+    with sanitized() as rep:
+        losses = api.train_rounds_windowed(8, start_round=8, window=4)
+    assert len(losses) == 8
+    assert rep.compiles == 0
+
+
+def test_compile_count_monotonic():
+    c0 = compile_count()
+    jax.jit(lambda a: a + 17.0)(jnp.ones(5))
+    assert compile_count() > c0
