@@ -63,6 +63,29 @@ import numpy as np
 BASELINE_SAMPLES_PER_SEC = 1500.0  # single-GPU torch simulator assumption
 TRIALS = 5
 
+
+class _SectionTimeout(Exception):
+    """A bench section overran its per-section wall-clock cap."""
+
+
+# Per-section deadline (absolute perf_counter value), set by main()
+# around each section. The r5 postmortem: the BUDGET check runs BEFORE a
+# section starts, so one long section (transformer_flash_e2e) still blew
+# past the driver's kill timer — rc 124, headline never printed. The cap
+# is enforced subprocess-free: every A/B repeat/calibration loop calls
+# _check_section_deadline() between timed units and bails with
+# _SectionTimeout, which main() records as {"timeout": ...} and moves on.
+_SECTION_DEADLINE = None
+
+
+def _check_section_deadline():
+    if _SECTION_DEADLINE is not None \
+            and time.perf_counter() > _SECTION_DEADLINE:
+        raise _SectionTimeout(
+            f"per-section cap exceeded "
+            f"(+{time.perf_counter() - _SECTION_DEADLINE:.0f}s past "
+            "deadline)")
+
 # Advertised peak bf16 TFLOP/s per chip (public spec sheets), keyed by
 # device_kind substring. Unknown kinds → MFU omitted.
 CHIP_PEAK_BF16_TFLOPS = {
@@ -110,6 +133,7 @@ def _timed_scan_trials(api, rounds, samples_per_round, n_trials=3):
     axon tunnel). Caller warms up first."""
     vals = []
     for _ in range(n_trials):
+        _check_section_deadline()
         t0 = time.perf_counter()
         losses = api.train_rounds_on_device(rounds)
         float(np.asarray(losses).sum())
@@ -143,6 +167,7 @@ def _scan_bench(model, n_clients, per_client, batch, cpr, lr,
     api.train_rounds_on_device(rounds)  # warmup/compile
     jax.block_until_ready(api.net.params)
     for _ in range(4):
+        _check_section_deadline()
         t0 = time.perf_counter()
         losses = api.train_rounds_on_device(rounds)
         float(np.asarray(losses).sum())
@@ -296,6 +321,7 @@ def _timed_store_windows(api, store, windows=5, window=10,
     window_floor_s = min_window_s * 2.0 / 3.0
 
     def run_window(r, window):
+        _check_section_deadline()
         samples = 0
         if count_samples:
             for rr in range(r, r + window):
@@ -368,6 +394,24 @@ def _timed_store_windows(api, store, windows=5, window=10,
 _femnist_state = {}
 
 
+def _synthetic_femnist_store(n_clients, batch, seed=0):
+    """FEMNIST-shaped synthetic streaming federation (28x28x1, 62
+    classes, lognormal power-law-ish counts ≈140 samples/writer) —
+    the SHARED builder for every store-backed FEMNIST section, so the
+    windowed-FedOpt A/B can never silently drift from the federation
+    shape its FedAvg comparison sections measure."""
+    from fedml_tpu.data.store import FederatedStore
+
+    rng = np.random.RandomState(seed)
+    counts = np.maximum(1, rng.lognormal(3.6, 0.7, n_clients).astype(int))
+    tot = int(counts.sum())
+    x = rng.rand(tot, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 62, tot).astype(np.int32)
+    edges = np.concatenate([[0], np.cumsum(counts)])
+    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(n_clients)}
+    return FederatedStore(x, y, parts, batch_size=batch), counts
+
+
 def _femnist_3400_setup():
     """The FEMNIST-3400 streaming configuration (BASELINE.md shallow-NN
     row at its TRUE client count: 3400 writers, 10/round, batch 20,
@@ -379,18 +423,10 @@ def _femnist_3400_setup():
                 _femnist_state["batch"])
     from fedml_tpu.algos.config import FedConfig
     from fedml_tpu.algos.fedavg import FedAvgAPI
-    from fedml_tpu.data.store import FederatedStore
     from fedml_tpu.models.cnn import CNNDropOut
 
     n_clients, batch, cpr = 3400, 20, 10
-    rng = np.random.RandomState(0)
-    counts = np.maximum(1, rng.lognormal(3.6, 0.7, n_clients).astype(int))
-    tot = int(counts.sum())  # ~140 samples/writer, power-law-ish
-    x = rng.rand(tot, 28, 28, 1).astype(np.float32)
-    y = rng.randint(0, 62, tot).astype(np.int32)
-    edges = np.concatenate([[0], np.cumsum(counts)])
-    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(n_clients)}
-    store = FederatedStore(x, y, parts, batch_size=batch)
+    store, counts = _synthetic_femnist_store(n_clients, batch)
     # comm_round bounds prefetch (fedavg.py _stream_cohort only prefetches
     # while round_idx+1 < comm_round): the floor-calibrated windows run
     # well past 40 rounds, so keep the horizon above any window schedule
@@ -424,6 +460,7 @@ def _timed_windowed_blocks(api, window, blocks=3, min_block_s=4.0,
     rounds, r = 4 * window, start_round
 
     def run_block(r, rounds):
+        _check_section_deadline()
         t0 = time.perf_counter()
         losses = api.train_rounds_windowed(rounds, start_round=r,
                                            window=window)
@@ -516,6 +553,42 @@ def bench_store_windowed():
     finally:
         # Free the GB-scale host store before the later sections run.
         _femnist_state.clear()
+
+
+def bench_store_windowed_fedopt():
+    """Windowed FedOpt (server adam) A/B — the carry-protocol tier's
+    headline evidence: W rounds per dispatch WITH the server optimizer
+    state threaded through the scan carry, vs the same federation's
+    per-round host loop. Before this tier, every adaptive-server run
+    floored at dispatch RTT (the windowed guard rejected any
+    _server_update override). Its own moderate federation (the 3400-
+    client store is freed after its section; this one is sized to fit
+    the per-section cap): 600 power-law writers, FEMNIST-shaped CNN,
+    10 clients/round."""
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedopt import FedOptAPI
+    from fedml_tpu.models.cnn import CNNDropOut
+
+    n_clients, batch, cpr, window = 600, 20, 10, 16
+    store, counts = _synthetic_femnist_store(n_clients, batch, seed=1)
+    cfg = FedConfig(client_num_in_total=n_clients, client_num_per_round=cpr,
+                    comm_round=100_000,  # > any window schedule (prefetch)
+                    epochs=1, batch_size=batch, lr=0.1,
+                    server_optimizer="adam", server_lr=0.01)
+    api = FedOptAPI(CNNDropOut(num_classes=62), store, None, cfg)
+    _warm_store_buckets(api, store, counts, cpr, batch)
+    synced = _timed_store_windows(api, store, windows=3, min_window_s=3.0)
+    windowed = _timed_windowed_blocks(api, window, blocks=3, min_block_s=3.0)
+    return {"clients": n_clients, "window": window,
+            "server_optimizer": "adam",
+            "synced_rounds_per_sec": synced["rounds_per_sec"],
+            "synced_rounds_per_sec_iqr": synced["rounds_per_sec_iqr"],
+            "windowed_rounds_per_sec": windowed["rounds_per_sec"],
+            "windowed_rounds_per_sec_iqr": windowed["rounds_per_sec_iqr"],
+            "block_rounds": windowed["block_rounds"],
+            "steady_state_compiles": windowed["steady_state_compiles"],
+            "speedup": round(windowed["rounds_per_sec"]
+                             / synced["rounds_per_sec"], 3)}
 
 
 def bench_stackoverflow_342k():
@@ -652,6 +725,7 @@ def _calibrated_side(f, q, k, v, tokens_per_iter, n_timed=5):
     re-checked against the refined rate (retry with more iters if a noisy
     first fit under-sized the chain)."""
     def call(iters):
+        _check_section_deadline()
         t0 = time.perf_counter()
         float(f(q, k, v, iters))
         return time.perf_counter() - t0
@@ -746,6 +820,8 @@ def bench_flash_attention_sweep():
                        "dense_temp_mb": temp_mb(f_naive, q, k, v),
                        "speedup": round(fl["tokens_per_sec"]
                                         / de["tokens_per_sec"], 3)})
+        except _SectionTimeout:  # the per-section cap must abort the
+            raise                # section, not masquerade as a dense OOM
         except Exception as e:  # the T² wall: dense cannot allocate
             pt["dense_tokens_per_sec"] = None
             pt["dense_failed"] = f"{type(e).__name__}: {e}"[:120]
@@ -753,6 +829,7 @@ def bench_flash_attention_sweep():
 
     points, crossover = {}, None
     for t, b in [(2048, 4), (8192, 1), (16384, 1), (32768, 1), (65536, 1)]:
+        _check_section_deadline()
         pt = measure(t, b)
         if (crossover is None and pt.get("speedup")
                 and pt["speedup"] > 1.0):
@@ -811,6 +888,7 @@ def _lm_scan_bench(model, n_clients, per_client, batch, cpr, t, vocab,
         return statistics.median(
             _timed_scan_trials(api, rounds, cpr * per_client))
     for _ in range(4):
+        _check_section_deadline()
         t0 = time.perf_counter()
         losses = api.train_rounds_on_device(rounds)
         float(np.asarray(losses).sum())
@@ -907,19 +985,26 @@ def main():
                    else None)
     # Wall-clock budget over the SECONDARY sections (r5 satellite: the
     # r5 run hit the driver timeout inside transformer_flash_e2e — rc
-    # 124, parsed: null — and the headline line never printed). The check
-    # runs before each section starts, so the worst case is budget + one
-    # section (~350 s measured max) + the JSON dump, which must stay
-    # inside the driver's kill timer. Sections the budget skips are
-    # recorded as {"skipped": ...} in the blob — an explicit hole, not a
-    # silent one — and the headline ALWAYS lands as the final line.
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1350"))
+    # 124, parsed: null — and the headline line never printed). The
+    # budget check runs before each section starts; r6 adds the
+    # PER-SECTION hard cap (BENCH_SECTION_S, enforced subprocess-free by
+    # _check_section_deadline inside every A/B repeat/calibration loop)
+    # so a single long section can no longer blow past the driver kill
+    # timer, and drops the default budget 1350 → 900 s — worst case is
+    # now primary + budget + ONE section cap + the JSON dump. Sections
+    # the budget skips are recorded as {"skipped": ...}, capped sections
+    # as {"timeout": ...} — explicit holes, not silent ones — and the
+    # headline ALWAYS lands as the final line.
+    global _SECTION_DEADLINE
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "900"))
+    section_s = float(os.environ.get("BENCH_SECTION_S", "240"))
     _t0 = time.perf_counter()
     primary = bench_cifar_resnet56(profile_dir=profile_dir)
     _log("primary done")
     sub = {}
     for name, fn in (("femnist_cnn_3400clients", bench_femnist_cnn_3400),
                      ("store_windowed", bench_store_windowed),
+                     ("store_windowed_fedopt", bench_store_windowed_fedopt),
                      ("stackoverflow_342k", bench_stackoverflow_342k),
                      ("vit_cifar_shaped", bench_vit),
                      ("resnet56_batch128_tuned", bench_resnet56_b128),
@@ -934,10 +1019,16 @@ def main():
                                      f"exhausted at +{elapsed:.0f}s")}
             _log(f"{name} SKIPPED (budget)")
             continue
+        _SECTION_DEADLINE = time.perf_counter() + section_s
         try:
             sub[name] = fn()
+        except _SectionTimeout as e:
+            sub[name] = {"timeout": (f"section cap {section_s:.0f}s: {e}")}
+            _log(f"{name} TIMED OUT (section cap)")
         except Exception as e:  # one broken submetric must not kill the line
             sub[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        finally:
+            _SECTION_DEADLINE = None
         _log(f"{name} done")
 
     sps = primary.pop("samples_per_sec")
@@ -1022,6 +1113,10 @@ def build_headline(out, full_path="docs/bench_r5_local.json"):
             "store_windowed_rps": _scalar("store_windowed",
                                           "windowed_rounds_per_sec"),
             "store_windowed_speedup": _scalar("store_windowed", "speedup"),
+            "fedopt_windowed_rps": _scalar("store_windowed_fedopt",
+                                           "windowed_rounds_per_sec"),
+            "fedopt_windowed_speedup": _scalar("store_windowed_fedopt",
+                                               "speedup"),
             "stackoverflow_342k_rps": _scalar("stackoverflow_342k",
                                               "rounds_per_sec"),
             "vit_sps": _scalar("vit_cifar_shaped", "samples_per_sec"),

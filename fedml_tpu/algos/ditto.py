@@ -34,23 +34,11 @@ from fedml_tpu.trainer.local import (
 )
 
 
-def _gather_stacked(stacked, idx):
-    return jax.tree.map(lambda p: jnp.take(p, idx, axis=0), stacked)
-
-
-def _scatter_stacked(stacked, idx, values, wmask):
-    """Write back sampled-client models. Shard padding repeats idx[0] with
-    wmask 0; routing padded slots to an out-of-bounds index with
-    ``mode='drop'`` discards those writes entirely — a gated merge would
-    leave duplicate indices in the scatter, whose write order XLA leaves
-    undefined, letting a padded slot's stale model clobber the real one."""
-
-    def put(old, new):
-        dustbin = old.shape[0]  # out of bounds → dropped
-        idx_eff = jnp.where(wmask > 0, idx, dustbin)
-        return old.at[idx_eff].set(new, mode="drop")
-
-    return jax.tree.map(put, stacked, values)
+# Canonical implementations moved to core.tree (the windowed scan needs
+# them without importing an algorithm module); the underscore aliases
+# stay for the existing importers (scaffold, feddyn, tests).
+from fedml_tpu.core.tree import gather_stacked as _gather_stacked
+from fedml_tpu.core.tree import scatter_stacked as _scatter_stacked
 
 
 class DittoAPI(FedAvgAPI):

@@ -587,16 +587,13 @@ class FedAvgAPI(FederatedLoop):
         unsynced dispatches costs the tunnel more than the syncs save
         (A/B on the 3400-client FEMNIST bench config: ~8.8 vs ~5.5
         rounds/sec). Prefer this method on directly-attached devices."""
-        if (type(self).train_one_round is not FedAvgAPI.train_one_round
-                or type(self).run_round is not FederatedLoop.run_round):
-            # A subclass with its own per-round procedure (SCAFFOLD's
-            # control updates, FedNova's tau algebra, ...) would silently
-            # run plain FedAvg rounds here; _server_update overrides
-            # (FedOpt) are fine — the loop applies them.
-            raise NotImplementedError(
-                f"{type(self).__name__} customizes the round itself; "
-                "train_rounds_pipelined only serves subclasses whose "
-                "round rides run_round + _server_update")
+        # Shared consistency guard with the windowed tier: any subclass
+        # whose per-round procedure is run_round + _server_update
+        # pipelines (stateful _server_update overrides like FedOpt's
+        # included — the loop applies them host-side as device math;
+        # windowed-scan purity is NOT required here); custom-round
+        # subclasses refuse loudly.
+        self._check_round_protocol("train_rounds_pipelined")
         if self.cfg.client_selection == "oort":
             raise NotImplementedError(
                 "oort updates per-client utilities after every round "
@@ -609,20 +606,132 @@ class FedAvgAPI(FederatedLoop):
             losses.append(loss)
         return [float(l) for l in losses]
 
+    # --- windowed carry protocol ------------------------------------------
+    #: How (whether) this algorithm rides the multi-round scan tiers
+    #: (``train_rounds_windowed`` / ``train_rounds_pipelined``):
+    #:
+    #: - ``"round"`` — the per-round procedure is exactly ``run_round``
+    #:   + ``_server_update``. The windowed scan replays ``round_fn``
+    #:   with the PURE server update from :meth:`_window_server_update`
+    #:   folded between rounds (plain FedAvg and FedProx need no carry;
+    #:   FedOpt carries its server optimizer state).
+    #: - ``"custom"`` — the subclass builds its own scan body
+    #:   (:meth:`_build_window_scan`) and threads its own carry
+    #:   (SCAFFOLD: server control + the full client-control stack,
+    #:   gathered/scattered per scanned round). Custom rounds do not
+    #:   pipeline — their per-round host procedure IS the round.
+    #: - ``None`` — host loop only.
+    #:
+    #: The guards key on THIS declaration (plus a consistency check that
+    #: a "round" declarer really left the round alone), not on
+    #: ``type(self)`` identity lists — so a subclass that overrides only
+    #: ``_server_update`` opts in by providing its pure windowed form
+    #: instead of being rejected wholesale.
+    window_protocol: Optional[str] = "round"
+
+    def _window_server_update(self):
+        """The PURE form of :meth:`_server_update` for the windowed scan:
+        ``None`` means plain FedAvg (``net' = round average``, no carry);
+        otherwise a jit-traceable ``(net, avg, extra) -> (net', extra')``
+        with ``extra`` the carried server state. A subclass that
+        overrides ``_server_update`` (host-loop, may touch ``self``) MUST
+        also override this hook — inheriting the plain-average fold
+        would silently change its semantics inside the scan."""
+        if type(self)._server_update is not FedAvgAPI._server_update:
+            raise NotImplementedError(
+                f"{type(self).__name__} overrides _server_update without "
+                "providing its pure windowed form; override "
+                "_window_server_update (and the carry init/commit hooks) "
+                "or set window_protocol = None")
+        return None
+
+    def _window_carry_init(self):
+        """Extra carry entering the window scan (read from instance
+        state). Plain FedAvg/FedProx carry nothing."""
+        return None
+
+    def _window_carry_commit(self, extra) -> None:
+        """Write the scanned-out carry back to instance state, so host
+        rounds / checkpoints after a window see it (FedOpt: the server
+        optimizer state; SCAFFOLD: server + client controls)."""
+
+    def _window_scan_extras(self, idx2d, wmask2d):
+        """Extra per-round scanned inputs, as a tuple of ``[W, ...]``
+        device arrays ("custom" protocol aux — SCAFFOLD passes the
+        window's cohort index map and its scatter mask). Default: none."""
+        return ()
+
+    def _build_window_scan(self):
+        """The UNJITTED window scan for this algorithm —
+        ``scan(net, extra, x, y, mask, weights, keys, *extras) ->
+        ((net', extra'), losses)``. "round"-protocol subclasses get it
+        for free from ``round_fn`` + ``_window_server_update``."""
+        from fedml_tpu.parallel.shard import make_window_scan
+
+        return make_window_scan(self.round_fn, self._window_server_update())
+
+    def _check_round_protocol(self, what: str) -> None:
+        """Consistency guard for the tiers that replay the STANDARD
+        round: the per-round procedure must be exactly ``run_round`` +
+        ``_server_update`` — a subclass with its own round (SCAFFOLD's
+        control updates, FedNova's tau algebra, Ditto's personal step)
+        would silently run plain rounds here. Note this is deliberately
+        all the pipelined loop requires: it applies ``_server_update``
+        host-side, so impure/stateful overrides (and classes that set
+        ``window_protocol = None`` to opt out of the windowed scan)
+        still pipeline; purity only matters inside the windowed scan
+        (:meth:`_window_server_update`)."""
+        if (type(self).train_one_round is not FedAvgAPI.train_one_round
+                or type(self).run_round is not FederatedLoop.run_round):
+            raise NotImplementedError(
+                f"{type(self).__name__} customizes the round itself; "
+                f"{what} only serves algorithms whose per-round "
+                "procedure is run_round + _server_update (declare the "
+                "'custom' windowed carry protocol for a bespoke scan "
+                "body)")
+
     def _check_windowed_supported(self):
-        """Shared guard for the windowed streaming tier."""
+        """Shared guard for the windowed streaming tier — keyed on the
+        windowed carry protocol, not type identity."""
+        if self.window_protocol is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} opts out of the windowed carry "
+                "protocol (window_protocol=None); use the per-round host "
+                "loop")
+        if self.window_protocol == "round":
+            self._check_round_protocol("train_rounds_windowed")
+            self._window_server_update()  # raises when no pure form exists
+        elif self.window_protocol == "custom":
+            if type(self)._build_window_scan is FedAvgAPI._build_window_scan:
+                # "custom" without a custom scan body would inherit the
+                # plain round replay — the silent-drift failure the
+                # protocol exists to refuse.
+                raise NotImplementedError(
+                    f"{type(self).__name__} declares window_protocol="
+                    "'custom' but does not override _build_window_scan; "
+                    "provide the custom scan body + carry hooks")
+            if (type(self)._window_carry_init
+                    is not FedAvgAPI._window_carry_init
+                    and type(self)._window_carry_commit
+                    is FedAvgAPI._window_carry_commit):
+                # State flows INTO the scan but the no-op default commit
+                # would silently drop the scanned-out result — remainder
+                # rounds/eval/checkpoints would read stale instance
+                # state with no error (a forgotten init at least fails
+                # loudly at trace time; a forgotten commit never does).
+                raise NotImplementedError(
+                    f"{type(self).__name__} overrides _window_carry_init "
+                    "without _window_carry_commit; the scanned-out carry "
+                    "would be silently discarded")
+        else:
+            raise NotImplementedError(
+                f"unknown window_protocol {self.window_protocol!r}; "
+                "declare 'round', 'custom', or None")
         if not self._streaming:
             raise NotImplementedError(
                 "windowed execution streams window superbatches from a "
                 "FederatedStore; the resident layout already has the "
                 "stronger train_rounds_on_device scan")
-        if (type(self).train_one_round is not FedAvgAPI.train_one_round
-                or type(self).run_round is not FederatedLoop.run_round
-                or type(self)._server_update is not FedAvgAPI._server_update):
-            raise NotImplementedError(
-                f"{type(self).__name__} customizes the round or server "
-                "update; the windowed scan applies plain-FedAvg server "
-                "updates (net' = round average) between its rounds")
         if self.cfg.client_selection != "random":
             raise NotImplementedError(
                 "windowed execution gathers the next W rounds' cohorts in "
@@ -633,12 +742,11 @@ class FedAvgAPI(FederatedLoop):
     def _get_window_scan(self):
         fn = self._window_scan_fn
         if fn is None:
-            from fedml_tpu.parallel.shard import make_window_scan
-
-            # Donate the incoming net (always replaced by the scan's
-            # output) so XLA reuses the old params' buffers.
-            fn = jax.jit(make_window_scan(self.round_fn),
-                         donate_argnums=(0,))
+            # Donate the incoming carry — net AND extra are always
+            # replaced by the scan's outputs, so XLA reuses the old
+            # buffers (the driver rebinds/commits before anything reads
+            # the donated originals again).
+            fn = jax.jit(self._build_window_scan(), donate_argnums=(0, 1))
             self._window_scan_fn = fn
         return fn
 
@@ -655,9 +763,26 @@ class FedAvgAPI(FederatedLoop):
         ``lax.scan`` dispatch — host round-trips drop from O(rounds) to
         O(rounds/window).
 
+        Server state rides the scan as the CARRY (the windowed carry
+        protocol, see :attr:`window_protocol`): FedOpt's adaptive server
+        optimizer threads its optax state between scanned rounds,
+        SCAFFOLD carries the server control plus the full client-control
+        stack (cohort slots gathered/scattered inside the scan body),
+        and plain FedAvg/FedProx carry nothing. The carry is committed
+        back to instance state at every window boundary, so
+        checkpointing between calls captures it.
+
         BIT-EQUAL to the per-round host loop under the same seeds (tested,
         including on a client mesh and with a window the round count
-        doesn't divide): each window forces its rounds onto the window's
+        doesn't divide). Precisely: the TRAINING TRAJECTORY — params,
+        carried server state, SCAFFOLD's controls — is bit-exact at every
+        round (the per-step update math is sequential and identical);
+        the reported per-round LOSS scalar is bit-equal at the pinned
+        test shapes but can differ by ~1 ulp at some shapes, because XLA
+        may reassociate the loss-reduction sum differently inside the
+        scan than in the standalone round dispatch (telemetry only —
+        observed on plain FedAvg as well, never feeding back into
+        training). Each window forces its rounds onto the window's
         MAX step bucket, which is an exact training no-op — pad slots all
         hold the client's own (masked) first sample, all-masked tail
         steps are ``tree_select``-gated out, and the trainer's rng
@@ -676,7 +801,6 @@ class FedAvgAPI(FederatedLoop):
 
         self._check_windowed_supported()
         store = self.train_fed
-        counts = self._host_counts()
 
         # Plan: every round's cohort (seeded → known now) and its bucket.
         cohorts = [self.sample_round(start_round + t)
@@ -711,12 +835,29 @@ class FedAvgAPI(FederatedLoop):
             pf.prefetch(*span_args(scan_spans[0]))
 
         losses = []
+        extra = self._window_carry_init()
         for off, length, steps in spans:
-            if steps is None:  # host-loop leftover rounds (run_round
-                for t in range(length):  # splits the rng chain itself)
-                    avg, loss = self.run_round(start_round + off + t)
-                    self.net = self._server_update(self.net, avg)
-                    losses.append(loss)
+            if steps is None:  # host-loop leftover rounds (the per-round
+                # path splits the rng chain itself); the carry was
+                # committed after the last scan span, so these rounds see
+                # fresh instance state.
+                for t in range(length):
+                    r = start_round + off + t
+                    if self.window_protocol == "round":
+                        avg, loss = self.run_round(r)
+                        self.net = self._server_update(self.net, avg)
+                        losses.append(loss)
+                    else:
+                        # "custom": train_one_round IS the round. Its
+                        # per-round host syncs (eager state gather/
+                        # scatter scalars, the float(loss) fetch) are
+                        # the remainder path's deliberate design — mark
+                        # them planned so sanitized() regions accept a
+                        # non-dividing window like they accept the
+                        # trailing loss fetch.
+                        with planned_transfer():
+                            losses.append(
+                                self.train_one_round(r)["train_loss"])
                 continue
             key, idx2d, _ = span_args((off, length, steps))
             batch = pf.get(key, idx2d, steps)
@@ -732,16 +873,22 @@ class FedAvgAPI(FederatedLoop):
                 self.rng, rnd = jax.random.split(self.rng)
                 keys.append(rnd)
             wmask2d = np.stack([cohorts[off + t][1] for t in range(length)])
-            weights = counts[idx2d].astype(np.float32) * wmask2d
+            weights = store.window_weights(idx2d, wmask2d)
             # planned_transfer: the per-window weights H2D rides along
             # with the superbatch as a deliberate staging copy.
             with planned_transfer():
                 weights = put(weights) if put is not None \
                     else jnp.asarray(weights)
+            extras = self._window_scan_extras(idx2d, wmask2d)
             scan = self._get_window_scan()
-            self.net, span_losses = scan(self.net, batch.x, batch.y,
-                                         batch.mask, weights,
-                                         jnp.stack(keys))
+            (self.net, extra), span_losses = scan(
+                self.net, extra, batch.x, batch.y, batch.mask, weights,
+                jnp.stack(keys), *extras)
+            # Commit per span: the donated pre-scan carry is dead, and
+            # anything host-side that runs next (remainder rounds, a
+            # checkpoint at a window boundary, eval in train_windowed)
+            # must read the scanned-out state.
+            self._window_carry_commit(extra)
             losses.extend(list(span_losses))
         # ONE end-of-loop host sync for the losses — planned by design
         # (train_rounds_pipelined contract), so mark it for sanitized()

@@ -59,3 +59,24 @@ class FedOptAPI(FedAvgAPI):
         )
         # Non-trainable state (BN stats) keeps the plain client average.
         return NetState(new_params, avg_net.model_state)
+
+    # --- windowed carry protocol: thread the server optimizer state ------
+    # _server_step is already a pure jitted optax step, so the windowed
+    # scan folds the SAME function between rounds (jit-under-scan
+    # inlines) with the optimizer state as the carried extra — FedOpt
+    # runs W rounds per dispatch bit-equal to its host loop.
+    def _window_server_update(self):
+        server_step = self._server_step
+
+        def update(net, avg, opt_state):
+            new_params, opt_state = server_step(
+                net.params, avg.params, opt_state)
+            return NetState(new_params, avg.model_state), opt_state
+
+        return update
+
+    def _window_carry_init(self):
+        return self.server_opt_state
+
+    def _window_carry_commit(self, extra) -> None:
+        self.server_opt_state = extra

@@ -27,10 +27,10 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
-from fedml_tpu.algos.ditto import _gather_stacked, _scatter_stacked
-from fedml_tpu.data.batching import gather_clients
+from fedml_tpu.core.tree import gather_stacked, scatter_stacked
 from fedml_tpu.trainer.local import tree_select
 
 
@@ -54,9 +54,22 @@ def make_scaffold_local_train(apply_fn, lr: float, local_epochs: int,
 class ScaffoldAPI(FedAvgAPI):
     """FedAvg + control variates. Plain-SGD clients only (the SCAFFOLD
     correction is defined on the SGD update; cfg.client_optimizer must be
-    'sgd'). Sampling/eval/loop scaffolding is inherited."""
+    'sgd'). Sampling/eval/loop scaffolding is inherited.
 
-    supports_streaming = False  # client controls are a device-resident [C, ...] stack
+    Streams from a ``FederatedStore`` too: the client CONTROLS stay a
+    device-resident ``[N, ...]`` stack (per-client state, not data), but
+    the round's training cohort arrives through the shared
+    :meth:`FedAvgAPI._cohort` path — host-gathered and double-buffered at
+    reference client scales. On the store, the windowed tier
+    (``train_rounds_windowed``) runs W rounds per dispatch through the
+    "custom" carry protocol below."""
+
+    #: Windowed carry protocol: the round itself consumes/produces the
+    #: carried state (server control + client-control stack), so the
+    #: scan body is custom — see _build_window_scan. Custom rounds do
+    #: not ride train_rounds_pipelined (the per-round host procedure
+    #: here IS the round: eager control gather/scatter).
+    window_protocol = "custom"
 
     def __init__(self, *args, server_lr: float = 1.0, **kw):
         super().__init__(*args, **kw)
@@ -154,10 +167,12 @@ class ScaffoldAPI(FedAvgAPI):
 
     def train_one_round(self, round_idx: int) -> Dict[str, float]:
         idx, wmask = self.sample_round(round_idx)
+        # Shared cohort path: device gather on the resident layout,
+        # host-gathered + double-buffered on the streaming store.
+        sub = self._cohort(round_idx, idx)
         idx = jnp.asarray(idx)
         wmask_a = jnp.asarray(wmask, jnp.float32)
-        sub = gather_clients(self.train_fed, idx)
-        ck_sub = _gather_stacked(self.client_controls, idx)
+        ck_sub = gather_stacked(self.client_controls, idx)
         self.rng, rnd = jax.random.split(self.rng)
         weights = sub.counts.astype(jnp.float32) * wmask_a
         self.net, self.server_control, ck_new, loss = self._scaffold_round_fn()(
@@ -169,9 +184,40 @@ class ScaffoldAPI(FedAvgAPI):
         # time it is sampled (the paper updates controls only for clients
         # that computed updates).
         trained_mask = wmask_a * (sub.counts > 0).astype(jnp.float32)
-        self.client_controls = _scatter_stacked(
+        self.client_controls = scatter_stacked(
             self.client_controls, idx, ck_new, trained_mask)
         return {"round": round_idx, "train_loss": float(loss)}
+
+    # --- windowed carry protocol ("custom"): controls ride the scan ------
+    def _build_window_scan(self):
+        """W SCAFFOLD rounds per dispatch: the scan carries
+        ``(net, (server_control, client_controls))`` and each scanned
+        round gathers its cohort's control slots, runs the stateful
+        round, and scatter-merges the updated slots back — inside the
+        body, so a client sampled twice in one window sees its own
+        earlier update (bit-equality with the host loop)."""
+        from fedml_tpu.parallel.shard import make_stateful_window_scan
+
+        return make_stateful_window_scan(self._scaffold_round_fn())
+
+    def _window_carry_init(self):
+        return (self.server_control, self.client_controls)
+
+    def _window_carry_commit(self, extra) -> None:
+        self.server_control, self.client_controls = extra
+
+    def _window_scan_extras(self, idx2d, wmask2d):
+        from fedml_tpu.obs.sanitizer import planned_transfer
+
+        # The scan body needs each round's cohort index map (control
+        # gather/scatter) and its trained mask (empty clients must not
+        # write their slot — same rule as the host loop above). Both are
+        # window-keyed host gathers over store counts; the H2D rides the
+        # window's planned staging copies.
+        trained = self.train_fed.window_trained_mask(idx2d, wmask2d)
+        with planned_transfer():
+            return (jnp.asarray(np.asarray(idx2d), jnp.int32),
+                    jnp.asarray(trained, jnp.float32))
 
     # -- checkpoint/resume: controls are run state ------------------------
     def checkpoint_extra_state(self):

@@ -77,3 +77,27 @@ def tree_select(pred, on_true, on_false):
     """Elementwise pytree select on a scalar predicate (used to gate optimizer
     updates on padded/empty batches so padding never perturbs state)."""
     return jax.tree.map(lambda t, f: jnp.where(pred, t, f), on_true, on_false)
+
+
+def gather_stacked(stacked, idx):
+    """Gather sampled-client slots from a client-stacked pytree
+    (``[N, ...]`` leaves → ``[k, ...]``). The per-client-state companion
+    of ``data.batching.gather_clients`` — Ditto's personal models,
+    SCAFFOLD's control variates."""
+    return jax.tree.map(lambda p: jnp.take(p, idx, axis=0), stacked)
+
+
+def scatter_stacked(stacked, idx, values, wmask):
+    """Write back sampled-client slots of a client-stacked pytree. Shard
+    padding repeats idx[0] with wmask 0; routing padded slots to an
+    out-of-bounds index with ``mode='drop'`` discards those writes
+    entirely — a gated merge would leave duplicate indices in the
+    scatter, whose write order XLA leaves undefined, letting a padded
+    slot's stale state clobber the real one."""
+
+    def put(old, new):
+        dustbin = old.shape[0]  # out of bounds → dropped
+        idx_eff = jnp.where(wmask > 0, idx, dustbin)
+        return old.at[idx_eff].set(new, mode="drop")
+
+    return jax.tree.map(put, stacked, values)

@@ -221,6 +221,30 @@ class FederatedStore:
             counts=jnp.asarray(ccounts, jnp.int32),
         )
 
+    def window_weights(self, window_indices, wmask) -> np.ndarray:
+        """``[W, k]`` float32 aggregation weights for a window: per-slot
+        sample counts gathered through the window's index map, zeroed at
+        padded slots (``wmask``). The window-keyed companion of
+        :meth:`gather_window` for count-derived per-round state — host
+        math (one fancy-index gather over ``counts``), shared by the
+        windowed executor's weights and the carry protocol's masks so
+        they can never drift from the per-round host loop's
+        ``sub.counts * wmask``."""
+        idx = np.asarray(window_indices)
+        return (self.counts[idx].astype(np.float32)
+                * np.asarray(wmask, np.float32))
+
+    def window_trained_mask(self, window_indices, wmask) -> np.ndarray:
+        """``[W, k]`` float32 mask of slots that actually TRAIN in their
+        round: active (un-padded) AND non-empty. Algorithms that carry
+        per-client state through the window scan (SCAFFOLD's controls)
+        gate their scatter-back on this — a sampled EMPTY client runs
+        zero real steps and must not write its state slot (same rule as
+        the per-round host loop)."""
+        idx = np.asarray(window_indices)
+        return (np.asarray(wmask, np.float32)
+                * (self.counts[idx] > 0).astype(np.float32))
+
     def _staged(self, field: str, shape: tuple, dtype) -> np.ndarray:
         """Reused staging buffer, one per (field, shape, dtype) — keyed
         by the full shape so alternating window-max buckets (giant

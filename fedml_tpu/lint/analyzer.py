@@ -247,6 +247,14 @@ class _Analyzer:
                             d = _dotted(n.func)
                             if d and "." not in d:
                                 info.calls.add(d)
+                            # partial(f, ...) binds f for a later call —
+                            # a call edge for reachability purposes (the
+                            # carry-protocol callbacks are exactly this
+                            # shape: cross=partial(psum, ...)).
+                            if _call_tail(n) == "partial" and n.args:
+                                t = _dotted(n.args[0])
+                                if t and "." not in t:
+                                    info.calls.add(t)
                 self.funcs.append(info)
                 self._func_of_node[node] = info
                 self._by_name.setdefault(name, []).append(info)
@@ -271,6 +279,13 @@ class _Analyzer:
             if tail not in _TRACING and tail not in _LOOPING:
                 continue
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                # Resolve partial(f, ...) -> f: a function handed to a
+                # tracing/looping entry point through functools.partial
+                # is traced exactly like the bare function would be
+                # (lax.scan(partial(body, cfg), ...)).
+                if isinstance(arg, ast.Call) and _call_tail(arg) == \
+                        "partial" and arg.args:
+                    arg = arg.args[0]
                 target: Optional[_FuncInfo] = None
                 if isinstance(arg, ast.Lambda):
                     target = self._func_of_node.get(arg)

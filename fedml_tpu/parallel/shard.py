@@ -182,31 +182,87 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
     return round_fn
 
 
-def make_window_scan(round_fn):
+def make_window_scan(round_fn, server_update=None):
     """``lax.scan`` over a window of PRE-GATHERED rounds: one jitted
-    dispatch runs W whole FedAvg rounds back-to-back with plain-FedAvg
-    server updates (net' = round average) between them — the windowed
+    dispatch runs W whole federated rounds back-to-back — the windowed
     execution tier's device side (host syncs drop from O(rounds) to
     O(rounds/W); see ``FedAvgAPI.train_rounds_windowed``).
+
+    The scan CARRY is ``(net, extra)`` — the windowed carry protocol.
+    Between rounds the per-algorithm ``server_update(net, avg, extra)
+    -> (net', extra')`` is folded over the round average: ``None`` (the
+    default) is plain FedAvg (``net' = avg``, ``extra`` threaded
+    untouched — pass ``extra=None``); FedOpt passes its pure jitted
+    optax server step with ``extra`` the server optimizer state, so the
+    adaptive-server algorithms ride the same one-dispatch-per-W-rounds
+    tier as plain FedAvg (the "keep state on device, talk to the host
+    less" lever of Parallel Restarted SGD, arXiv:1807.06629, applied at
+    the dispatch boundary).
 
     ``round_fn`` is the SAME per-round function the host loop dispatches
     (vmap round on one chip, shard_map round on a client mesh — jitted is
     fine, jit-under-scan inlines), so windowed rounds are bit-equal to
     host-loop rounds fed the same cohorts, weights, and rng keys.
 
-    Returns ``scan_fn(net, x, y, mask, weights, keys) -> (net', losses)``
-    with ``x/y/mask [W, C, S, B, ...]``, ``weights [W, C]`` (sample
-    counts x pad mask — used for BOTH the model average and the loss
-    weighting, as the streaming host loop does), ``keys [W, 2]`` the
-    per-round rng keys in round order."""
+    Returns ``scan_fn(net, extra, x, y, mask, weights, keys) ->
+    ((net', extra'), losses)`` with ``x/y/mask [W, C, S, B, ...]``,
+    ``weights [W, C]`` (sample counts x pad mask — used for BOTH the
+    model average and the loss weighting, as the streaming host loop
+    does), ``keys [W, 2]`` the per-round rng keys in round order."""
 
-    def scan_fn(net, x, y, mask, weights, keys):
-        def body(net, inp):
+    def scan_fn(net, extra, x, y, mask, weights, keys):
+        def body(carry, inp):
+            net, extra = carry
             xw, yw, mw, ww, kw = inp
             avg, loss = round_fn(net, xw, yw, mw, ww, ww, kw)
-            return avg, loss
+            if server_update is None:
+                return (avg, extra), loss
+            new_net, new_extra = server_update(net, avg, extra)
+            return (new_net, new_extra), loss
 
-        return jax.lax.scan(body, net, (x, y, mask, weights, keys))
+        return jax.lax.scan(body, (net, extra), (x, y, mask, weights, keys))
+
+    return scan_fn
+
+
+def make_stateful_window_scan(round_fn):
+    """Windowed scan for ``make_stateful_client_round``-shaped rounds
+    (SCAFFOLD's control variates): the carry protocol's "custom" form,
+    where the round itself consumes and produces the carried state
+    instead of a post-round ``server_update``.
+
+    The carry is ``(net, (s_global, s_clients))`` with ``s_clients`` the
+    FULL client-stacked state ``[N, ...]``. Each scanned round gathers
+    its cohort's slots, runs the stateful round, and scatter-merges the
+    updated slots back — INSIDE the scan body, because a client sampled
+    by two rounds of the same window must see round t's state update in
+    round t' > t (a per-window pre-gather/post-scatter would replay
+    stale slots for repeat clients and break host-loop bit-equality).
+
+    Returns ``scan_fn(net, extra, x, y, mask, weights, keys, idx, umask)
+    -> ((net', extra'), losses)`` where ``idx [W, k]`` is the window's
+    padded cohort index map (the same map ``gather_window`` consumed)
+    and ``umask [W, k]`` gates the scatter — only clients that actually
+    trained write their slot back (padded and empty-client slots are
+    routed out of bounds and dropped, exactly as the host loop's
+    ``scatter_stacked``)."""
+    from fedml_tpu.core.tree import gather_stacked, scatter_stacked
+
+    def scan_fn(net, extra, x, y, mask, weights, keys, idx, umask):
+        def body(carry, inp):
+            net, s_global, s_clients = carry
+            xw, yw, mw, ww, kw, iw, uw = inp
+            sub = gather_stacked(s_clients, iw)
+            new_net, new_global, new_sub, loss = round_fn(
+                net, s_global, sub, xw, yw, mw, ww, kw)
+            s_clients = scatter_stacked(s_clients, iw, new_sub, uw)
+            return (new_net, new_global, s_clients), loss
+
+        s_global, s_clients = extra
+        (net, s_global, s_clients), losses = jax.lax.scan(
+            body, (net, s_global, s_clients),
+            (x, y, mask, weights, keys, idx, umask))
+        return (net, (s_global, s_clients)), losses
 
     return scan_fn
 

@@ -63,6 +63,8 @@ def test_headline_tolerates_budget_skipped_submetrics():
            "submetrics": {
                "store_windowed": {"windowed_rounds_per_sec": 12.5,
                                   "speedup": 1.7},
+               "store_windowed_fedopt": {"windowed_rounds_per_sec": 9.25,
+                                         "speedup": 1.4},
                "flash_attention_sweep":
                    {"skipped": "wall-clock budget 1350s exhausted"},
                "transformer_fed_mfu":
@@ -71,6 +73,8 @@ def test_headline_tolerates_budget_skipped_submetrics():
     h = json.loads(json.dumps(bench.build_headline(out)))
     assert h["sub"]["store_windowed_rps"] == 12.5
     assert h["sub"]["store_windowed_speedup"] == 1.7
+    assert h["sub"]["fedopt_windowed_rps"] == 9.25
+    assert h["sub"]["fedopt_windowed_speedup"] == 1.4
     assert h["sub"]["flash_speedup_t16384"] is None
     assert h["sub"]["transformer_mfu"] is None
     assert len(json.dumps(h)) < 1024

@@ -184,6 +184,59 @@ def test_r3_suppression():
     assert len(_findings(src, "R3", suppressed=True)) == 1
 
 
+def test_r3_through_partial_scan_body_flagged():
+    """functools.partial is transparent to tracing: a function handed to
+    lax.scan through partial(f, ...) IS the scan body — the carry-
+    protocol callbacks are exactly this shape, and the r5-era call graph
+    missed them entirely."""
+    src = """
+        import jax
+        from functools import partial
+
+        def body(cfg, carry, x):
+            return carry, float(x)
+
+        def run(xs):
+            return jax.lax.scan(partial(body, 0), 0.0, xs)
+    """
+    vs = _findings(src, "R3")
+    assert len(vs) == 1 and "float()" in vs[0].message
+
+
+def test_r3_call_edge_through_partial():
+    """A hot function that BINDS a local function with partial creates a
+    call edge: the bound function is reachable from traced code."""
+    src = """
+        import jax
+        from functools import partial
+
+        def leaf(x):
+            return float(x)
+
+        def hot(x):
+            f = partial(leaf)
+            return f(x)
+
+        jitted = jax.jit(hot)
+    """
+    vs = _findings(src, "R3")
+    assert len(vs) == 1 and "leaf" in vs[0].message
+
+
+def test_partial_of_cold_function_not_marked_hot():
+    """Negative: partial-binding alone does not make a function hot —
+    only reachability from a tracing/looping entry point does."""
+    src = """
+        from functools import partial
+
+        def helper(x):
+            return float(x)
+
+        bound = partial(helper, 1)
+    """
+    assert not _findings(src, "R3")
+
+
 # ---------------------------------------------------------------------------
 # R4 — recompile hazards
 

@@ -242,10 +242,15 @@ def test_incompatible_algorithms_reject_store():
 
     x, y, parts = _classification(8, 32)
     store = FederatedStore(x, y, parts, batch_size=16)
-    for cls in (ScaffoldAPI, DittoAPI):
-        with pytest.raises(NotImplementedError, match="streaming|resident"):
-            cls(LogisticRegression(num_classes=2), store, None,
-                _cfg(8, 4, batch=16))
+    # Ditto still gathers training data client-stacked outside run_round.
+    with pytest.raises(NotImplementedError, match="streaming|resident"):
+        DittoAPI(LogisticRegression(num_classes=2), store, None,
+                 _cfg(8, 4, batch=16))
+    # SCAFFOLD streams now (controls stay device-resident; the cohort
+    # rides the shared _cohort path) — construction + a round must work.
+    sc = ScaffoldAPI(LogisticRegression(num_classes=2), store, None,
+                     _cfg(8, 4, batch=16))
+    assert np.isfinite(sc.train_one_round(0)["train_loss"])
     api = FedAvgAPI(LogisticRegression(num_classes=2), store, None,
                     _cfg(8, 8, batch=16))
     with pytest.raises(NotImplementedError, match="resident|host loop"):
@@ -280,16 +285,31 @@ def test_pipelined_rounds_match_per_round_loop():
 
 
 def test_pipelined_rounds_fedopt_subclass():
+    """FedOpt rides the 'round' carry protocol: the pipelined loop must
+    be BIT-EQUAL to its per-round host loop (same rng chain, same jitted
+    server step applied between rounds), params and opt state."""
     from fedml_tpu.algos.fedopt import FedOptAPI
 
     x, y, parts = _classification(8, 64)
-    store = FederatedStore(x, y, parts, batch_size=16)
-    cfg = _cfg(8, 4, rounds=5)
-    cfg.server_optimizer = "adam"
-    cfg.server_lr = 0.05
-    api = FedOptAPI(LogisticRegression(num_classes=2), store, None, cfg)
-    losses = api.train_rounds_pipelined(5)
-    assert len(losses) == 5 and np.isfinite(losses).all()
+
+    def mk():
+        cfg = _cfg(8, 4, rounds=5)
+        cfg.server_optimizer = "adam"
+        cfg.server_lr = 0.05
+        return FedOptAPI(LogisticRegression(num_classes=2),
+                         FederatedStore(x, y, parts, batch_size=16), None,
+                         cfg)
+
+    host, pipe = mk(), mk()
+    la = [host.train_one_round(r)["train_loss"] for r in range(5)]
+    lb = pipe.train_rounds_pipelined(5)
+    np.testing.assert_allclose(la, lb, rtol=0, atol=0)
+    for a, b in zip(jax.tree.leaves(host.net.params),
+                    jax.tree.leaves(pipe.net.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(host.server_opt_state),
+                    jax.tree.leaves(pipe.server_opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_pipelined_rounds_reject_custom_round_subclasses():

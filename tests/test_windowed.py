@@ -268,10 +268,251 @@ def test_window_prefetcher_failure_containment():
         np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
 
 
+# ---------------------------------------------------------------------------
+# Windowed carry protocol: FedOpt / SCAFFOLD / FedProx ride the scan
+
+
+def _fedopt_cfg(server_opt, rounds=9, **kw):
+    cfg = _cfg(12, 4, rounds, **kw)
+    cfg.server_optimizer = server_opt
+    cfg.server_lr = 0.05
+    return cfg
+
+
+@pytest.mark.parametrize("server_opt", ["adam", "yogi"])
+def test_windowed_fedopt_bit_equal(server_opt):
+    """The carried server-optimizer state: W FedOpt rounds per dispatch
+    (optax state threaded through the scan carry) must equal the
+    per-round host loop exactly — params AND optimizer state — with a
+    window that does not divide the round count, so the carry is
+    committed back before the host-loop remainder consumes it."""
+    from fedml_tpu.algos.fedopt import FedOptAPI
+
+    x, y, parts = _power_law()
+    host = FedOptAPI(LogisticRegression(num_classes=2),
+                     FederatedStore(x, y, parts, batch_size=16), None,
+                     _fedopt_cfg(server_opt))
+    win = FedOptAPI(LogisticRegression(num_classes=2),
+                    FederatedStore(x, y, parts, batch_size=16), None,
+                    _fedopt_cfg(server_opt))
+    la = [host.train_one_round(r)["train_loss"] for r in range(9)]
+    lb = win.train_rounds_windowed(9, window=4)
+    assert win._window_stats == {"windows": 2, "scanned_rounds": 8,
+                                 "host_rounds": 1}
+    np.testing.assert_array_equal(la, lb)
+    _assert_nets_bit_equal(host, win)
+    for a, b in zip(jax.tree.leaves(host.server_opt_state),
+                    jax.tree.leaves(win.server_opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_windowed_fedopt_mesh_bit_equal():
+    """The carry rides the shard_map round too (optimizer state
+    replicated, clients sharded)."""
+    from fedml_tpu.algos.fedopt import FedOptAPI
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    x, y, parts = _power_law(seed=2, n_clients=16)
+    mesh = client_mesh(8)
+    cfg = _cfg(16, 8, 6)
+    cfg.server_optimizer = "adam"
+    cfg.server_lr = 0.05
+    host = FedOptAPI(LogisticRegression(num_classes=2),
+                     FederatedStore(x, y, parts, batch_size=16), None,
+                     cfg, mesh=mesh)
+    cfg2 = _cfg(16, 8, 6)
+    cfg2.server_optimizer = "adam"
+    cfg2.server_lr = 0.05
+    win = FedOptAPI(LogisticRegression(num_classes=2),
+                    FederatedStore(x, y, parts, batch_size=16), None,
+                    cfg2, mesh=mesh)
+    la = [host.train_one_round(r)["train_loss"] for r in range(6)]
+    lb = win.train_rounds_windowed(6, window=3)
+    np.testing.assert_array_equal(la, lb)
+    _assert_nets_bit_equal(host, win)
+
+
+def _assert_scaffold_state_bit_equal(a, b):
+    for sa, sb in zip(jax.tree.leaves(a.server_control),
+                      jax.tree.leaves(b.server_control)):
+        np.testing.assert_array_equal(np.asarray(sa), np.asarray(sb))
+    for ca, cb in zip(jax.tree.leaves(a.client_controls),
+                      jax.tree.leaves(b.client_controls)):
+        np.testing.assert_array_equal(np.asarray(ca), np.asarray(cb))
+
+
+def test_windowed_scaffold_bit_equal():
+    """SCAFFOLD's "custom" carry: server control + the FULL client-
+    control stack ride the scan, cohort slots gathered/scattered INSIDE
+    the body (12 clients, 4/round, 9 rounds → repeat clients across
+    rounds of one window, which a per-window pre-gather/post-scatter
+    would corrupt). Params, both control states, and losses must equal
+    the streaming host loop exactly, incl. the host-loop remainder."""
+    from fedml_tpu.algos.scaffold import ScaffoldAPI
+
+    x, y, parts = _power_law()
+    host = ScaffoldAPI(LogisticRegression(num_classes=2),
+                       FederatedStore(x, y, parts, batch_size=16), None,
+                       _cfg(12, 4, 9))
+    win = ScaffoldAPI(LogisticRegression(num_classes=2),
+                      FederatedStore(x, y, parts, batch_size=16), None,
+                      _cfg(12, 4, 9))
+    la = [host.train_one_round(r)["train_loss"] for r in range(9)]
+    lb = win.train_rounds_windowed(9, window=4)
+    assert win._window_stats["scanned_rounds"] == 8
+    np.testing.assert_array_equal(la, lb)
+    _assert_nets_bit_equal(host, win)
+    _assert_scaffold_state_bit_equal(host, win)
+
+
+def test_windowed_scaffold_mesh_bit_equal():
+    """SCAFFOLD windowed on a client mesh: the stateful shard_map round
+    under the scan, control gather/scatter crossing shards."""
+    from fedml_tpu.algos.scaffold import ScaffoldAPI
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    x, y, parts = _power_law(seed=2, n_clients=16)
+    mesh = client_mesh(8)
+    host = ScaffoldAPI(LogisticRegression(num_classes=2),
+                       FederatedStore(x, y, parts, batch_size=16), None,
+                       _cfg(16, 8, 6), mesh=mesh)
+    win = ScaffoldAPI(LogisticRegression(num_classes=2),
+                      FederatedStore(x, y, parts, batch_size=16), None,
+                      _cfg(16, 8, 6), mesh=mesh)
+    la = [host.train_one_round(r)["train_loss"] for r in range(6)]
+    lb = win.train_rounds_windowed(6, window=3)
+    np.testing.assert_array_equal(la, lb)
+    _assert_nets_bit_equal(host, win)
+    _assert_scaffold_state_bit_equal(host, win)
+
+
+def test_scaffold_streaming_matches_resident():
+    """ScaffoldAPI now streams: the same federation through a
+    FederatedStore host loop must train bit-equal to the resident-layout
+    host loop (the controls stay device-resident either way; only the
+    data path differs, and it is step-count prefix-stable)."""
+    from fedml_tpu.algos.scaffold import ScaffoldAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+
+    x, y, parts = _power_law(seed=8)
+    res = ScaffoldAPI(LogisticRegression(num_classes=2),
+                      build_federated_arrays(x, y, parts, batch_size=16),
+                      None, _cfg(12, 4, 4))
+    st = ScaffoldAPI(LogisticRegression(num_classes=2),
+                     FederatedStore(x, y, parts, batch_size=16), None,
+                     _cfg(12, 4, 4))
+    la = [res.train_one_round(r)["train_loss"] for r in range(4)]
+    lb = [st.train_one_round(r)["train_loss"] for r in range(4)]
+    np.testing.assert_array_equal(la, lb)
+    _assert_nets_bit_equal(res, st)
+    _assert_scaffold_state_bit_equal(res, st)
+
+
+def test_windowed_fedprox_bit_equal():
+    """FedProx rides the protocol with NO carry: the μ term lives in the
+    local trainer the scan replays."""
+    from fedml_tpu.algos.fedprox import FedProxAPI
+
+    x, y, parts = _power_law(seed=9)
+    host = FedProxAPI(LogisticRegression(num_classes=2),
+                      FederatedStore(x, y, parts, batch_size=16), None,
+                      _cfg(12, 4, 6, fedprox_mu=0.1))
+    win = FedProxAPI(LogisticRegression(num_classes=2),
+                     FederatedStore(x, y, parts, batch_size=16), None,
+                     _cfg(12, 4, 6, fedprox_mu=0.1))
+    la = [host.train_one_round(r)["train_loss"] for r in range(6)]
+    lb = win.train_rounds_windowed(6, window=3)
+    np.testing.assert_array_equal(la, lb)
+    _assert_nets_bit_equal(host, win)
+
+
+def test_windowed_fedopt_checkpoint_restore_mid_run():
+    """Checkpoint at a window boundary mid-run: the carried server
+    optimizer state is committed back to the instance at every boundary,
+    so save → fresh api → restore → continue windowed must equal one
+    uninterrupted host-loop run exactly."""
+    from fedml_tpu.algos.fedopt import FedOptAPI
+    from fedml_tpu.obs.checkpoint import (CheckpointManager, restore_run,
+                                          save_run)
+
+    x, y, parts = _power_law(seed=10)
+
+    def mk():
+        return FedOptAPI(LogisticRegression(num_classes=2),
+                         FederatedStore(x, y, parts, batch_size=16), None,
+                         _fedopt_cfg("adam", rounds=8))
+
+    host = mk()
+    la = [host.train_one_round(r)["train_loss"] for r in range(8)]
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        a = mk()
+        lb = a.train_rounds_windowed(4, window=4)  # one whole window
+        mgr = CheckpointManager(d)
+        save_run(mgr, a, 3)  # after round 3 = the window boundary
+        b = mk()  # fresh: different params until restore
+        nxt = restore_run(mgr, b)
+        mgr.close()
+        assert nxt == 4
+        lb += b.train_rounds_windowed(4, start_round=4, window=4)
+    np.testing.assert_array_equal(la, lb)
+    _assert_nets_bit_equal(host, b)
+    for x1, x2 in zip(jax.tree.leaves(host.server_opt_state),
+                      jax.tree.leaves(b.server_opt_state)):
+        np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+
+
+def test_windowed_fedopt_steady_state_sanitized():
+    """Acceptance pin: after warmup, windowed FedOpt (uniform buckets)
+    runs under the sanitizer with ZERO jit-cache misses and no unplanned
+    transfers — the carried optimizer state stays on device."""
+    from fedml_tpu.algos.fedopt import FedOptAPI
+    from fedml_tpu.obs.sanitizer import sanitized
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(12 * 32, 6).astype(np.float32)
+    y = (x @ rng.randn(6) > 0).astype(np.int32)
+    parts = {c: np.arange(c * 32, (c + 1) * 32) for c in range(12)}
+    api = FedOptAPI(LogisticRegression(num_classes=2),
+                    FederatedStore(x, y, parts, batch_size=8), None,
+                    _fedopt_cfg("adam", rounds=32, batch=8))
+    api.train_rounds_windowed(8, start_round=0, window=4)  # warmup
+    with sanitized() as rep:
+        losses = api.train_rounds_windowed(8, start_round=8, window=4)
+    assert len(losses) == 8
+    assert rep.compiles == 0
+
+
+def test_windowed_scaffold_steady_state_sanitized():
+    """Acceptance pin for the "custom" carry: steady-state windowed
+    SCAFFOLD — control gather/scatter inside the scan, idx/mask aux H2D
+    marked planned — zero recompiles, no unplanned transfers. Uses a
+    NON-dividing window: the host-loop remainder round runs the custom
+    per-round procedure, whose deliberate syncs must be planned too
+    (regression: the remainder used to trip the transfer guard)."""
+    from fedml_tpu.algos.scaffold import ScaffoldAPI
+    from fedml_tpu.obs.sanitizer import sanitized
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(12 * 32, 6).astype(np.float32)
+    y = (x @ rng.randn(6) > 0).astype(np.int32)
+    parts = {c: np.arange(c * 32, (c + 1) * 32) for c in range(12)}
+    api = ScaffoldAPI(LogisticRegression(num_classes=2),
+                      FederatedStore(x, y, parts, batch_size=8), None,
+                      _cfg(12, 4, 32, batch=8))
+    api.train_rounds_windowed(9, start_round=0, window=4)  # warmup
+    with sanitized() as rep:
+        losses = api.train_rounds_windowed(9, start_round=9, window=4)
+    assert len(losses) == 9
+    assert rep.compiles == 0
+
+
 def test_windowed_guards():
     """Incompatible configurations refuse loudly instead of silently
-    changing semantics."""
-    from fedml_tpu.algos.scaffold import ScaffoldAPI
+    changing semantics — keyed on the windowed CARRY PROTOCOL, not
+    type-identity lists (FedOpt/SCAFFOLD/FedProx now ride the scan; see
+    the bit-equality tests below)."""
     from fedml_tpu.data.batching import build_federated_arrays
 
     x, y, parts = _power_law(seed=6)
@@ -288,20 +529,68 @@ def test_windowed_guards():
                          pow_d_candidates=8))
     with pytest.raises(NotImplementedError, match="random"):
         api.train_rounds_windowed(4)
-    # Custom-round subclasses cannot ride the plain-FedAvg scan (they
-    # reject the store outright at construction).
-    with pytest.raises(NotImplementedError, match="streaming|resident"):
-        ScaffoldAPI(LogisticRegression(num_classes=2),
-                    FederatedStore(x, y, parts, batch_size=16), None,
-                    _cfg(12, 12, 4))
-    # Stateful server optimizers stream fine through the host loop but
-    # cannot ride the windowed scan (it applies net' = avg between
-    # rounds).
-    from fedml_tpu.algos.fedopt import FedOptAPI
 
-    cfg = _cfg(12, 4, 4)
-    cfg.server_optimizer = "adam"
-    api = FedOptAPI(LogisticRegression(num_classes=2),
-                    FederatedStore(x, y, parts, batch_size=16), None, cfg)
-    with pytest.raises(NotImplementedError, match="server"):
+    # A stateful _server_update override WITHOUT its pure windowed form:
+    # the protocol refuses — inheriting the plain-average fold would
+    # silently change the algorithm inside the scan.
+    class _StatefulUpdate(FedAvgAPI):
+        def _server_update(self, old_net, avg_net):
+            self._booster = getattr(self, "_booster", 0) + 1
+            return avg_net
+
+    api = _StatefulUpdate(LogisticRegression(num_classes=2),
+                          FederatedStore(x, y, parts, batch_size=16), None,
+                          _cfg(12, 4, 4))
+    with pytest.raises(NotImplementedError, match="pure windowed form"):
+        api.train_rounds_windowed(4)
+
+    # A custom per-round procedure that inherits window_protocol="round":
+    # replaying run_round would silently drop it — refuse and point at
+    # the protocol.
+    class _CustomRound(FedAvgAPI):
+        def train_one_round(self, round_idx):
+            out = super().train_one_round(round_idx)
+            out["extra_metric"] = 0.0
+            return out
+
+    api = _CustomRound(LogisticRegression(num_classes=2),
+                       FederatedStore(x, y, parts, batch_size=16), None,
+                       _cfg(12, 4, 4))
+    with pytest.raises(NotImplementedError, match="customizes the round"):
+        api.train_rounds_windowed(4)
+    with pytest.raises(NotImplementedError, match="customizes the round"):
+        api.train_rounds_pipelined(4)
+
+    # "custom" WITHOUT a custom scan body would inherit the plain round
+    # replay — refuse (symmetric to the inherited-"round" check).
+    class _CustomSansScan(FedAvgAPI):
+        window_protocol = "custom"
+
+    api = _CustomSansScan(LogisticRegression(num_classes=2),
+                          FederatedStore(x, y, parts, batch_size=16), None,
+                          _cfg(12, 4, 4))
+    with pytest.raises(NotImplementedError, match="_build_window_scan"):
+        api.train_rounds_windowed(4)
+
+    # Carry flowing IN without a commit hook: the scanned-out state
+    # would be silently discarded — refuse.
+    from fedml_tpu.algos.scaffold import ScaffoldAPI
+
+    class _CustomSansCommit(ScaffoldAPI):
+        _window_carry_commit = FedAvgAPI._window_carry_commit
+
+    api = _CustomSansCommit(LogisticRegression(num_classes=2),
+                            FederatedStore(x, y, parts, batch_size=16),
+                            None, _cfg(12, 4, 4))
+    with pytest.raises(NotImplementedError, match="_window_carry_commit"):
+        api.train_rounds_windowed(4)
+
+    # window_protocol=None opts out entirely.
+    class _OptedOut(FedAvgAPI):
+        window_protocol = None
+
+    api = _OptedOut(LogisticRegression(num_classes=2),
+                    FederatedStore(x, y, parts, batch_size=16), None,
+                    _cfg(12, 4, 4))
+    with pytest.raises(NotImplementedError, match="opts out"):
         api.train_rounds_windowed(4)
