@@ -591,6 +591,43 @@ def bench_store_windowed_fedopt():
                              / synced["rounds_per_sec"], 3)}
 
 
+def bench_robust_agg():
+    """Byzantine-robust aggregation cost (docs/ROBUSTNESS.md): windowed
+    streaming rounds with aggregator ∈ {mean, coord_median, krum} on ONE
+    moderate federation (300 power-law writers, FEMNIST-shaped CNN,
+    10/round, window 8) — same store, same seeded cohorts, only the
+    server reduction changes, so the RPS deltas are the aggregators'
+    price. Sized to fit the per-section cap (three sides, each with its
+    own warmup + floor-calibrated blocks). Headline scalar
+    ``robust_agg_overhead`` = mean_rps / krum_rps — krum is the
+    expensive end of the zoo (pairwise distances over the cohort), so
+    this bounds what turning the defense on can cost."""
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.models.cnn import CNNDropOut
+
+    n_clients, batch, cpr, window = 300, 20, 10, 8
+    out = {"clients": n_clients, "window": window}
+    rps = {}
+    for agg in ("mean", "coord_median", "krum"):
+        _check_section_deadline()
+        store, counts = _synthetic_femnist_store(n_clients, batch, seed=2)
+        cfg = FedConfig(client_num_in_total=n_clients,
+                        client_num_per_round=cpr,
+                        comm_round=100_000,  # > any window schedule
+                        epochs=1, batch_size=batch, lr=0.1, aggregator=agg)
+        api = FedAvgAPI(CNNDropOut(num_classes=62), store, None, cfg)
+        _warm_store_buckets(api, store, counts, cpr, batch)
+        timed = _timed_windowed_blocks(api, window, blocks=3,
+                                       min_block_s=2.0)
+        rps[agg] = timed["rounds_per_sec"]
+        out[agg] = timed
+    out["robust_agg_overhead"] = round(rps["mean"] / rps["krum"], 3)
+    out["coord_median_overhead"] = round(rps["mean"] / rps["coord_median"],
+                                         3)
+    return out
+
+
 def bench_stackoverflow_342k():
     """BASELINE.md's largest row at its TRUE scale: 342,477 clients
     (the reference enumerates exactly that many stackoverflow_nwp
@@ -1005,6 +1042,7 @@ def main():
     for name, fn in (("femnist_cnn_3400clients", bench_femnist_cnn_3400),
                      ("store_windowed", bench_store_windowed),
                      ("store_windowed_fedopt", bench_store_windowed_fedopt),
+                     ("robust_agg", bench_robust_agg),
                      ("stackoverflow_342k", bench_stackoverflow_342k),
                      ("vit_cifar_shaped", bench_vit),
                      ("resnet56_batch128_tuned", bench_resnet56_b128),
@@ -1117,6 +1155,8 @@ def build_headline(out, full_path="docs/bench_r5_local.json"):
                                            "windowed_rounds_per_sec"),
             "fedopt_windowed_speedup": _scalar("store_windowed_fedopt",
                                                "speedup"),
+            "robust_agg_overhead": _scalar("robust_agg",
+                                           "robust_agg_overhead"),
             "stackoverflow_342k_rps": _scalar("stackoverflow_342k",
                                               "rounds_per_sec"),
             "vit_sps": _scalar("vit_cifar_shaped", "samples_per_sec"),

@@ -41,6 +41,22 @@ class FedConfig:
     # poisoned data, e.g. data.loaders.edge_case.make_backdoor_dataset).
     attack_freq: int = 0
     attack_num_adversaries: int = 1
+    # Byzantine-robust server aggregation (core/robust_agg — new
+    # capability; the reference's only reduction is the weighted mean):
+    # "mean" (the bit-equal fast path), "coord_median",
+    # "trimmed_mean<beta>", "krum<f>", "multi_krum<f>-<m>",
+    # "geometric_median<iters>". Rides every execution tier (host loop,
+    # pipelined, windowed, on-device scan); on a client mesh non-mean
+    # aggregators all_gather the cohort. docs/ROBUSTNESS.md.
+    aggregator: str = "mean"
+    # Device-side update-corruption drill (core/faults.UpdateCorruptor
+    # .device_fn, wired through FedAvgRobustAPI): adversary clients'
+    # trained updates are corrupted INSIDE the jitted round — "none",
+    # "sign_flip", "scale", "nan", or "random"; corrupt_scale is the
+    # mode's magnitude. Pair with cfg.aggregator / nan_guard to run
+    # attack-vs-defense drills in the windowed tier.
+    corrupt_mode: str = "none"
+    corrupt_scale: float = 10.0
     # Hierarchical FL (fedml_experiments/standalone/hierarchical_fl/main.py
     # flag --group_comm_round)
     group_comm_round: int = 1

@@ -20,6 +20,7 @@ import numpy as np
 
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.loop import FederatedLoop, eval_segments
+from fedml_tpu.core.robust_agg import make_aggregator
 from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.obs.sanitizer import planned_transfer
 from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
@@ -115,6 +116,30 @@ class FedAvgAPI(FederatedLoop):
 
         self._loss_fn = loss_fn
         self._nan_guard = nan_guard
+        # Byzantine-robust server aggregation (core/robust_agg): resolved
+        # once; "mean" keeps the existing weighted-mean reduction
+        # bit-equal on every tier. Guards mirror the windowed carry
+        # protocol's philosophy — refuse loudly instead of silently
+        # keeping a subclass's own aggregation.
+        self._aggregator = make_aggregator(getattr(cfg, "aggregator", "mean"))
+        if not self._aggregator.is_mean and (
+                type(self).train_one_round is not FedAvgAPI.train_one_round
+                or type(self).run_round is not FederatedLoop.run_round
+                or type(self)._make_vmap_round is not FedAvgAPI._make_vmap_round
+                or type(self)._make_sharded_round
+                is not FedAvgAPI._make_sharded_round):
+            raise NotImplementedError(
+                f"{type(self).__name__} customizes the round or its "
+                f"aggregation; cfg.aggregator={cfg.aggregator!r} only rides "
+                "the FedAvg family's shared round builders (a custom round "
+                "would silently keep its own aggregation)")
+        if (getattr(cfg, "corrupt_mode", "none") != "none"
+                and type(self)._corruptor is FedAvgAPI._corruptor):
+            raise NotImplementedError(
+                f"cfg.corrupt_mode={cfg.corrupt_mode!r} drives the device-"
+                "side corruption drill, which needs adversary wiring "
+                "(per-round adversary masks); use FedAvgRobustAPI — on "
+                f"{type(self).__name__} the flag would be silently inert")
         self.n_shards = 1 if mesh is None else int(mesh.shape[mesh.axis_names[0]])
         self._client_lr = None
         self.set_client_lr(cfg.lr)
@@ -166,7 +191,11 @@ class FedAvgAPI(FederatedLoop):
                 self.local_train, transform, guard
             )
 
-            if not self._streaming:
+            if not self._streaming and self._corruptor() is None:
+                # (The corruption drill's rounds take a trailing per-
+                # round adversary-mask operand run_round computes host-
+                # side; the fused gather-inside-jit path has no slot for
+                # it, so drilled rounds use the plain round_fn path.)
                 # Single-device: fuse the client gather + weight
                 # computation into the jitted round. Dispatching the takes
                 # eagerly costs ~40% of the round wall-clock on a real chip
@@ -209,13 +238,34 @@ class FedAvgAPI(FederatedLoop):
         no post-round eval pass is needed."""
         return make_vmap_round(
             local_train, client_transform=transform, nan_guard=guard,
-            with_client_losses=self.cfg.client_selection == "oort")
+            with_client_losses=self.cfg.client_selection == "oort",
+            aggregator=self._round_aggregator(),
+            corruptor=self._corruptor())
 
     def _make_sharded_round(self, local_train, mesh, transform, guard):
         return make_sharded_round(
             local_train, mesh, mesh.axis_names[0],
             client_transform=transform, nan_guard=guard,
-            with_client_losses=self.cfg.client_selection == "oort")
+            with_client_losses=self.cfg.client_selection == "oort",
+            aggregator=self._round_aggregator(),
+            corruptor=self._corruptor())
+
+    def _round_aggregator(self):
+        """The aggregator handed to the round builders: ``None`` for mean
+        (the builders' weighted-mean fast path — per-shard partial sums +
+        psum on a mesh — stays byte-for-byte the compiled program it was
+        before the protocol existed), the resolved ``core.robust_agg``
+        callable otherwise."""
+        return None if self._aggregator.is_mean else self._aggregator
+
+    def _corruptor(self):
+        """Device-side update-corruption hook for the attack drill
+        (``None`` = no corruption; rounds keep their 7-operand
+        signature). FedAvgRobustAPI builds
+        ``UpdateCorruptor.device_fn()`` from ``cfg.corrupt_mode`` and
+        supplies the per-round adversary masks via ``_round_aux`` /
+        ``_window_scan_extras``."""
+        return None
 
     def _build_local_train(self, optimizer, loss_fn):
         return make_local_train_fn_from_cfg(self.fns.apply, optimizer,
@@ -632,11 +682,15 @@ class FedAvgAPI(FederatedLoop):
     def _window_server_update(self):
         """The PURE form of :meth:`_server_update` for the windowed scan:
         ``None`` means plain FedAvg (``net' = round average``, no carry);
-        otherwise a jit-traceable ``(net, avg, extra) -> (net', extra')``
-        with ``extra`` the carried server state. A subclass that
-        overrides ``_server_update`` (host-loop, may touch ``self``) MUST
-        also override this hook — inheriting the plain-average fold
-        would silently change its semantics inside the scan."""
+        otherwise a jit-traceable ``(net, avg, extra, key) ->
+        (net', extra')`` with ``extra`` the carried server state and
+        ``key`` the round's rng key (the same key ``run_round`` split for
+        that round — randomized server updates fold_in from it, see
+        FedAvgRobustAPI's weak-DP noise; deterministic updates like
+        FedOpt's ignore it). A subclass that overrides
+        ``_server_update`` (host-loop, may touch ``self``) MUST also
+        override this hook — inheriting the plain-average fold would
+        silently change its semantics inside the scan."""
         if type(self)._server_update is not FedAvgAPI._server_update:
             raise NotImplementedError(
                 f"{type(self).__name__} overrides _server_update without "
@@ -657,9 +711,28 @@ class FedAvgAPI(FederatedLoop):
 
     def _window_scan_extras(self, idx2d, wmask2d):
         """Extra per-round scanned inputs, as a tuple of ``[W, ...]``
-        device arrays ("custom" protocol aux — SCAFFOLD passes the
-        window's cohort index map and its scatter mask). Default: none."""
+        device arrays — "custom" protocol aux (SCAFFOLD passes the
+        window's cohort index map and its scatter mask) OR trailing
+        round operands for a "round"-protocol round built with extras
+        (the corruption drill's ``[W, C]`` adversary mask, forwarded by
+        ``make_window_scan`` into each scanned ``round_fn`` call).
+        Default: none."""
         return ()
+
+    def _get_window_put(self):
+        """The (cached) mesh layout ``put`` for window-scoped device
+        arrays — the superbatch, the per-window weights, and any
+        ``_window_scan_extras`` that must arrive client-sharded. ``None``
+        on a single device (plain ``jnp.asarray`` suffices there)."""
+        if self.mesh is None:
+            return None
+        put = getattr(self, "_window_put", None)
+        if put is None:
+            from fedml_tpu.parallel.shard import window_put
+
+            put = self._window_put = window_put(
+                self.mesh, self.mesh.axis_names[0])
+        return put
 
     def _build_window_scan(self):
         """The UNJITTED window scan for this algorithm —
@@ -814,14 +887,7 @@ class FedAvgAPI(FederatedLoop):
             "host_rounds": n_rounds - sum(s[1] for s in scan_spans),
         }
 
-        put = None
-        if self.mesh is not None:
-            put = getattr(self, "_window_put", None)
-            if put is None:
-                from fedml_tpu.parallel.shard import window_put
-
-                put = self._window_put = window_put(
-                    self.mesh, self.mesh.axis_names[0])
+        put = self._get_window_put()
         pf = getattr(self, "_window_prefetcher", None)
         if pf is None or pf.store is not store or pf.put is not put:
             pf = self._window_prefetcher = WindowPrefetcher(store, put=put)

@@ -68,7 +68,10 @@ class FedOptAPI(FedAvgAPI):
     def _window_server_update(self):
         server_step = self._server_step
 
-        def update(net, avg, opt_state):
+        def update(net, avg, opt_state, key):
+            # key: the round's rng key (protocol slot for randomized
+            # server updates) — the optax step is deterministic.
+            del key
             new_params, opt_state = server_step(
                 net.params, avg.params, opt_state)
             return NetState(new_params, avg.model_state), opt_state

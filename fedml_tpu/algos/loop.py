@@ -70,6 +70,15 @@ class FederatedLoop:
         idx, wmask = pad_to_multiple(idx, self.n_shards)
         return idx, wmask
 
+    def _round_aux(self, round_idx: int, idx, wmask):
+        """Extra trailing operands for ``round_fn`` beyond the standard
+        seven — the hook the device-side corruption drill fills with its
+        per-client adversary mask (``FedAvgRobustAPI``). Default: none.
+        Rounds built without the matching builder option keep their
+        7-operand signature, so this must return ``()`` unless the
+        subclass also configured its round to consume the extras."""
+        return ()
+
     def run_round(self, round_idx: int):
         """One sampled round through ``round_fn``: gather client shards,
         sample-count weights (padded slots weight 0), fresh round rng.
@@ -81,14 +90,22 @@ class FederatedLoop:
         ``FederatedStore`` (``self._streaming``), the cohort was gathered
         on host (double-buffered) and the round consumes it directly."""
         self.rng, rnd_rng = jax.random.split(self.rng)
+        # Server updates that need a round-keyed randomness stream
+        # (FedAvgRobust's weak-DP noise) fold_in from THIS key instead of
+        # splitting self.rng again: the windowed tier reproduces exactly
+        # this per-round key chain, so fold_in children are bit-equal
+        # across tiers (the PR-2 prefix-stability discipline).
+        self._last_round_key = rnd_rng
         idx, wmask = self.sample_round(round_idx)
+        aux = self._round_aux(round_idx, idx, wmask)
         if getattr(self, "_streaming", False):
             sub = self._stream_cohort(round_idx, idx)
             weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
             return self._unpack_round(self.round_fn(
-                self.net, sub.x, sub.y, sub.mask, weights, weights, rnd_rng
+                self.net, sub.x, sub.y, sub.mask, weights, weights, rnd_rng,
+                *aux
             ))
-        if self.round_fn_fused is not None:
+        if self.round_fn_fused is not None and not aux:
             return self._unpack_round(self.round_fn_fused(
                 self.net, self.train_fed,
                 jnp.asarray(idx), jnp.asarray(wmask), rnd_rng))
@@ -97,7 +114,8 @@ class FederatedLoop:
         sub = gather_clients(self.train_fed, idx)
         weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
         return self._unpack_round(self.round_fn(
-            self.net, sub.x, sub.y, sub.mask, weights, weights, rnd_rng
+            self.net, sub.x, sub.y, sub.mask, weights, weights, rnd_rng,
+            *aux
         ))
 
     def _unpack_round(self, out):

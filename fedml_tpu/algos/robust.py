@@ -17,16 +17,45 @@ main_fedavg_robust.py:120), and :func:`attack_success_rate` measures the
 model on a targeted test set (``test_target_accuracy``,
 FedAvgRobustAggregator.py:270). tests/test_backdoor.py composes the two
 and shows clipping+noise actually suppressing the attack.
+
+Beyond reference parity, this class is now the algorithm layer of the
+Byzantine-robustness stack (docs/ROBUSTNESS.md):
+
+- ``cfg.aggregator`` (inherited from FedAvgAPI) swaps the server
+  reduction for a robust one — coord_median / trimmed_mean / krum /
+  geometric_median (``core/robust_agg``) — composable with the norm
+  clip this class installs as its client transform;
+- ``cfg.corrupt_mode`` arms the DEVICE-SIDE corruption drill: the
+  adversary clients' trained updates are corrupted inside the jitted
+  round (``UpdateCorruptor.device_fn``, mask-driven), so
+  attack-vs-defense drills run on every execution tier, including the
+  windowed ``lax.scan``;
+- the weak-DP noise stream is now keyed by ``fold_in`` on the ROUND's
+  rng key instead of a carried ``self.rng`` split chain (the PR-2
+  prefix-stability discipline), which is what lets robust runs ride
+  ``train_rounds_windowed`` / ``train_rounds_pipelined`` bit-equal to
+  the host loop instead of flooring at per-round dispatch RTT.
 """
 
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.core.robustness import add_gaussian_noise, norm_diff_clipping
 from fedml_tpu.trainer.local import NetState
+
+#: fold_in constant reserving the weak-DP noise stream off each round's
+#: rng key. The per-client training streams fork at the SAME level as
+#: ``fold_in(round_key, slot)`` with slot ∈ [0, cohort) — so this tag
+#: sits at the top of the int32 range, unreachable by any cohort slot
+#: index (a small constant like 0x3D would be bit-identical to client
+#: slot 61's stream root in a 62+-client round). The transform (0x7F)
+#: and corruptor (0xC0) forks are second-level (folded on the per-client
+#: key), so they cannot collide with this either.
+_NOISE_TAG = 0x7FFFFF3D
 
 
 def attack_success_rate(api, x_targeted, y_target, batch_size: int = 128):
@@ -46,7 +75,9 @@ class FedAvgRobustAPI(FedAvgAPI):
     def __init__(self, *args, adversary_clients=None, **kwargs):
         super().__init__(*args, **kwargs)
         cfg = self.cfg
-        if getattr(cfg, "attack_freq", 0) and adversary_clients is None:
+        armed = (getattr(cfg, "attack_freq", 0)
+                 or getattr(cfg, "corrupt_mode", "none") != "none")
+        if armed and adversary_clients is None:
             k = max(1, int(getattr(cfg, "attack_num_adversaries", 1)))
             if k > cfg.client_num_in_total:
                 # A negative id here would silently gather client 0's
@@ -111,10 +142,74 @@ class FedAvgRobustAPI(FedAvgAPI):
 
         return clip
 
+    # --- device-side corruption drill (cfg.corrupt_mode) -----------------
+    def _corruptor(self):
+        """Build (once) the mask-driven device corruptor from
+        ``cfg.corrupt_mode`` — consulted by the base round builders
+        during ``set_client_lr`` (which runs inside ``super().__init__``,
+        hence cfg-only: ``adversary_clients`` is not resolved yet; the
+        MASKS are computed per round in :meth:`_round_aux` /
+        :meth:`_window_scan_extras`, after construction finished)."""
+        mode = getattr(self.cfg, "corrupt_mode", "none")
+        if mode == "none":
+            return None
+        fn = getattr(self, "_device_corruptor", None)
+        if fn is None:
+            from fedml_tpu.core.faults import UpdateCorruptor
+
+            fn = self._device_corruptor = UpdateCorruptor(
+                mode, scale=self.cfg.corrupt_scale).device_fn()
+        return fn
+
+    def _adv_mask(self, idx, wmask) -> np.ndarray:
+        """Host math: 1.0 at cohort slots held by an adversary client
+        (padded slots masked out — they repeat slot 0's id with weight 0
+        and must not be corrupted into the order statistics)."""
+        return (np.isin(np.asarray(idx), self.adversary_clients)
+                .astype(np.float32) * np.asarray(wmask, np.float32))
+
+    def _round_aux(self, round_idx: int, idx, wmask):
+        if self._corruptor() is None:
+            return ()
+        return (jnp.asarray(self._adv_mask(idx, wmask)),)
+
+    def _window_scan_extras(self, idx2d, wmask2d):
+        if self._corruptor() is None:
+            return ()
+        from fedml_tpu.obs.sanitizer import planned_transfer
+
+        # The [W, C] adversary mask is scanned alongside the weights and
+        # forwarded into each round_fn call (make_window_scan *aux) — on
+        # a mesh it ships client-sharded like every per-round [C] input.
+        adv = self._adv_mask(idx2d, wmask2d)
+        put = self._get_window_put()
+        with planned_transfer():
+            return ((put(adv) if put is not None else jnp.asarray(adv)),)
+
+    # --- server update: weak-DP noise, round-keyed -----------------------
     def _server_update(self, old_net, avg_net):
         if self.cfg.robust_stddev > 0:
-            self.rng, sub = jax.random.split(self.rng)
+            # fold_in on the ROUND's key (stored by run_round) — not a
+            # self.rng split chain: the windowed scan reproduces the same
+            # per-round keys, so the noise stream is bit-equal across
+            # tiers and never blocks the scan on carried host state.
+            key = jax.random.fold_in(self._last_round_key, _NOISE_TAG)
             return NetState(
-                self._noise(avg_net.params, sub), avg_net.model_state
+                self._noise(avg_net.params, key), avg_net.model_state
             )
         return avg_net
+
+    def _window_server_update(self):
+        """Windowed carry protocol ("round"): the weak-DP noise is a pure
+        fold over the round average, keyed off the scanned round key —
+        no carry needed. With ``robust_stddev == 0`` the server update is
+        the plain average and the scan folds nothing."""
+        if self.cfg.robust_stddev <= 0:
+            return None
+        noise = self._noise  # jitted; jit-under-scan inlines
+
+        def update(net, avg, extra, key):
+            p = noise(avg.params, jax.random.fold_in(key, _NOISE_TAG))
+            return NetState(p, avg.model_state), extra
+
+        return update

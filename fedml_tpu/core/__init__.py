@@ -12,6 +12,7 @@ from fedml_tpu.core.tree import (
 )
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.core.aggregate import weighted_average, pseudo_gradient
+from fedml_tpu.core.robust_agg import make_aggregator
 
 __all__ = [
     "tree_add",
@@ -27,4 +28,5 @@ __all__ = [
     "sample_clients",
     "weighted_average",
     "pseudo_gradient",
+    "make_aggregator",
 ]

@@ -9,7 +9,11 @@ become framework subsystems:
 - ``DropoutInjector`` — per-round Bernoulli client dropout (the TA dropout
   generalized to every algorithm: returns a weight mask);
 - ``UpdateCorruptor`` — adversarial/fault update injection for robustness
-  testing (sign-flip, gradient-scaling, NaN faults);
+  testing (sign-flip, gradient-scaling, NaN faults); its
+  :meth:`~UpdateCorruptor.device_fn` form is mask-driven and pure, so
+  attack-vs-defense drills run INSIDE the jitted rounds on every
+  execution tier, windowed scan included (cfg.corrupt_mode via
+  FedAvgRobustAPI; docs/ROBUSTNESS.md);
 - ``HeartbeatMonitor`` — wall-clock failure detector for the message-passing
   path: ranks check in, anything silent past ``timeout_s`` is reported
   failed instead of hanging the federation;
@@ -39,12 +43,17 @@ class DropoutInjector:
 
     def round_mask(self, round_idx: int, n_clients: int) -> np.ndarray:
         """[n] float mask — 0.0 = dropped this round. Guarantees at least
-        one survivor (an all-dropped round would be a silent no-op; keep the
-        lowest-index client instead, deterministically)."""
+        one survivor: an all-dropped round would be a silent no-op, so one
+        client is revived — drawn UNIFORMLY from the same round-keyed RNG
+        (still deterministic per (seed, round)). Always reviving client 0
+        would be a systematic participation bias at high dropout rates —
+        the same bias class FedAvgRobustAPI's eviction fix addressed
+        (algos/robust.py): client 0 would train in every all-dropped
+        round while its peers never do."""
         rng = np.random.RandomState((self.seed * 1_000_003 + round_idx) % (2**31))
         mask = (rng.rand(n_clients) >= self.p).astype(np.float32)
         if mask.sum() == 0:
-            mask[0] = 1.0
+            mask[rng.randint(n_clients)] = 1.0
         return mask
 
 
@@ -91,6 +100,59 @@ class UpdateCorruptor:
         if hasattr(net, "params"):
             return type(net)(new, net.model_state)
         return new
+
+    def device_fn(self):
+        """The device-side, MASK-DRIVEN variant of :meth:`corrupt` for
+        the jitted rounds: a pure ``(global_net, client_nets, adv, rngs)
+        -> client_nets`` over the CLIENT-STACKED trained models, where
+        ``adv [C] > 0`` flags the adversary slots and ``rngs [C]`` are
+        per-client streams (consumed by the "random" mode — forked by
+        the round builder with a corruptor-reserved fold_in constant,
+        ``parallel.shard.run_clients_guarded``).
+
+        Branchless by construction — corruption is computed for every
+        client and selected per-slot with ``tree_select`` — so it traces
+        into vmap/shard_map and, critically, into the windowed
+        ``lax.scan`` body: attack-vs-defense drills run in the windowed
+        tier itself instead of flooring at host-loop RTT. No host state
+        is read or mutated (unlike :meth:`corrupt`'s ``self.rng`` split
+        chain), so repeated traces are stable and the scan never
+        recompiles for it."""
+        mode, scale = self.mode, self.scale
+        from fedml_tpu.core.tree import tree_select
+
+        def corrupted(gp, cp, rng):
+            if mode == "sign_flip":
+                # Model replacement: g - scale * (w - g).
+                return jax.tree.map(lambda w, g: g - scale * (w - g), cp, gp)
+            if mode == "scale":
+                return jax.tree.map(lambda w: w * scale, cp)
+            if mode == "nan":
+                return jax.tree.map(
+                    lambda w: (w.at[(0,) * w.ndim].set(jnp.nan)
+                               if w.ndim else jnp.nan * w), cp)
+            leaves, treedef = jax.tree.flatten(cp)  # random
+            keys = jax.random.split(rng, len(leaves))
+            return jax.tree.unflatten(
+                treedef,
+                [scale * jax.random.normal(k, l.shape, l.dtype)
+                 for k, l in zip(keys, leaves)])
+
+        def apply(global_net, client_nets, adv, rngs):
+            gp = (global_net.params if hasattr(global_net, "params")
+                  else global_net)
+
+            def per_client(cnet, a, rng):
+                cp = cnet.params if hasattr(cnet, "params") else cnet
+                new = tree_select(a > 0, corrupted(gp, cp, rng), cp)
+                if hasattr(cnet, "params"):
+                    return type(cnet)(new, cnet.model_state)
+                return new
+
+            return jax.vmap(per_client, in_axes=(0, 0, 0))(
+                client_nets, adv, rngs)
+
+        return apply
 
 
 class HeartbeatMonitor:

@@ -48,6 +48,18 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--stddev", type=float, default=0.0)
     p.add_argument("--attack_freq", type=int, default=0)
     p.add_argument("--attack_num_adversaries", type=int, default=1)
+    # Byzantine-robust aggregation + device-side corruption drill (new
+    # capability beyond the reference's clip+noise; docs/ROBUSTNESS.md)
+    p.add_argument("--aggregator", type=str, default="mean",
+                   help="server aggregation: mean | coord_median | "
+                        "trimmed_mean<beta> | krum<f> | "
+                        "multi_krum<f>-<m> | geometric_median<iters>")
+    p.add_argument("--corrupt_mode", type=str, default="none",
+                   choices=["none", "sign_flip", "scale", "nan", "random"],
+                   help="device-side update corruption by the adversary "
+                        "clients (FedAvgRobust attack drill)")
+    p.add_argument("--corrupt_scale", type=float, default=10.0,
+                   help="corruption magnitude for sign_flip/scale/random")
     # hierarchical (hierarchical_fl/main.py)
     p.add_argument("--group_comm_round", type=int, default=1)
     p.add_argument("--group_num", type=int, default=2)
@@ -119,6 +131,27 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     return p
 
 
+def reject_fedavg_family_flags(args, algorithm: str) -> None:
+    """Refuse FedAvg-family-only flags for algorithms that never read
+    them. ``FedAvgAPI.__init__`` guards its OWN subclasses against a
+    silently-dropped ``--aggregator``/``--corrupt_mode``, but the
+    specialty mains (FedGAN/GKT/NAS/SplitNN/VFL/decentralized/async…)
+    construct classes outside that family — without this driver-level
+    check the user would believe a Byzantine defense or attack drill is
+    active while nothing reads the flag (docs/ROBUSTNESS.md)."""
+    bad = []
+    if getattr(args, "aggregator", "mean") != "mean":
+        bad.append(f"--aggregator {args.aggregator}")
+    if getattr(args, "corrupt_mode", "none") != "none":
+        bad.append(f"--corrupt_mode {args.corrupt_mode}")
+    if bad:
+        raise SystemExit(
+            f"{algorithm} does not support {', '.join(bad)}: robust "
+            "aggregation and the corruption drill ride the FedAvg "
+            "family's shared rounds only (the flag would be silently "
+            "inert here)")
+
+
 def parse_args(argv=None) -> argparse.Namespace:
     parser = argparse.ArgumentParser(description="fedml_tpu experiment")
     add_args(parser)
@@ -145,6 +178,9 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         robust_stddev=args.stddev,
         attack_freq=args.attack_freq,
         attack_num_adversaries=args.attack_num_adversaries,
+        aggregator=args.aggregator,
+        corrupt_mode=args.corrupt_mode,
+        corrupt_scale=args.corrupt_scale,
         group_comm_round=args.group_comm_round,
         lr_schedule=args.lr_schedule,
         lr_decay_rate=args.lr_decay_rate,

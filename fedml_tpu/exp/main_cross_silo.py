@@ -84,6 +84,12 @@ def main(argv=None):
             f"--client_selection {args.client_selection} is a simulator "
             "feature; the cross-silo server samples uniformly (it has no "
             "access to silo-local losses before assignment)")
+    from fedml_tpu.exp.args import reject_fedavg_family_flags
+
+    # The cross-silo server reduces with FedAVGAggregator-parity math —
+    # the simulator's pluggable aggregator/corruption drill would be
+    # silently inert here.
+    reject_fedavg_family_flags(args, "the cross-silo pipeline")
 
     logging.basicConfig(
         level=logging.INFO,

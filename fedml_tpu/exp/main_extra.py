@@ -17,7 +17,8 @@ import logging
 
 import numpy as np
 
-from fedml_tpu.exp.args import add_args, config_from_args
+from fedml_tpu.exp.args import (add_args, config_from_args,
+                                reject_fedavg_family_flags)
 from fedml_tpu.exp.setup import global_test_batches, load_data
 from fedml_tpu.data.loaders import to_federated_arrays
 
@@ -187,6 +188,9 @@ def main(argv=None):
                         help="Decentralized only: dsgd | pushsum")
     add_args(parser)
     args = parser.parse_args(argv)
+    # None of these specialty algorithms ride the FedAvg-family rounds,
+    # so the robust-aggregation/drill flags must refuse, not no-op.
+    reject_fedavg_family_flags(args, args.algorithm)
     logging.basicConfig(level=logging.INFO,
                         format=f"[{args.algorithm} %(asctime)s] %(message)s")
     history = RUNNERS[args.algorithm](args)

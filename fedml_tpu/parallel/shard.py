@@ -36,14 +36,15 @@ def client_finite_mask(client_params) -> jnp.ndarray:
 
 
 def run_clients_guarded(local_train, client_transform, nan_guard,
-                        net, x, y, mask, rngs):
+                        net, x, y, mask, rngs, corruptor=None, adv=None):
     """Shared per-round client-training prelude: vmapped local training,
-    optional post-transform (robust clipping etc.), and the NaN-guard
-    zeroing. Returns ``(client_nets, losses, finite)`` where ``finite [C]``
-    is 1.0 for clients whose trained model is wholly finite (all-ones when
-    the guard is off) — callers fold it into their aggregation weights.
-    Used by the vmap round, the sharded round, and q-FedAvg's fair round
-    so the guard semantics can never drift between them.
+    optional ADVERSARIAL corruption, optional post-transform (robust
+    clipping etc.), and the NaN-guard zeroing. Returns ``(client_nets,
+    losses, finite)`` where ``finite [C]`` is 1.0 for clients whose
+    trained model is wholly finite (all-ones when the guard is off) —
+    callers fold it into their aggregation weights. Used by the vmap
+    round, the sharded round, and q-FedAvg's fair round so the guard
+    semantics can never drift between them.
 
     ``client_transform`` is ``(global_net, client_net) -> client_net``,
     or — when the builder marked it ``transform.wants_rng = True`` —
@@ -53,10 +54,22 @@ def run_clients_guarded(local_train, client_transform, nan_guard,
     a transform-reserved constant, so it never collides with the streams
     local training consumed for shuffling/dropout/DP noise). An explicit
     attribute, not signature sniffing: partials and C-implemented
-    callables would defeat ``inspect`` silently."""
+    callables would defeat ``inspect`` silently.
+
+    ``corruptor`` is the device-side attack model for robustness drills
+    (``core.faults.UpdateCorruptor.device_fn()``): a pure
+    ``(global_net, client_nets, adv, rngs) -> client_nets`` applied to
+    the trained stack where ``adv [C] > 0`` flags the adversary slots.
+    It runs BEFORE the transform and the guard — exactly the real threat
+    order: the server's defenses see the already-corrupted updates. Its
+    per-client streams are forked with their own reserved constant
+    (0xC0), disjoint from training's and the transform's (0x7F)."""
     client_nets, losses = jax.vmap(
         local_train, in_axes=(None, 0, 0, 0, 0)
     )(net, x, y, mask, rngs)
+    if corruptor is not None:
+        crngs = jax.vmap(lambda r: jax.random.fold_in(r, 0xC0))(rngs)
+        client_nets = corruptor(net, client_nets, adv, crngs)
     if client_transform is not None:
         if getattr(client_transform, "wants_rng", False):
             trngs = jax.vmap(
@@ -79,8 +92,24 @@ def run_clients_guarded(local_train, client_transform, nan_guard,
     return client_nets, losses, finite
 
 
+def _is_mean(aggregator) -> bool:
+    return aggregator is None or getattr(aggregator, "is_mean", False)
+
+
+def _robust_avg(aggregator, client_params, weights, params):
+    """Aggregate with a non-mean Aggregator (core/robust_agg protocol)
+    and keep the PREVIOUS global model when no client carries weight:
+    order statistics over an empty participant set are meaningless — the
+    aggregators' ±inf exclusion sentinels would leak into the model (the
+    mean path's equivalent guard is the nan_guard ``any_ok`` select)."""
+    avg = aggregator(client_params, weights)
+    any_ok = jnp.sum(jnp.where(weights > 0, 1.0, 0.0)) > 0
+    return jax.tree.map(lambda a, p: jnp.where(any_ok, a, p), avg, params)
+
+
 def make_vmap_round(local_train, client_transform=None, nan_guard: bool = False,
-                    with_client_losses: bool = False):
+                    with_client_losses: bool = False, aggregator=None,
+                    corruptor=None):
     """``round_fn(params, x, y, mask, weights, loss_weights, rng) ->
     (avg_params, mean_loss)`` with client-stacked inputs ``[C, S, B, ...]``.
 
@@ -99,29 +128,52 @@ def make_vmap_round(local_train, client_transform=None, nan_guard: bool = False,
     ``with_client_losses`` appends the per-client training losses ``[C]``
     as a THIRD output — the in-round observable Oort's utility needs
     (Lai et al. §5), captured for free instead of a post-round eval pass.
-    """
 
-    def round_fn(params, x, y, mask, weights, loss_weights, rng):
+    ``aggregator`` swaps the server reduction for a Byzantine-robust one
+    (``core.robust_agg`` protocol — coord_median, trimmed_mean, krum,
+    geometric_median). ``None`` or an ``is_mean`` aggregator keeps the
+    existing weighted-mean path UNCHANGED (bit-equal). Under ``nan_guard``
+    a diverged client's zeroed weight EXCLUDES it from the robust
+    aggregator's order statistics (core/robust_agg weight semantics).
+
+    ``corruptor`` enables the device-side attack drill: the round grows a
+    trailing ``adv [C]`` operand (adversary mask) and the corruptor runs
+    on the trained stack before the transform/guard — see
+    :func:`run_clients_guarded`. The mask-driven form means the drill
+    rides every tier, including the windowed ``lax.scan`` body."""
+    if _is_mean(aggregator):
+        aggregator = None
+
+    def round_core(params, x, y, mask, weights, loss_weights, rng, adv):
         rngs = client_rngs(rng, x.shape[0], 0)
         client_params, losses, finite = run_clients_guarded(
             local_train, client_transform, nan_guard,
-            params, x, y, mask, rngs)
+            params, x, y, mask, rngs, corruptor=corruptor, adv=adv)
         weights = weights * finite
         loss_weights = loss_weights * finite
-        avg = tree_weighted_mean(client_params, weights)
-        if nan_guard:
-            # Every sampled client diverged → keep the previous global model
-            # (a zero-total weighted mean would silently zero the params).
-            any_ok = jnp.sum(weights) > 0
-            avg = jax.tree.map(
-                lambda a, p: jnp.where(any_ok, a, p), avg, params)
+        if aggregator is None:
+            avg = tree_weighted_mean(client_params, weights)
+            if nan_guard:
+                # Every sampled client diverged → keep the previous global
+                # model (a zero-total weighted mean would silently zero the
+                # params).
+                any_ok = jnp.sum(weights) > 0
+                avg = jax.tree.map(
+                    lambda a, p: jnp.where(any_ok, a, p), avg, params)
+        else:
+            avg = _robust_avg(aggregator, client_params, weights, params)
         lw = loss_weights / jnp.maximum(jnp.sum(loss_weights), 1e-12)
         mean_loss = jnp.sum(losses * lw)
         if with_client_losses:
             return avg, mean_loss, losses
         return avg, mean_loss
 
-    return round_fn
+    if corruptor is None:
+        def round_fn(params, x, y, mask, weights, loss_weights, rng):
+            return round_core(params, x, y, mask, weights, loss_weights,
+                              rng, None)
+        return round_fn
+    return round_core
 
 
 def client_rngs(rng, n_local, offset):
@@ -133,51 +185,78 @@ def client_rngs(rng, n_local, offset):
 
 def make_sharded_round(local_train, mesh, axis: str = "clients",
                        client_transform=None, nan_guard: bool = False,
-                       with_client_losses: bool = False):
+                       with_client_losses: bool = False, aggregator=None,
+                       corruptor=None):
     """Sharded round: client axis split over ``mesh[axis]``; output replicated.
 
     Weighted average = psum of per-shard weighted partial sums / psum of
     weights — exact regardless of how clients land on shards.
     ``nan_guard`` and ``with_client_losses`` as in :func:`make_vmap_round`
     (the per-client losses come back client-sharded over ``axis``).
-    """
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
-        out_specs=((P(), P(), P(axis)) if with_client_losses
-                   else (P(), P())),
-        check_vma=False,
-    )
-    def round_fn(params, x, y, mask, weights, loss_weights, rng):
+    ``aggregator`` (core/robust_agg protocol): a non-mean aggregator needs
+    the FULL client-stacked update, which the partial-sum reduction never
+    materializes — the round ``all_gather``s the trained stack (and the
+    weights) along the client axis and runs the aggregator replicated on
+    every shard. ``tiled`` gathers concatenate in axis order, which is
+    exactly the global-slot order the vmap path stacks, so the aggregator
+    sees bit-identical inputs on one chip and on a mesh. ``None`` / mean
+    keeps the partial-sum ``psum`` fast path untouched (bit-equal).
+
+    ``corruptor`` as in :func:`make_vmap_round`: the round grows a
+    trailing client-sharded ``adv`` operand."""
+    if _is_mean(aggregator):
+        aggregator = None
+
+    def body(params, x, y, mask, weights, loss_weights, rng, adv):
         # Same global-slot-keyed streams as the vmap path.
         shard_idx = jax.lax.axis_index(axis)
         rngs = client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
         client_params, losses, finite = run_clients_guarded(
             local_train, client_transform, nan_guard,
-            params, x, y, mask, rngs)
+            params, x, y, mask, rngs, corruptor=corruptor, adv=adv)
         weights = weights * finite
         loss_weights = loss_weights * finite
         w = weights.astype(jnp.float32)
-        total = jax.lax.psum(jnp.sum(w), axis)
-        wn = w / jnp.maximum(total, 1e-12)
-        avg = jax.tree.map(
-            lambda p: jax.lax.psum(
-                jnp.einsum("c,c...->...", wn, p.astype(jnp.float32)), axis
-            ).astype(p.dtype),
-            client_params,
-        )
-        if nan_guard:
-            # All-diverged round: keep the previous global model.
+        if aggregator is None:
+            total = jax.lax.psum(jnp.sum(w), axis)
+            wn = w / jnp.maximum(total, 1e-12)
             avg = jax.tree.map(
-                lambda a, p: jnp.where(total > 0, a, p), avg, params)
+                lambda p: jax.lax.psum(
+                    jnp.einsum("c,c...->...", wn, p.astype(jnp.float32)), axis
+                ).astype(p.dtype),
+                client_params,
+            )
+            if nan_guard:
+                # All-diverged round: keep the previous global model.
+                avg = jax.tree.map(
+                    lambda a, p: jnp.where(total > 0, a, p), avg, params)
+        else:
+            full = jax.tree.map(
+                lambda p: jax.lax.all_gather(p, axis, axis=0, tiled=True),
+                client_params)
+            w_full = jax.lax.all_gather(w, axis, axis=0, tiled=True)
+            avg = _robust_avg(aggregator, full, w_full, params)
         lw = loss_weights.astype(jnp.float32)
         lw = lw / jnp.maximum(jax.lax.psum(jnp.sum(lw), axis), 1e-12)
         loss = jax.lax.psum(jnp.sum(losses * lw), axis)
         if with_client_losses:
             return avg, loss, losses
         return avg, loss
+
+    specs = (P(), P(axis), P(axis), P(axis), P(axis), P(axis), P())
+    out_specs = ((P(), P(), P(axis)) if with_client_losses
+                 else (P(), P()))
+    if corruptor is None:
+        @partial(shard_map, mesh=mesh, in_specs=specs,
+                 out_specs=out_specs, check_vma=False)
+        def round_fn(params, x, y, mask, weights, loss_weights, rng):
+            return body(params, x, y, mask, weights, loss_weights, rng, None)
+    else:
+        @partial(shard_map, mesh=mesh, in_specs=specs + (P(axis),),
+                 out_specs=out_specs, check_vma=False)
+        def round_fn(params, x, y, mask, weights, loss_weights, rng, adv):
+            return body(params, x, y, mask, weights, loss_weights, rng, adv)
 
     return round_fn
 
@@ -189,38 +268,48 @@ def make_window_scan(round_fn, server_update=None):
     O(rounds/W); see ``FedAvgAPI.train_rounds_windowed``).
 
     The scan CARRY is ``(net, extra)`` — the windowed carry protocol.
-    Between rounds the per-algorithm ``server_update(net, avg, extra)
-    -> (net', extra')`` is folded over the round average: ``None`` (the
-    default) is plain FedAvg (``net' = avg``, ``extra`` threaded
+    Between rounds the per-algorithm ``server_update(net, avg, extra,
+    key) -> (net', extra')`` is folded over the round average: ``None``
+    (the default) is plain FedAvg (``net' = avg``, ``extra`` threaded
     untouched — pass ``extra=None``); FedOpt passes its pure jitted
     optax server step with ``extra`` the server optimizer state, so the
     adaptive-server algorithms ride the same one-dispatch-per-W-rounds
     tier as plain FedAvg (the "keep state on device, talk to the host
     less" lever of Parallel Restarted SGD, arXiv:1807.06629, applied at
-    the dispatch boundary).
+    the dispatch boundary). ``key`` is the ROUND's rng key — the same
+    key the host loop's ``run_round`` split for that round — so a
+    randomized server update (FedAvgRobust's weak-DP noise) derives its
+    stream by ``fold_in`` from it and stays bit-equal to the host loop
+    without carrying a split chain (the PR-2 prefix-stability
+    discipline; fedlint R1 forbids carried split chains in scan bodies).
 
     ``round_fn`` is the SAME per-round function the host loop dispatches
     (vmap round on one chip, shard_map round on a client mesh — jitted is
     fine, jit-under-scan inlines), so windowed rounds are bit-equal to
     host-loop rounds fed the same cohorts, weights, and rng keys.
 
-    Returns ``scan_fn(net, extra, x, y, mask, weights, keys) ->
+    Returns ``scan_fn(net, extra, x, y, mask, weights, keys, *aux) ->
     ((net', extra'), losses)`` with ``x/y/mask [W, C, S, B, ...]``,
     ``weights [W, C]`` (sample counts x pad mask — used for BOTH the
     model average and the loss weighting, as the streaming host loop
-    does), ``keys [W, 2]`` the per-round rng keys in round order."""
+    does), ``keys [W, 2]`` the per-round rng keys in round order, and
+    ``aux`` any extra per-round scanned inputs (leading axis W) the
+    round takes as trailing operands — the "round"-protocol slot
+    ``FedAvgAPI._window_scan_extras`` fills (the corruption drill's
+    ``[W, C]`` adversary mask)."""
 
-    def scan_fn(net, extra, x, y, mask, weights, keys):
+    def scan_fn(net, extra, x, y, mask, weights, keys, *aux):
         def body(carry, inp):
             net, extra = carry
-            xw, yw, mw, ww, kw = inp
-            avg, loss = round_fn(net, xw, yw, mw, ww, ww, kw)
+            (xw, yw, mw, ww, kw), auxw = inp[:5], inp[5:]
+            avg, loss = round_fn(net, xw, yw, mw, ww, ww, kw, *auxw)
             if server_update is None:
                 return (avg, extra), loss
-            new_net, new_extra = server_update(net, avg, extra)
+            new_net, new_extra = server_update(net, avg, extra, kw)
             return (new_net, new_extra), loss
 
-        return jax.lax.scan(body, (net, extra), (x, y, mask, weights, keys))
+        return jax.lax.scan(body, (net, extra),
+                            (x, y, mask, weights, keys) + tuple(aux))
 
     return scan_fn
 

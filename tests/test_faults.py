@@ -28,6 +28,22 @@ def test_dropout_injector_reproducible_and_never_empty():
         DropoutInjector(1.0)
 
 
+def test_dropout_all_dropped_survivor_not_id_biased():
+    """When every client drops, the revived survivor comes from the
+    round-keyed RNG — not deterministically client 0, which would be a
+    systematic participation bias at high dropout (the same bias class
+    as FedAvgRobustAPI's eviction fix, algos/robust.py)."""
+    inj = DropoutInjector(0.999999, seed=7)  # every round is all-dropped
+    survivors = set()
+    for r in range(40):
+        m = inj.round_mask(r, 8)
+        assert m.sum() == 1.0, (r, m)
+        survivors.add(int(np.argmax(m)))
+        # Still reproducible per (seed, round).
+        np.testing.assert_array_equal(m, inj.round_mask(r, 8))
+    assert len(survivors) > 1, survivors
+
+
 def test_update_corruptor_modes():
     import jax
 
@@ -41,6 +57,56 @@ def test_update_corruptor_modes():
         assert all(l.shape == o.shape for l, o in zip(leaves, jax.tree.leaves(net.params)))
     nan_bad = UpdateCorruptor("nan").corrupt(net)
     assert not all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(nan_bad.params))
+
+
+def test_update_corruptor_device_fn_matches_host_corrupt():
+    """The device-side, mask-driven variant must reproduce the host
+    ``corrupt`` on flagged slots (sign_flip / scale / nan are
+    deterministic) and leave unflagged slots untouched, under jit."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.trainer.local import model_fns
+
+    fns = model_fns(create_model("lr", input_dim=4, num_classes=2))
+    nets = [fns.init(jax.random.PRNGKey(i), np.zeros((1, 4), np.float32))
+            for i in range(3)]
+    gnet = fns.init(jax.random.PRNGKey(9), np.zeros((1, 4), np.float32))
+    stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *nets)
+    adv = jnp.asarray([0.0, 1.0, 0.0])
+    rngs = jax.vmap(jax.random.PRNGKey)(jnp.arange(3))
+    for mode in ("sign_flip", "scale", "nan"):
+        out = jax.jit(UpdateCorruptor(mode).device_fn())(
+            gnet, stacked, adv, rngs)
+        want1 = UpdateCorruptor(mode).corrupt(nets[1], global_net=gnet)
+        for got, n0, w1, n2 in zip(jax.tree.leaves(out.params),
+                                   jax.tree.leaves(nets[0].params),
+                                   jax.tree.leaves(want1.params),
+                                   jax.tree.leaves(nets[2].params)):
+            g = np.asarray(got)
+            np.testing.assert_array_equal(g[0], np.asarray(n0))
+            # Flagged slot: same math as the host corrupt, to ~1 ulp —
+            # XLA fuses g - scale*(w - g) into an fma under jit, the
+            # eager host reference rounds each op (the drill's cross-
+            # TIER bit-equality is pinned in test_robust_agg, where
+            # both sides run the same jitted round).
+            np.testing.assert_allclose(g[1], np.asarray(w1),
+                                       rtol=2e-7, atol=1e-7)
+            np.testing.assert_array_equal(g[2], np.asarray(n2))
+    # "random" replaces the flagged update with scaled noise (stream
+    # differs from the host variant's split chain by design — the device
+    # streams are fold_in-forked per client): flagged slot changed,
+    # unflagged slots bit-identical.
+    out = jax.jit(UpdateCorruptor("random").device_fn())(
+        gnet, stacked, adv, rngs)
+    for got, n0, n1, n2 in zip(jax.tree.leaves(out.params),
+                               jax.tree.leaves(nets[0].params),
+                               jax.tree.leaves(nets[1].params),
+                               jax.tree.leaves(nets[2].params)):
+        g = np.asarray(got)
+        np.testing.assert_array_equal(g[0], np.asarray(n0))
+        assert not np.array_equal(g[1], np.asarray(n1))
+        np.testing.assert_array_equal(g[2], np.asarray(n2))
 
 
 def test_nan_guard_contains_diverged_client():
@@ -106,6 +172,74 @@ def test_heartbeat_monitor():
     got = {1: True, 2: True}
     failed = mon.wait_all_or_failed([1, 2, 3], have=lambda: list(got), poll_s=0.01)
     assert failed == [3]
+
+
+def test_heartbeat_wait_deadline_flags_missing_results():
+    """Deadline path under a FAKE clock: a rank whose heartbeat looks
+    ALIVE but whose result never arrives must be declared failed once
+    the deadline elapses — the caller must not keep waiting (the
+    reference's check_whether_all_receive would spin forever)."""
+    t = [0.0]
+    mon = HeartbeatMonitor([1, 2], timeout_s=10.0, clock=lambda: t[0])
+    have = {1: True}
+
+    def ticking_have():
+        # Each poll advances the fake clock; BOTH ranks keep beating, so
+        # neither is ever heartbeat-failed — only the deadline catches
+        # the one whose result never arrives.
+        t[0] += 7.0
+        mon.beat(1)
+        mon.beat(2)
+        return list(have)
+
+    failed = mon.wait_all_or_failed([1, 2], have=ticking_have,
+                                    poll_s=0.0, deadline_s=21.0)
+    assert failed == [2]
+    assert mon.failed() == []  # 2 is alive — it just never delivered
+
+
+def test_heartbeat_wait_never_seen_ranks_time_out():
+    """Ranks in ``expected`` the monitor has never seen get their clocks
+    started at entry and count as failed once timeout_s passes — without
+    a single beat ever arriving."""
+    t = [100.0]
+    mon = HeartbeatMonitor([1], timeout_s=5.0, clock=lambda: t[0])
+    mon.beat(1)
+
+    def advancing_have():
+        t[0] += 3.0
+        mon.beat(1)  # rank 1 stays alive but never delivers either
+        return []
+
+    failed = mon.wait_all_or_failed([1, 7, 8], have=advancing_have,
+                                    poll_s=0.0)
+    # 7/8: registered at entry (clock 100), silent past timeout → failed;
+    # 1: alive-but-silent, caught by the default 2x-timeout deadline.
+    assert failed == [1, 7, 8]
+    assert set(mon.failed()) == {7, 8}
+
+
+def test_heartbeat_wait_returns_immediately_when_all_present():
+    """No clock advance needed when every expected result is already
+    there — and failures OUTSIDE ``expected`` are not reported."""
+    t = [0.0]
+    mon = HeartbeatMonitor([1, 2, 99], timeout_s=1.0, clock=lambda: t[0])
+    t[0] = 50.0  # everyone, incl. 99, is heartbeat-expired
+    mon.beat(1)
+    mon.beat(2)
+    failed = mon.wait_all_or_failed([1, 2], have=lambda: [1, 2],
+                                    poll_s=0.0)
+    assert failed == []  # 99 failed, but it was not expected here
+    assert mon.failed() == [99]
+
+
+def test_heartbeat_beat_registers_unknown_rank():
+    t = [0.0]
+    mon = HeartbeatMonitor([], timeout_s=10.0, clock=lambda: t[0])
+    mon.beat(5)  # unknown → registered on first beat
+    assert mon.alive() == [5]
+    t[0] = 11.0
+    assert mon.failed() == [5]
 
 
 def test_turboaggregate_dropout_harness():
