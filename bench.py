@@ -628,6 +628,97 @@ def bench_robust_agg():
     return out
 
 
+def bench_chaos():
+    """Control-plane resilience price (docs/ROBUSTNESS.md "Control
+    plane"): every backend's ``send_message`` now runs through the
+    unified RetryPolicy — this section measures what that wrapper costs
+    on the CLEAN path (no faults, no retries), where it is pure
+    overhead. A/B over the native TCP transport with a model-sized-ish
+    64 KB payload: policy path = the production ``send_message``
+    (serialize + RetryPolicy.run + one transport attempt); raw path =
+    the same serialize + the same single attempt with the policy
+    machinery bypassed. Headline scalar ``chaos_clean_overhead`` =
+    policy_time / raw_time (1.0 = free). Also reports the
+    ChaosTransport pass-through ratio with an all-zeros spec — the cost
+    of LEAVING the drill wrapper installed in production."""
+    import threading
+
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.resilience import ChaosSpec, ChaosTransport
+    from fedml_tpu.comm.tcp import TcpCommManager
+    from fedml_tpu.comm.wire import serialize_message
+
+    n_msgs, repeats = 400, 5
+    table = {0: ("127.0.0.1", 0), 1: ("127.0.0.1", 0)}
+    m0 = TcpCommManager(table, 0)
+    m1 = TcpCommManager(table, 1)
+    got = []
+
+    class Obs:
+        def receive_message(self, t, msg):
+            got.append(t)
+
+    m1.add_observer(Obs())
+    rx = threading.Thread(target=m1.handle_receive_message, daemon=True)
+    rx.start()
+    msg = Message(type=3, sender_id=0, receiver_id=1)
+    msg.add("round", 0)
+    msg.add(Message.MSG_ARG_KEY_MODEL_PARAMS,
+            {"w": np.zeros(16384, np.float32)})
+    chaos_clean = ChaosTransport(m0, ChaosSpec(seed=0), rank=0)
+
+    def _wait_drained(target):
+        deadline = time.perf_counter() + 30
+        while len(got) < target and time.perf_counter() < deadline:
+            time.sleep(0.002)
+
+    sent = [0]
+
+    def timed(send_one):
+        _check_section_deadline()
+        t0 = time.perf_counter()
+        for _ in range(n_msgs):
+            send_one()
+        dt = time.perf_counter() - t0  # sender-side cost only
+        sent[0] += n_msgs
+        _wait_drained(sent[0])  # isolate trials from each other (untimed)
+        return dt
+
+    def raw_send():
+        blob = serialize_message(msg, m0._serializer)
+        m0._send_once(1, *m0.ip_config[1], blob)
+
+    try:
+        raw_send()  # connect + warm both paths
+        m0.send_message(msg)
+        sent[0] = 2
+        raw_t, policy_t, wrapped_t = [], [], []
+        for _ in range(repeats):
+            raw_t.append(timed(raw_send))
+            policy_t.append(timed(lambda: m0.send_message(msg)))
+            wrapped_t.append(timed(lambda: chaos_clean.send_message(msg)))
+        raw_med, raw_iqr = _med_iqr(raw_t)
+        pol_med, pol_iqr = _med_iqr(policy_t)
+        wrap_med, _ = _med_iqr(wrapped_t)
+    finally:
+        m1.stop_receive_message()
+        m0.close()
+        m1.close()
+    return {
+        "messages_per_trial": n_msgs,
+        "payload_bytes": 16384 * 4,
+        "raw_send_s": round(raw_med, 4),
+        "raw_send_s_iqr": raw_iqr,
+        "policy_send_s": round(pol_med, 4),
+        "policy_send_s_iqr": pol_iqr,
+        "chaos_wrapped_send_s": round(wrap_med, 4),
+        "delivered": len(got),
+        "chaos_clean_overhead": round(pol_med / raw_med, 3),
+        "chaos_wrapper_overhead": round(wrap_med / raw_med, 3),
+        "send_retries_on_clean_path": m0.retry_count,
+    }
+
+
 def bench_stackoverflow_342k():
     """BASELINE.md's largest row at its TRUE scale: 342,477 clients
     (the reference enumerates exactly that many stackoverflow_nwp
@@ -1043,6 +1134,7 @@ def main():
                      ("store_windowed", bench_store_windowed),
                      ("store_windowed_fedopt", bench_store_windowed_fedopt),
                      ("robust_agg", bench_robust_agg),
+                     ("chaos", bench_chaos),
                      ("stackoverflow_342k", bench_stackoverflow_342k),
                      ("vit_cifar_shaped", bench_vit),
                      ("resnet56_batch128_tuned", bench_resnet56_b128),
@@ -1157,6 +1249,8 @@ def build_headline(out, full_path="docs/bench_r5_local.json"):
                                                "speedup"),
             "robust_agg_overhead": _scalar("robust_agg",
                                            "robust_agg_overhead"),
+            "chaos_clean_overhead": _scalar("chaos",
+                                            "chaos_clean_overhead"),
             "stackoverflow_342k_rps": _scalar("stackoverflow_342k",
                                               "rounds_per_sec"),
             "vit_sps": _scalar("vit_cifar_shaped", "samples_per_sec"),

@@ -96,3 +96,15 @@ class FedConfig:
     # Account the privacy cost with fedml_tpu.core.privacy.PrivacyAccountant.
     dp_clip: float = 0.0
     dp_noise_multiplier: float = 0.0
+    # Distributed control plane (algos/fedavg_distributed.py,
+    # docs/ROBUSTNESS.md "Control plane"): checkpoint the server's run
+    # state every N completed rounds (0 disables; async orbax save off
+    # the round critical path — a killed server restarts from the latest
+    # checkpoint and the federation continues), and abandon a round after
+    # round_timeout_s wall-clock seconds by EVICTING the silent ranks and
+    # aggregating over the survivors (0 = wait forever, reference
+    # behavior). Workers beat every heartbeat_interval_s while training
+    # long rounds (0 = uploads are the only liveness signal).
+    checkpoint_every: int = 0
+    round_timeout_s: float = 0.0
+    heartbeat_interval_s: float = 0.0

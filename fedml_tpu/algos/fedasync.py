@@ -17,12 +17,21 @@ this degenerates to sequential SGD on shuffled client shards.
 
 Message flow per worker is strictly request/response (upload -> new model
 or done), which makes shutdown deterministic: the server answers every
-in-flight upload, so no rank can block on a model that never comes.
+in-flight upload, so no rank can block on a model that never comes — as
+long as every worker LIVES to upload once more. A crash-stop worker used
+to hang exactly the terminal handshake (``done_workers == size - 1``
+never reached); with ``done_timeout_s > 0`` the server now runs the same
+heartbeat-driven bounded termination as the synchronous control plane
+(algos/fedavg_distributed.py): workers beat, silent ranks are evicted
+from the done-wait, and the run always ends.
 """
 
 from __future__ import annotations
 
-from typing import List
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Set
 
 import jax
 import jax.numpy as jnp
@@ -32,19 +41,25 @@ from fedml_tpu.algos.fedavg_distributed import (
     MSG_ARG_KEY_CLIENT_INDEX,
     MSG_ARG_KEY_MODEL_PARAMS,
     MSG_ARG_KEY_NUM_SAMPLES,
+    MSG_TYPE_C2S_HEARTBEAT,
     MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
     MSG_TYPE_S2C_INIT_CONFIG,
     MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+    MSG_TYPE_SRV_TICK,
     build_federation_setup,
 )
 from fedml_tpu.comm.loopback import run_workers
 from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.resilience import ChaosSpec, HeartbeatSender
+from fedml_tpu.core.faults import HeartbeatMonitor
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.trainer.local import softmax_ce
 
 MSG_ARG_KEY_MODEL_VERSION = "model_version"
+
+log = logging.getLogger(__name__)
 
 
 def staleness_weight(alpha: float, staleness: int, a: float = 0.5) -> float:
@@ -59,7 +74,9 @@ class FedAsyncServerManager(ServerManager):
 
     def __init__(self, args, net, cfg: FedConfig, size: int,
                  backend: str = "LOOPBACK", alpha: float = 0.6,
-                 staleness_exp: float = 0.5, eval_fn=None, test_data=None):
+                 staleness_exp: float = 0.5, eval_fn=None, test_data=None,
+                 *, done_timeout_s: Optional[float] = None,
+                 clock=time.monotonic):
         super().__init__(args, rank=0, size=size, backend=backend)
         self.net = net
         self.cfg = cfg
@@ -68,19 +85,165 @@ class FedAsyncServerManager(ServerManager):
         self.eval_fn = eval_fn
         self.test_data = test_data
         self.version = 0
-        self.done_workers = 0
         self.staleness_history: List[int] = []
         self.test_history: List[dict] = []
+        self.evictions = 0
+        self.duplicate_drops = 0
+        self.reassignments = 0
+        self._members: Set[int] = set(range(1, size))
+        self._done_set: Set[int] = set()
+        # Per-worker high-water mark of the model version its uploads
+        # trained FROM: a worker's assigned versions strictly increase,
+        # so a repeat (ChaosTransport duplication, sender retry after a
+        # lost ACK) is dropped WITHOUT reply — mixing it twice would
+        # double-count one real update and hand the worker a second live
+        # assignment.
+        self._last_upload_ver: Dict[int, int] = {}
+        # Wall-clock of the last time each worker made request/response
+        # progress (assignment sent or upload arrived). The strict
+        # request/response flow means a LOST server reply leaves an
+        # alive-but-idle worker with nothing to do forever — its beats
+        # keep it heartbeat-alive, so the watchdog never fires. Beats
+        # from a worker stalled past done_timeout_s get a fresh
+        # assignment instead (see _handle_heartbeat).
+        self._last_progress: Dict[int, float] = {}
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._stopped = False
+        self.done_timeout_s = (cfg.round_timeout_s if done_timeout_s is None
+                               else done_timeout_s)
+        self.heartbeat = HeartbeatMonitor(
+            range(1, size), timeout_s=self.done_timeout_s or 30.0,
+            clock=clock)
         self._mix = jax.jit(
             lambda g, c, w: jax.tree.map(
                 lambda a_, b_: ((1.0 - w) * a_.astype(jnp.float32)
                                 + w * b_.astype(jnp.float32)).astype(a_.dtype),
                 g, c))
 
+    @property
+    def done_workers(self) -> int:
+        return len(self._done_set)
+
     def run(self) -> None:
         self.register_message_receive_handlers()
+        with self._lock:
+            members = sorted(self._members)
+        for r in members:  # liveness clocks start when the run starts
+            self.heartbeat.beat(r)
         self.send_init_msg()
+        if self.done_timeout_s and self.done_timeout_s > 0:
+            threading.Thread(target=self._watchdog_loop, daemon=True).start()
         self.com_manager.handle_receive_message()
+
+    def finish(self) -> None:
+        self._stopped = True
+        super().finish()
+
+    # -- bounded termination (the sync control plane's watchdog, scoped to
+    # the done handshake: async progress never blocks on one worker, but
+    # the terminal barrier used to) ----------------------------------------
+    def _watchdog_loop(self) -> None:
+        poll = max(0.005, min(0.05, self.done_timeout_s / 10))
+        while not self._stopped:
+            with self._lock:
+                members = sorted(self._members)
+            if not members or self.version >= self.cfg.comm_round:
+                failed = self.heartbeat.wait_all_or_failed(
+                    members,
+                    have=lambda: (members if self._stopped
+                                  else self._done_snapshot()),
+                    poll_s=poll, deadline_s=self.done_timeout_s)
+                if not self._stopped and (failed or not members):
+                    self._post_tick(failed)
+            else:
+                # Mid-run: async progress tolerates any minority of dead
+                # workers, but ALL of them dead means the version counter
+                # can never reach comm_round — bound that too.
+                failed = set(self.heartbeat.failed())
+                if failed >= set(members):
+                    self._post_tick(sorted(failed))
+            time.sleep(poll)
+
+    def _done_snapshot(self) -> List[int]:
+        # The watchdog thread reads while the dispatch thread mutates —
+        # iterating the live set can raise "Set changed size during
+        # iteration", killing the daemon watchdog and silently disabling
+        # bounded termination.
+        with self._lock:
+            return sorted(self._done_set)
+
+    def _post_tick(self, failed) -> None:
+        msg = Message(MSG_TYPE_SRV_TICK, 0, 0)
+        msg.add("failed", [int(w) for w in failed])
+        try:
+            self.send_message(msg)
+        except (ConnectionError, OSError):
+            pass  # next watchdog pass re-ticks
+
+    def _handle_tick(self, msg: Message) -> None:
+        failed = set(msg.get("failed") or [])
+        with self._lock:
+            evict = [w for w in failed
+                     if w in self._members and w not in self._done_set]
+            for w in evict:
+                self._members.discard(w)
+                self.evictions += 1
+        if evict:
+            log.warning("async server: evicting silent ranks %s", evict)
+        self._maybe_finish()
+
+    def _handle_heartbeat(self, msg: Message) -> None:
+        worker = msg.get_sender_id()
+        self.heartbeat.beat(worker)
+        if not (self.done_timeout_s and self.done_timeout_s > 0):
+            return
+        if self.version >= self.cfg.comm_round:
+            # A beat past the target version means the worker never got
+            # its done (lost reply, or evicted-but-alive) — re-send it so
+            # it can exit instead of beating until idle_timeout_s.
+            self._send_done(worker)
+            return
+        stalled = (self._clock() - self._last_progress.get(worker, 0.0)
+                   > self.done_timeout_s)
+        if stalled:
+            # Request/response recovery: the worker is alive but has no
+            # live assignment (its reply was lost, or it was evicted and
+            # came back). Hand it fresh work at the CURRENT version —
+            # the per-worker upload high-water mark keeps any late
+            # original upload idempotent.
+            log.warning("async server: worker %d alive but idle past "
+                        "done_timeout_s — re-assigning at version %d",
+                        worker, self.version)
+            self.reassignments += 1
+            self._send_assignment(worker, recovery=True)
+
+    def _evict_dead(self, worker: int, err: BaseException, what: str) -> None:
+        """A send failed past the retry policy: evict — guarded, so
+        repeated failures to an already-evicted rank don't inflate the
+        eviction counter the fault drills assert on."""
+        log.warning("%s to worker %d failed (%s): evicting", what, worker, err)
+        with self._lock:
+            if worker in self._members:
+                self._members.discard(worker)
+                self.evictions += 1
+
+    def _send_done(self, worker: int) -> None:
+        out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
+        out.add("done", True)
+        try:
+            self.send_message(out)
+            with self._lock:
+                self._done_set.add(worker)
+        except (ConnectionError, OSError) as err:
+            self._evict_dead(worker, err, "done")
+        self._maybe_finish()
+
+    def _maybe_finish(self) -> None:
+        with self._lock:
+            done = self._done_set >= self._members
+        if done and not self._stopped:
+            self.finish()
 
     def _assign_client(self, worker: int) -> int:
         """Deterministic per-(version, worker) client assignment — the
@@ -95,26 +258,67 @@ class FedAsyncServerManager(ServerManager):
             msg.add(MSG_ARG_KEY_MODEL_PARAMS, self.net)
             msg.add(MSG_ARG_KEY_CLIENT_INDEX, self._assign_client(worker))
             msg.add(MSG_ARG_KEY_MODEL_VERSION, 0)
-            self.send_message(msg)
+            self._last_progress[worker] = self._clock()
+            try:
+                self.send_message(msg)
+            except (ConnectionError, OSError) as err:
+                # A silo dead at startup must not crash the whole async
+                # server (the sync control plane's send_init_msg is
+                # evict-and-continue too); the survivors run the
+                # federation, a later beat/upload re-admits the rank.
+                self._evict_dead(worker, err, "init")
+        self._maybe_finish()
+
+    def _send_assignment(self, worker: int, *, recovery: bool = False) -> None:
+        out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
+        out.add("done", False)
+        out.add(MSG_ARG_KEY_MODEL_PARAMS, self.net)
+        out.add(MSG_ARG_KEY_CLIENT_INDEX, self._assign_client(worker))
+        out.add(MSG_ARG_KEY_MODEL_VERSION, self.version)
+        if recovery:
+            # Stalled-worker recovery: tell the client which upload we
+            # last ACCEPTED from it, so a worker that is merely SLOW (its
+            # upload still in flight, or lost) resends its cached upload
+            # instead of training this extra assignment — beats arriving
+            # every done_timeout_s during one long local round must not
+            # backlog an unbounded queue of live assignments.
+            out.add("recovery", True)
+            with self._lock:
+                out.add("expected", self._last_upload_ver.get(worker, -1))
+        self._last_progress[worker] = self._clock()
+        try:
+            self.send_message(out)
+        except (ConnectionError, OSError) as err:
+            self._evict_dead(worker, err, "assignment")
+            self._maybe_finish()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
             MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.handle_upload)
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_HEARTBEAT, self._handle_heartbeat)
+        self.register_message_receive_handler(
+            MSG_TYPE_SRV_TICK, self._handle_tick)
 
     def handle_upload(self, msg: Message) -> None:
         worker = msg.get_sender_id()
+        self.heartbeat.beat(worker)
+        with self._lock:
+            if worker not in self._members:
+                self._members.add(worker)  # returned after eviction
         if self.version >= self.cfg.comm_round:
             # Target version reached while this upload was in flight:
             # discard it (mixing would overshoot comm_round) and release
             # the worker.
-            out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
-            out.add("done", True)
-            self.send_message(out)
-            self.done_workers += 1
-            if self.done_workers == self.size - 1:
-                self.finish()
+            self._send_done(worker)
             return
-        staleness = self.version - int(msg.get(MSG_ARG_KEY_MODEL_VERSION))
+        base_ver = int(msg.get(MSG_ARG_KEY_MODEL_VERSION))
+        with self._lock:
+            if base_ver <= self._last_upload_ver.get(worker, -1):
+                self.duplicate_drops += 1
+                return
+            self._last_upload_ver[worker] = base_ver
+        staleness = self.version - base_ver
         w = staleness_weight(self.alpha, staleness, self.staleness_exp)
         self.net = self._mix(self.net, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
                              jnp.float32(w))
@@ -127,32 +331,58 @@ class FedAsyncServerManager(ServerManager):
             self.test_history.append(
                 {"version": self.version, "staleness": staleness,
                  **{k: float(v) for k, v in m.items()}})
-        out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
         if self.version >= self.cfg.comm_round:
-            out.add("done", True)
-            self.send_message(out)
-            self.done_workers += 1
-            if self.done_workers == self.size - 1:
-                self.finish()
+            self._send_done(worker)
             return
-        out.add("done", False)
-        out.add(MSG_ARG_KEY_MODEL_PARAMS, self.net)
-        out.add(MSG_ARG_KEY_CLIENT_INDEX, self._assign_client(worker))
-        out.add(MSG_ARG_KEY_MODEL_VERSION, self.version)
-        self.send_message(out)
+        self._send_assignment(worker)
 
 
 class FedAsyncClientManager(ClientManager):
     """Train on the latest received model, upload tagged with the model
-    version it was based on, wait for the next model (or done)."""
+    version it was based on, wait for the next model (or done). Beats
+    every ``cfg.heartbeat_interval_s`` (or ``beat_interval_s``) so the
+    server's bounded-termination watchdog sees it alive, and self-
+    terminates after ``idle_timeout_s`` without server contact."""
 
     def __init__(self, args, rank: int, size: int, train_fed: FederatedArrays,
-                 local_train, cfg: FedConfig, backend: str = "LOOPBACK"):
+                 local_train, cfg: FedConfig, backend: str = "LOOPBACK", *,
+                 beat_interval_s: Optional[float] = None,
+                 idle_timeout_s: float = 0.0):
         super().__init__(args, rank=rank, size=size, backend=backend)
         self.train_fed = train_fed
         self.local_train = local_train
         self.cfg = cfg
         self.steps = 0
+        self.duplicate_drops = 0
+        self.upload_resends = 0
+        # Assigned versions strictly increase, so an assignment at or
+        # below the high-water mark is a transport duplicate — dropped
+        # without retraining (the sync client's round dedupe, keyed on
+        # the version counter instead).
+        self._last_version = -1
+        # Cached last upload + the version it trained FROM: a recovery
+        # assignment whose ``expected`` is below that version means the
+        # server never saw our latest upload (in flight, or lost) —
+        # resend the cache instead of training the recovery assignment.
+        self._last_upload: Optional[Message] = None
+        self._last_upload_base = -1
+        self._beats = HeartbeatSender(
+            self._send_beat,
+            interval_s=(cfg.heartbeat_interval_s if beat_interval_s is None
+                        else beat_interval_s),
+            idle_timeout_s=idle_timeout_s,
+            on_idle=self.finish)
+
+    def run(self) -> None:
+        self._beats.start()
+        super().run()
+
+    def finish(self) -> None:
+        self._beats.stop()
+        super().finish()
+
+    def _send_beat(self) -> None:
+        self.send_message(Message(MSG_TYPE_C2S_HEARTBEAT, self.rank, 0))
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -161,11 +391,32 @@ class FedAsyncClientManager(ClientManager):
             MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_model)
 
     def handle_model(self, msg: Message) -> None:
+        self._beats.touch()
         if msg.get("done"):
             self.finish()
             return
         c = int(msg.get(MSG_ARG_KEY_CLIENT_INDEX))
         version = int(msg.get(MSG_ARG_KEY_MODEL_VERSION))
+        if msg.get("recovery"):
+            exp = msg.get("expected")
+            exp = int(exp) if exp is not None else -1
+            if self._last_upload is not None and self._last_upload_base > exp:
+                # The server thinks we are idle, but our latest upload
+                # postdates what it has accepted: it is in flight or was
+                # lost. Resend the cache (idempotent at the server's
+                # per-worker version high-water mark) instead of training
+                # the recovery assignment — a slow worker must not
+                # accumulate a backlog of live assignments, one per
+                # done_timeout_s of a long local round.
+                self.upload_resends += 1
+                self.send_message(self._last_upload)
+                return
+        if version <= self._last_version:
+            # Transport duplicate (ChaosTransport dup of an assignment):
+            # retraining it would upload a copy the server drops anyway.
+            self.duplicate_drops += 1
+            return
+        self._last_version = version
         rng = jax.random.fold_in(
             jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), self.steps),
             self.rank)
@@ -178,6 +429,8 @@ class FedAsyncClientManager(ClientManager):
         out.add(MSG_ARG_KEY_MODEL_PARAMS, jax.device_get(net))
         out.add(MSG_ARG_KEY_NUM_SAMPLES, int(self.train_fed.counts[c]))
         out.add(MSG_ARG_KEY_MODEL_VERSION, version)
+        self._last_upload = out
+        self._last_upload_base = version
         self.send_message(out)
 
 
@@ -190,18 +443,26 @@ def FedML_FedAsync_distributed(
     loss_fn=softmax_ce,
     alpha: float = 0.6,
     staleness_exp: float = 0.5,
+    *,
+    chaos: Optional[ChaosSpec] = None,
+    done_timeout_s: Optional[float] = None,
+    idle_timeout_s: float = 0.0,
 ):
     """Run the async federation: ``cfg.comm_round`` server model updates
     (arrivals, not barrier rounds) across ``cfg.client_num_per_round``
-    workers. Returns the server manager (net, staleness/test history)."""
+    workers. Returns the server manager (net, staleness/test history).
+    ``done_timeout_s`` (default ``cfg.round_timeout_s``) bounds the
+    terminal handshake against crash-stop workers; ``chaos`` installs the
+    fleet-wide fault-injecting transport."""
     size, net0, local_train, eval_fn, args = build_federation_setup(
-        model, train_fed, test_global, cfg, backend, loss_fn)
+        model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos)
     server = FedAsyncServerManager(args, net0, cfg, size, backend=backend,
                                    alpha=alpha, staleness_exp=staleness_exp,
-                                   eval_fn=eval_fn, test_data=test_global)
+                                   eval_fn=eval_fn, test_data=test_global,
+                                   done_timeout_s=done_timeout_s)
     clients = [
         FedAsyncClientManager(args, rank, size, train_fed, local_train, cfg,
-                              backend=backend)
+                              backend=backend, idle_timeout_s=idle_timeout_s)
         for rank in range(1, size)
     ]
     run_workers([server.run] + [c.run for c in clients])

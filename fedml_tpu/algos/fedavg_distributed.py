@@ -11,11 +11,42 @@ shard, and the server weighted-averages the returned pytrees.
 This path exists for TRUE federation (separate hosts/silos over loopback or
 the native TCP transport). Simulated federation should use ``FedAvgAPI``,
 where clients are a sharded array axis and aggregation is a psum over ICI.
+
+Fault-tolerant control plane (docs/ROBUSTNESS.md "Control plane"; the
+reference's ``check_whether_all_receive`` blocks unconditionally — one
+dead worker hangs its server forever):
+
+- **Heartbeat-driven membership** — workers piggyback liveness on
+  uploads plus a lightweight beat while training long rounds; the
+  server's watchdog runs the round deadline through
+  ``HeartbeatMonitor.wait_all_or_failed`` and EVICTS silent ranks: their
+  in-flight round is abandoned and aggregation proceeds over the
+  surviving cohort (partial-participation averaging still converges —
+  Parallel Restarted SGD, arXiv:1807.06629). A returning rank is
+  re-admitted through the stale-round catch-up path (or on a beat, when
+  its upload/assignment was lost in transit).
+- **Idempotent uploads** — a duplicated upload (ChaosTransport
+  duplication, sender retry after a lost ACK) is detected by the
+  per-worker round high-water mark and dropped without a reply, so the
+  aggregator never double-counts and no worker ever holds two
+  assignments.
+- **Bounded termination** — done-handshakes are tracked per member and
+  watched by the same watchdog, so a permanently dead rank can never
+  hang the run; dead-at-terminal ranks are evicted and the server exits.
+- **Crash-resume** — the server checkpoints its run state every
+  ``cfg.checkpoint_every`` rounds (async orbax save, off the round
+  critical path) and stamps a monotonic EPOCH into every message; a
+  restarted server restores the latest checkpoint, bumps the epoch, and
+  deterministically rejects pre-crash uploads while workers adopt the
+  new epoch from its re-broadcast assignments.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Set
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +56,9 @@ from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.comm.loopback import LoopbackNetwork, run_workers
 from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.resilience import ChaosSpec, HeartbeatSender
 from fedml_tpu.core.compression import make_compressor, tree_spec
+from fedml_tpu.core.faults import HeartbeatMonitor
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.core.tree import tree_scale, tree_add, tree_sub
 from fedml_tpu.data.batching import FederatedArrays
@@ -41,10 +74,16 @@ from fedml_tpu.trainer.local import (
 MSG_TYPE_S2C_INIT_CONFIG = 1
 MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = 2
 MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = 3
+# Control plane (no reference equivalent): worker liveness beats and the
+# server watchdog's self-addressed deadline tick.
+MSG_TYPE_C2S_HEARTBEAT = 4
+MSG_TYPE_SRV_TICK = 5
 
 MSG_ARG_KEY_MODEL_PARAMS = Message.MSG_ARG_KEY_MODEL_PARAMS
 MSG_ARG_KEY_CLIENT_INDEX = Message.MSG_ARG_KEY_CLIENT_INDEX
 MSG_ARG_KEY_NUM_SAMPLES = Message.MSG_ARG_KEY_NUM_SAMPLES
+
+log = logging.getLogger(__name__)
 
 
 class FedAVGAggregator:
@@ -74,8 +113,13 @@ class FedAVGAggregator:
     def aggregate_from(self, indices):
         """Weighted average over a subset of worker slots — the first-k
         straggler-tolerant mode aggregates only the workers that uploaded
-        fresh results this round."""
+        fresh results this round. An EMPTY index set (every sampled
+        worker evicted/excluded) keeps the previous global net, mirroring
+        ``_robust_avg``'s all-excluded behavior — ``self.net = None``
+        here would poison every later round."""
         indices = list(indices)
+        if not indices:
+            return self.net
         total = sum(self.sample_num_dict[i] for i in indices)
         avg = None
         for i in indices:
@@ -110,11 +154,26 @@ class FedAVGServerManager(ServerManager):
     current round ("catch-up"), so message flow stays strict
     request/response — every upload gets exactly one reply and no worker
     can hold two assignments. The reference has no straggler story at all
-    (check_whether_all_receive blocks on everyone)."""
+    (check_whether_all_receive blocks on everyone).
+
+    With ``round_timeout_s > 0`` the control plane is live: a watchdog
+    thread runs each round's deadline through
+    ``HeartbeatMonitor.wait_all_or_failed`` and posts a self-addressed
+    TICK message, so evictions execute on the receive-dispatch thread
+    like every other state change (handlers stay single-threaded).
+    Evicted ranks leave the membership — the first-k threshold shrinks
+    with it, a returning rank re-admits via catch-up — and the terminal
+    done-handshake is watched the same way, so the run always ends.
+    See the module docstring for the full failure model."""
 
     def __init__(self, args, aggregator: FedAVGAggregator, cfg: FedConfig,
                  size: int, backend: str = "LOOPBACK", compress: str = "none",
-                 aggregate_k: int = 0):
+                 aggregate_k: int = 0, *,
+                 round_timeout_s: Optional[float] = None,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 done_timeout_s: Optional[float] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 metrics=None, clock=time.monotonic):
         super().__init__(args, rank=0, size=size, backend=backend)
         if aggregate_k and not 1 <= aggregate_k <= size - 1:
             raise ValueError(
@@ -123,46 +182,187 @@ class FedAVGServerManager(ServerManager):
         self.cfg = cfg
         self.round_idx = 0
         self.aggregate_k = aggregate_k or (size - 1)
-        self._arrived: set = set()
+        self._arrived: Set[int] = set()
         self.straggler_drops = 0
-        self._done_workers = 0
+        self.duplicate_drops = 0
+        self.epoch_drops = 0
+        self.evictions = 0
+        self.readmissions = 0
+        self.aborted = False
+        self._members: Set[int] = set(range(1, size))
+        self._done_set: Set[int] = set()
+        self._last_upload_round: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._clock = clock
+        self.metrics = metrics
+        self.round_timeout_s = (cfg.round_timeout_s
+                                if round_timeout_s is None else round_timeout_s)
+        self.done_timeout_s = (done_timeout_s if done_timeout_s is not None
+                               else (self.round_timeout_s or 0.0))
+        self.heartbeat = HeartbeatMonitor(
+            range(1, size),
+            timeout_s=(heartbeat_timeout_s if heartbeat_timeout_s is not None
+                       else (self.round_timeout_s or 30.0)),
+            clock=clock)
         self._decoders = {}  # codec name → compressor (built lazily)
         self._spec = tree_spec(aggregator.net)
+        # Crash-resume: restore the latest checkpoint (if any) and run
+        # under a BUMPED epoch — every message carries it, so pre-crash
+        # uploads are deterministically rejected.
+        self.epoch = 0
+        self._ckpt = None
+        if checkpoint_dir:
+            from fedml_tpu.obs.checkpoint import (CheckpointManager,
+                                                  allocate_epoch,
+                                                  restore_federation)
+
+            self._ckpt = CheckpointManager(checkpoint_dir)
+            restored = restore_federation(self._ckpt, aggregator.net)
+            # allocate_epoch, not restored["epoch"] + 1: the restored
+            # round's checkpoint step is already durable, so the bumped
+            # epoch can't be re-saved there — two crashes inside one
+            # checkpoint window would otherwise reuse an epoch and let
+            # the previous incarnation's uploads through the fence. The
+            # EPOCH sidecar makes every start strictly monotonic (a
+            # crash BEFORE the first checkpoint is fenced too).
+            self.epoch = allocate_epoch(
+                self._ckpt, -1 if restored is None else restored["epoch"])
+            if restored is not None:
+                aggregator.net = restored["net"]
+                self.round_idx = restored["round_idx"]
+                log.info("server restored: round %d, epoch %d",
+                         self.round_idx, self.epoch)
         # The net broadcast this round — compressed uploads are deltas
         # against it, so reconstruction must use the same anchor.
         self._broadcast_net = aggregator.net
         del compress  # server decodes by each frame's self-described codec
 
+    # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:
         self.register_message_receive_handlers()
+        # Liveness clocks start when the RUN starts, not at construction:
+        # a slow __init__ (orbax import + checkpoint restore) must not
+        # make the whole fleet look expired to the first watchdog pass.
+        for r in self._members_snapshot():
+            self.heartbeat.beat(r)
         self.send_init_msg()
+        # Armed by EITHER deadline: done_timeout_s alone still bounds the
+        # terminal handshake (the loop guards each branch by its own
+        # timeout, so round deadlines stay off when round_timeout_s == 0).
+        if ((self.round_timeout_s and self.round_timeout_s > 0)
+                or (self.done_timeout_s and self.done_timeout_s > 0)):
+            threading.Thread(target=self._watchdog_loop, daemon=True).start()
         self.com_manager.handle_receive_message()
 
+    def finish(self) -> None:
+        self._stopped = True
+        if self._ckpt is not None:
+            try:
+                self._save_checkpoint(wait=True)
+            except Exception:  # noqa: BLE001 — shutdown must not re-raise
+                log.exception("final checkpoint save failed")
+            self._ckpt.close()
+            self._ckpt = None
+        super().finish()
+
     def send_init_msg(self) -> None:
-        client_indexes = self.aggregator.client_sampling(0)
-        for worker in range(1, self.size):
+        if self.round_idx >= self.cfg.comm_round:
+            # Restored at (or past) the terminal round: nothing to train.
+            for worker in self._members_snapshot():
+                self._send_done(worker)
+            return
+        client_indexes = self.aggregator.client_sampling(self.round_idx)
+        for worker in self._members_snapshot():
             msg = Message(MSG_TYPE_S2C_INIT_CONFIG, 0, worker)
             msg.add(MSG_ARG_KEY_MODEL_PARAMS, self.aggregator.net)
             msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[worker - 1]))
-            msg.add("round", 0)
-            self.send_message(msg)
+            msg.add("round", self.round_idx)
+            msg.add("epoch", self.epoch)
+            self._safe_send(msg, worker)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
             MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
             self.handle_message_receive_model_from_client,
         )
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_HEARTBEAT, self._handle_heartbeat)
+        self.register_message_receive_handler(
+            MSG_TYPE_SRV_TICK, self._handle_tick)
+
+    # -- snapshots (watchdog thread reads; handlers mutate under _lock) -----
+    def _members_snapshot(self) -> List[int]:
+        with self._lock:
+            return sorted(self._members)
+
+    def _arrived_snapshot(self) -> List[int]:
+        with self._lock:
+            return sorted(self._arrived)
+
+    def _done_snapshot(self) -> List[int]:
+        with self._lock:
+            return sorted(self._done_set)
+
+    def _k_effective(self) -> int:
+        return max(1, min(self.aggregate_k, len(self._members)))
+
+    def health(self) -> Dict[str, int]:
+        """Control-plane counters, surfaced per round through the metrics
+        logger and asserted on by the fault drills."""
+        with self._lock:
+            return {
+                "members": len(self._members),
+                "evictions": self.evictions,
+                "readmissions": self.readmissions,
+                "straggler_drops": self.straggler_drops,
+                "duplicate_drops": self.duplicate_drops,
+                "epoch_drops": self.epoch_drops,
+                "epoch": self.epoch,
+                "send_retries": getattr(self.com_manager, "retry_count", 0),
+            }
+
+    # -- fault-aware sends --------------------------------------------------
+    def _safe_send(self, msg: Message, worker: int) -> bool:
+        """Send; a transport-level failure (peer dead past the retry
+        policy) EVICTS the worker instead of crashing the control plane."""
+        try:
+            self.send_message(msg)
+            return True
+        except (ConnectionError, OSError) as err:
+            log.warning("send to worker %d failed (%s): evicting", worker, err)
+            self._evict([worker])
+            return False
+
+    def _evict(self, ranks) -> None:
+        # Evicted ranks STAY in the heartbeat monitor: an alive-but-slow
+        # rank (e.g. still jit-compiling its first round) keeps beating
+        # and is re-admitted by _handle_heartbeat; only ranks whose beats
+        # also stop are truly gone.
+        with self._lock:
+            for w in ranks:
+                if w in self._members:
+                    self._members.discard(w)
+                    self.evictions += 1
 
     def _send_done(self, worker: int) -> None:
         out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
         out.add(MSG_ARG_KEY_MODEL_PARAMS, self.aggregator.net)
         out.add("done", True)
-        self.send_message(out)
-        self._done_workers += 1
-        if self._done_workers == self.size - 1:
+        out.add("epoch", self.epoch)
+        if self._safe_send(out, worker):
+            with self._lock:
+                self._done_set.add(worker)
+        self._maybe_finish()
+
+    def _maybe_finish(self) -> None:
+        with self._lock:
+            done = self._done_set >= self._members
+        if done and not self._stopped:
             self.finish()
 
-    def _send_assignment(self, worker: int, client_indexes=None) -> None:
+    def _send_assignment(self, worker: int, client_indexes=None, *,
+                         resend: bool = False) -> None:
         if client_indexes is None:
             client_indexes = self.aggregator.client_sampling(self.round_idx)
         out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
@@ -170,17 +370,171 @@ class FedAVGServerManager(ServerManager):
         out.add(MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[worker - 1]))
         out.add("round", self.round_idx)
         out.add("done", False)
-        self.send_message(out)
+        out.add("epoch", self.epoch)
+        if resend:
+            # Re-admission: the worker's upload (or our assignment) was
+            # lost — a client that already trained this round should
+            # RESEND its cached upload. Only flagged assignments trigger
+            # that, so a plain transport duplicate of a normal assignment
+            # is dropped instead of costing a model-sized resend.
+            out.add("resend", True)
+        self._safe_send(out, worker)
 
+    # -- checkpointing ------------------------------------------------------
+    def _save_checkpoint(self, wait: bool) -> None:
+        from fedml_tpu.obs.checkpoint import save_federation
+
+        try:
+            save_federation(self._ckpt, self.aggregator.net, self.round_idx,
+                            self.epoch, wait=wait)
+        except Exception:  # noqa: BLE001 — e.g. an async save still in flight
+            self._ckpt.wait()
+            save_federation(self._ckpt, self.aggregator.net, self.round_idx,
+                            self.epoch, wait=wait)
+
+    # -- watchdog: round deadline + bounded done-handshake ------------------
+    def _watchdog_loop(self) -> None:
+        poll = max(0.005, min(
+            0.05, (self.round_timeout_s or self.done_timeout_s) / 10))
+        while not self._stopped:
+            members = self._members_snapshot()
+            if not members:
+                # Either everyone is dead (the tick handler aborts) or an
+                # eviction storm is healing through beat re-admissions —
+                # keep watching either way.
+                self._post_tick(self.round_idx, [])
+                time.sleep(max(poll, 0.1))
+                continue
+            r = self.round_idx
+            if r >= self.cfg.comm_round:
+                if self.done_timeout_s and self.done_timeout_s > 0:
+                    failed = self.heartbeat.wait_all_or_failed(
+                        members, have=self._done_snapshot, poll_s=poll,
+                        deadline_s=self.done_timeout_s)
+                    if not self._stopped and failed:
+                        self._post_tick(r, failed)
+            elif self.round_timeout_s and self.round_timeout_s > 0:
+                failed = self.heartbeat.wait_all_or_failed(
+                    members,
+                    have=lambda m=members, r=r: (
+                        m if (self._stopped or self.round_idx != r)
+                        else self._arrived_snapshot()),
+                    poll_s=poll, deadline_s=self.round_timeout_s)
+                if not self._stopped and failed and self.round_idx == r:
+                    self._post_tick(r, failed)
+            time.sleep(poll)
+
+    def _post_tick(self, round_idx: int, failed) -> None:
+        """Self-addressed deadline tick: eviction executes on the receive
+        thread, serialized with every other handler."""
+        msg = Message(MSG_TYPE_SRV_TICK, 0, 0)
+        msg.add("round", int(round_idx))
+        msg.add("failed", [int(w) for w in failed])
+        msg.add("epoch", self.epoch)
+        try:
+            self.send_message(msg)
+        except (ConnectionError, OSError):
+            pass  # next watchdog pass re-ticks
+
+    def _handle_tick(self, msg: Message) -> None:
+        ep = msg.get("epoch")
+        if ep is not None and int(ep) != self.epoch:
+            return  # tick from a pre-crash instance left in the inbox
+        failed = set(msg.get("failed") or [])
+        terminal = self.round_idx >= self.cfg.comm_round
+        with self._lock:
+            if terminal:
+                evict = [w for w in failed
+                         if w in self._members and w not in self._done_set]
+            else:
+                if int(msg.get("round", -1)) != self.round_idx:
+                    return  # stale: the round advanced while it was queued
+                evict = [w for w in failed
+                         if w in self._members and w not in self._arrived]
+        if evict:
+            log.warning("round %d deadline: evicting silent ranks %s",
+                        self.round_idx, evict)
+            self._evict(evict)
+        if terminal:
+            self._maybe_finish()
+            return
+        with self._lock:
+            empty = not self._members
+            ready = bool(self._arrived) and (
+                len(self._arrived) >= self._k_effective())
+        if empty:
+            if self.heartbeat.alive():
+                # Everyone missed the deadline but someone still beats
+                # (e.g. the whole fleet is jit-compiling its first
+                # round): hold the round open — the next beats re-admit
+                # them and their uploads complete it.
+                return
+            # Every worker is gone; nothing can ever arrive again.
+            log.error("all workers evicted at round %d: abandoning the run",
+                      self.round_idx)
+            self.aborted = True
+            self.finish()
+            return
+        if ready:
+            self._complete_round()
+
+    def _handle_heartbeat(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        self.heartbeat.beat(sender)
+        if self.round_idx >= self.cfg.comm_round:
+            # Any beat at the terminal round gets a done (idempotent: the
+            # worker finishes on first receipt). Members and done-set
+            # ranks may have lost theirs in transit; an EVICTED-but-alive
+            # rank (slow past the done deadline, then resumed beating)
+            # has never been sent one at all — with idle_timeout_s=0 it
+            # would otherwise block on its receive loop forever.
+            self._send_done(sender)
+            return
+        with self._lock:
+            member = sender in self._members
+        if not member:
+            # Evicted-but-alive: its upload or our assignment was lost,
+            # or it was slow past the deadline. Re-admit with the current
+            # round's work, resend-flagged: a client that never saw the
+            # assignment trains it, one that already trained this round
+            # resends its cached upload (idempotent at our high-water
+            # mark) instead of dropping the copy.
+            with self._lock:
+                self._members.add(sender)
+                self.readmissions += 1
+            log.info("re-admitting rank %d on heartbeat", sender)
+            self._send_assignment(sender, resend=True)
+
+    # -- the round ----------------------------------------------------------
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
+        ep = msg.get("epoch")
+        if ep is not None and int(ep) != self.epoch:
+            # Pre-crash upload: the restarted server already re-broadcast
+            # assignments under the new epoch, so this worker has live
+            # work — reject deterministically, never reply.
+            self.epoch_drops += 1
+            return
+        self.heartbeat.beat(sender)
+        tag = msg.get("round")
+        t = int(tag) if tag is not None else self.round_idx
+        with self._lock:
+            if t <= self._last_upload_round.get(sender, -1):
+                # Duplicate delivery (ChaosTransport duplication, sender
+                # retry after a lost ACK): the first copy was answered —
+                # replying again would hand the worker two assignments.
+                self.duplicate_drops += 1
+                return
+            self._last_upload_round[sender] = t
+            if sender not in self._members:
+                self._members.add(sender)
+                self.readmissions += 1
         if self.round_idx >= self.cfg.comm_round:
             # Terminal: a straggler's in-flight upload after the final
             # aggregation — release it.
             self._send_done(sender)
             return
-        tag = msg.get("round")
-        if tag is not None and int(tag) != self.round_idx:
+        if t != self.round_idx:
             # Stale upload from an older round: discard the model, catch
             # the worker up on the current round.
             self.straggler_drops += 1
@@ -199,41 +553,84 @@ class FedAVGServerManager(ServerManager):
         self.aggregator.add_local_trained_result(
             sender - 1, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES)
         )
-        self._arrived.add(sender)
-        if len(self._arrived) < self.aggregate_k:
-            return
-        global_net = self.aggregator.aggregate_from(
-            sorted(w - 1 for w in self._arrived))
+        with self._lock:
+            self._arrived.add(sender)
+            ready = len(self._arrived) >= self._k_effective()
+        if ready:
+            self._complete_round()
+
+    def _complete_round(self) -> None:
+        with self._lock:
+            arrived = sorted(self._arrived)
+            self._arrived = set()
+        global_net = self.aggregator.aggregate_from([w - 1 for w in arrived])
         self._broadcast_net = global_net
         if (
             self.round_idx % self.cfg.frequency_of_the_test == 0
             or self.round_idx == self.cfg.comm_round - 1
         ):
             self.aggregator.test_on_server(self.round_idx)
+        completed = self.round_idx
         self.round_idx += 1
-        arrived, self._arrived = self._arrived, set()
+        self._log_round_health(completed, arrived)
+        if self._ckpt is not None and self.cfg.checkpoint_every and (
+            self.round_idx % self.cfg.checkpoint_every == 0
+        ):
+            self._save_checkpoint(wait=False)
         if self.round_idx >= self.cfg.comm_round:
-            for worker in sorted(arrived):
+            for worker in arrived:
                 self._send_done(worker)
             return
         client_indexes = self.aggregator.client_sampling(self.round_idx)
-        for worker in sorted(arrived):
+        for worker in arrived:
             self._send_assignment(worker, client_indexes)
+
+    def _log_round_health(self, round_idx: int, arrived) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.log({"arrived": len(arrived), **self.health()},
+                         step=round_idx, prefix="ctrl")
 
 
 class FedAVGClientManager(ClientManager):
     """Worker process: jitted local training on the assigned client's shard
-    (FedAvgClientManager.py:34-79)."""
+    (FedAvgClientManager.py:34-79). Control-plane duties: adopt the
+    server's epoch (resetting the round dedupe on a restart), drop
+    duplicated assignments by round tag, beat every
+    ``beat_interval_s`` while training keeps the upload path silent, and
+    self-terminate after ``idle_timeout_s`` without server contact (a
+    crashed-and-never-restarted server must not strand its workers)."""
 
     def __init__(self, args, rank: int, size: int, train_fed: FederatedArrays,
                  local_train, cfg: FedConfig, backend: str = "LOOPBACK",
-                 compress: str = "none"):
+                 compress: str = "none", *,
+                 beat_interval_s: Optional[float] = None,
+                 idle_timeout_s: float = 0.0):
         super().__init__(args, rank=rank, size=size, backend=backend)
         self.train_fed = train_fed
         self.local_train = local_train
         self.cfg = cfg
         self.round_idx = 0
+        self.epoch = 0
+        self.duplicate_drops = 0
+        self.upload_resends = 0
+        self._last_handled = -1
+        # The last upload message, kept until the NEXT round's assignment
+        # arrives: a RESEND-flagged re-assignment of the round we already
+        # trained means our upload was lost in transit (the server flags
+        # re-admission assignments) — resend it instead of dropping the
+        # assignment, or a round whose every upload was lost would
+        # evict/re-admit/livelock forever. One message of memory; the
+        # server's per-worker round high-water mark makes resends
+        # idempotent.
+        self._last_upload: Optional[Message] = None
         self._compressor = make_compressor(compress)
+        self._beats = HeartbeatSender(
+            self._send_beat,
+            interval_s=(cfg.heartbeat_interval_s if beat_interval_s is None
+                        else beat_interval_s),
+            idle_timeout_s=idle_timeout_s,
+            on_idle=self._idle_quit)
         # Latest top-k error-feedback residual: (round, client, residual).
         # EF theory requires the residual to stay with its own data
         # stream, so it is applied only when this rank trains the SAME
@@ -252,6 +649,24 @@ class FedAVGClientManager(ClientManager):
         # cause the drops.
         self.ef_carry_drops = 0
 
+    def run(self) -> None:
+        self._beats.start()
+        super().run()
+
+    def finish(self) -> None:
+        self._beats.stop()
+        super().finish()
+
+    def _send_beat(self) -> None:
+        msg = Message(MSG_TYPE_C2S_HEARTBEAT, self.rank, 0)
+        msg.add("epoch", self.epoch)
+        self.send_message(msg)
+
+    def _idle_quit(self) -> None:
+        log.warning("rank %d: no server contact for %.1fs — exiting",
+                    self.rank, self._beats.idle_timeout_s)
+        self.finish()
+
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
             MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init
@@ -262,17 +677,52 @@ class FedAVGClientManager(ClientManager):
         )
 
     def handle_message_init(self, msg: Message) -> None:
-        self.round_idx = int(msg.get("round") or 0)
-        self._train(msg.get(MSG_ARG_KEY_MODEL_PARAMS), msg.get(MSG_ARG_KEY_CLIENT_INDEX))
+        self._handle_assignment(msg)
 
     def handle_message_receive_model_from_server(self, msg: Message) -> None:
+        self._handle_assignment(msg)
+
+    def _handle_assignment(self, msg: Message) -> None:
+        self._beats.touch()
+        ep = msg.get("epoch")
+        if ep is not None:
+            ep = int(ep)
+            if ep < self.epoch:
+                return  # straggler message from a dead server epoch
+            if ep > self.epoch:
+                # Server restarted: adopt its epoch and reset the round
+                # dedupe — the restored run legitimately replays rounds.
+                # The cached upload died with the old epoch.
+                self.epoch = ep
+                self._last_handled = -1
+                self._last_upload = None
         if msg.get("done"):
             self.finish()
             return
         # The server's round tag, not a local counter: under first-k
         # aggregation a straggler can be reassigned past skipped rounds.
         tag = msg.get("round")
-        self.round_idx = int(tag) if tag is not None else self.round_idx + 1
+        if tag is not None:
+            t = int(tag)
+            if t <= self._last_handled:
+                if (t == self._last_handled and msg.get("resend")
+                        and self._last_upload is not None):
+                    # Resend-flagged re-assignment of the round we
+                    # already trained: the server re-admitted us, so our
+                    # upload was lost in transit. Resend it — idempotent
+                    # at the server's round high-water mark. Unflagged
+                    # copies are plain transport duplicates and drop
+                    # below, costing nothing on the wire.
+                    self.upload_resends += 1
+                    self.send_message(self._last_upload)
+                    return
+                # Transport duplicate of a handled assignment.
+                self.duplicate_drops += 1
+                return
+            self._last_handled = t
+            self.round_idx = t
+        else:
+            self.round_idx += 1
         self._train(msg.get(MSG_ARG_KEY_MODEL_PARAMS), msg.get(MSG_ARG_KEY_CLIENT_INDEX))
 
     def _train(self, global_net, client_index: int) -> None:
@@ -303,20 +753,24 @@ class FedAVGClientManager(ClientManager):
             out.add(MSG_ARG_KEY_MODEL_PARAMS, jax.device_get(net))
         out.add(MSG_ARG_KEY_NUM_SAMPLES, int(self.train_fed.counts[c]))
         out.add("round", self.round_idx)
+        out.add("epoch", self.epoch)
         if not (self.cfg.dp_clip and self.cfg.dp_clip > 0):
             # Under DP-SGD the exact train loss is an un-noised function of
             # the private examples; releasing it would void the accounted
             # (eps, delta). Only the noised model leaves the silo.
             out.add("train_loss", float(loss))
+        self._last_upload = out
         self.send_message(out)
 
 
 def build_federation_setup(model, train_fed: FederatedArrays, test_global,
-                           cfg: FedConfig, backend: str, loss_fn):
+                           cfg: FedConfig, backend: str, loss_fn,
+                           chaos: Optional[ChaosSpec] = None):
     """Shared worker-process scaffolding for the message-passing
     federations (sync FedAvg here, async in fedasync.py): model fns +
     initial net, jitted local trainer / eval, and the backend ``args``
-    shim. Returns ``(size, net0, local_train, eval_fn, args)``."""
+    shim (``chaos`` installs a fleet-wide ChaosTransport wrapper).
+    Returns ``(size, net0, local_train, eval_fn, args)``."""
     size = cfg.client_num_per_round + 1
     fns = model_fns(model)
     sample_x = jnp.zeros((1,) + train_fed.x.shape[3:], train_fed.x.dtype)
@@ -331,6 +785,7 @@ def build_federation_setup(model, train_fed: FederatedArrays, test_global,
         pass
 
     args = Args()
+    args.chaos = chaos
     if backend == "LOOPBACK":
         args.network = LoopbackNetwork(size)
     elif backend in ("TCP", "GRPC", "TRPC"):
@@ -350,6 +805,11 @@ def FedML_FedAvg_distributed(
     loss_fn=softmax_ce,
     compress: str = "none",
     aggregate_k: int = 0,
+    *,
+    chaos: Optional[ChaosSpec] = None,
+    checkpoint_dir: Optional[str] = None,
+    metrics=None,
+    idle_timeout_s: float = 0.0,
 ):
     """Build server + ``client_num_per_round`` workers on the chosen backend
     and run the full federation (FedAvgAPI.py:20 analogue). Returns the
@@ -360,15 +820,25 @@ def FedML_FedAvg_distributed(
     quantization); see fedml_tpu.core.compression.
 
     ``aggregate_k``: straggler-tolerant first-k rounds (0 = wait for all
-    workers; see FedAVGServerManager)."""
+    workers; see FedAVGServerManager).
+
+    Control plane (docs/ROBUSTNESS.md): ``cfg.round_timeout_s`` arms the
+    eviction watchdog, ``cfg.heartbeat_interval_s`` the worker beats,
+    ``cfg.checkpoint_every`` + ``checkpoint_dir`` crash-resume, ``chaos``
+    a fleet-wide fault-injecting transport wrapper, ``metrics`` a
+    MetricsLogger for per-round health counters, ``idle_timeout_s`` the
+    workers' no-server-contact self-termination bound."""
     size, net0, local_train, eval_fn, args = build_federation_setup(
-        model, train_fed, test_global, cfg, backend, loss_fn)
+        model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos)
     aggregator = FedAVGAggregator(net0, size - 1, cfg, eval_fn, test_global)
     server = FedAVGServerManager(args, aggregator, cfg, size, backend=backend,
-                                 compress=compress, aggregate_k=aggregate_k)
+                                 compress=compress, aggregate_k=aggregate_k,
+                                 checkpoint_dir=checkpoint_dir,
+                                 metrics=metrics)
     clients = [
         FedAVGClientManager(args, rank, size, train_fed, local_train, cfg,
-                            backend=backend, compress=compress)
+                            backend=backend, compress=compress,
+                            idle_timeout_s=idle_timeout_s)
         for rank in range(1, size)
     ]
     run_workers([server.run] + [c.run for c in clients])

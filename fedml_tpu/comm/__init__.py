@@ -19,12 +19,24 @@ base_com_manager.py:7, client/client_manager.py:14) — with five backends:
   semantics, epoch+seq idempotent delivery) with the pickle-free
   ``tensor`` wire format (the TensorPipe role, trpc_comm_manager.py:25)
 - ``mqtt`` — broker pub/sub for device/mobile edges (requires paho-mqtt)
+
+Cross-cutting resilience (fedml_tpu.comm.resilience): one ``RetryPolicy``
+shared by every backend's ``send_message``, and ``ChaosTransport`` — a
+seeded deterministic fault injector (drop/delay/duplicate/reorder/
+partition) over any backend, enabled fleet-wide via ``args.chaos``.
 """
 
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
 from fedml_tpu.comm.loopback import LoopbackNetwork, LoopbackCommManager
 from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.comm.resilience import (
+    ChaosSpec,
+    ChaosTransport,
+    HeartbeatSender,
+    RetryGiveUp,
+    RetryPolicy,
+)
 
 __all__ = [
     "Message",
@@ -34,4 +46,9 @@ __all__ = [
     "LoopbackCommManager",
     "ClientManager",
     "ServerManager",
+    "ChaosSpec",
+    "ChaosTransport",
+    "HeartbeatSender",
+    "RetryGiveUp",
+    "RetryPolicy",
 ]

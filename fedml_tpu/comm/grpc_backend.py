@@ -27,11 +27,11 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
 from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.resilience import RetryPolicy
 from fedml_tpu.comm.wire import WIRE_FORMATS, deserialize_message, serialize_message
 
 SERVICE_NAME = "fedml.tpu.CommService"
@@ -140,7 +140,9 @@ class GrpcCommManager(BaseCommunicationManager):
     """
 
     def __init__(self, ip_config: Dict[int, Tuple[str, int]], rank: int,
-                 serializer: str = "pickle", max_workers: int = 8):
+                 serializer: str = "pickle", max_workers: int = 8,
+                 retry_first: Optional[RetryPolicy] = None,
+                 retry: Optional[RetryPolicy] = None):
         import grpc
         from concurrent import futures
 
@@ -148,11 +150,18 @@ class GrpcCommManager(BaseCommunicationManager):
             raise ValueError(f"unknown serializer {serializer!r}")
         self._grpc = grpc
         self._serializer = serializer
+        # The per-attempt RPC deadline used to be a hardcoded 120 s
+        # buried in send_message; it now rides the shared policy.
+        self._retry_first = retry_first or RetryPolicy.first_contact(
+            seed=rank, attempt_timeout_s=120.0)
+        self._retry = retry or RetryPolicy.established(
+            seed=rank, attempt_timeout_s=120.0)
         self.rank = rank
         self.ip_config = ip_config
         self._queue: "queue.Queue[bytes]" = queue.Queue()
         self._observers: List[Observer] = []
         self._running = False
+        self._stop_requested = False
         self._contacted: set = set()
         self._channels: Dict[int, object] = {}
         self._lock = threading.Lock()
@@ -202,35 +211,46 @@ class GrpcCommManager(BaseCommunicationManager):
                 self._channels[receiver] = entry
             return entry[1]
 
+    @property
+    def retry_count(self) -> int:
+        return self._retry_first.retries + self._retry.retries
+
+    def _send_once(self, receiver: int, frame: bytes,
+                   timeout_s: float) -> None:
+        try:
+            ack = self._stub(receiver)(frame, timeout=timeout_s)
+        except self._grpc.RpcError as err:
+            code = err.code() if hasattr(err, "code") else None
+            host, port = self.ip_config[receiver]
+            exc = ConnectionError(
+                f"grpc: send from rank {self.rank} to {receiver} "
+                f"({host}:{port}) failed: {code}")
+            # Only UNAVAILABLE (peer not up yet / mid-restart) is worth a
+            # retry — the policy's predicate reads this marker.
+            exc.retriable = code == self._grpc.StatusCode.UNAVAILABLE
+            raise exc from err
+        if decode_comm_ack(ack) != 0:
+            raise ConnectionError(
+                f"grpc: rank {receiver} rejected the message")
+        self._contacted.add(receiver)
+
     # -- BaseCommunicationManager ------------------------------------------
-    def send_message(self, msg: Message, retries: int = 20,
-                     backoff_s: float = 0.5) -> None:
-        """Retry ``UNAVAILABLE`` only until a peer is first reached (ranks
-        start in any order; once contacted, a dead silo must surface
-        immediately) — same policy as the TCP backend."""
+    def send_message(self, msg: Message) -> None:
+        """Send under the shared RetryPolicy: ``UNAVAILABLE`` retried
+        generously until a peer is first reached (ranks start in any
+        order; once contacted, a dead silo must surface immediately) —
+        same discipline as the TCP backend."""
         receiver = int(msg.get_receiver_id())
         frame = encode_comm_request(
             self.rank, serialize_message(msg, self._serializer),
             self._serializer)
-        call = self._stub(receiver)
-        n_tries = (retries if receiver not in self._contacted else 0) + 1
-        for attempt in range(n_tries):
-            try:
-                ack = call(frame, timeout=120.0)
-                if decode_comm_ack(ack) != 0:
-                    raise ConnectionError(
-                        f"grpc: rank {receiver} rejected the message")
-                self._contacted.add(receiver)
-                return
-            except self._grpc.RpcError as err:
-                code = err.code() if hasattr(err, "code") else None
-                retriable = code == self._grpc.StatusCode.UNAVAILABLE
-                if not retriable or attempt == n_tries - 1:
-                    host, port = self.ip_config[receiver]
-                    raise ConnectionError(
-                        f"grpc: send from rank {self.rank} to {receiver} "
-                        f"({host}:{port}) failed: {code}") from err
-                time.sleep(backoff_s)
+        policy = (self._retry if receiver in self._contacted
+                  else self._retry_first)
+        policy.run(
+            lambda: self._send_once(receiver, frame,
+                                    policy.attempt_timeout_s or 120.0),
+            retriable=lambda e: getattr(e, "retriable", False),
+            describe=f"grpc send rank {self.rank} -> {receiver}")
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -251,7 +271,9 @@ class GrpcCommManager(BaseCommunicationManager):
         import logging
 
         log = logging.getLogger(__name__)
-        self._running = True
+        # Honor a stop that ran BEFORE the loop started (stop-before-start
+        # race: a restored-at-terminal server finishes in send_init_msg).
+        self._running = not self._stop_requested
         while self._running:
             try:
                 frame = self._queue.get(timeout=0.2)
@@ -275,6 +297,7 @@ class GrpcCommManager(BaseCommunicationManager):
                 obs.receive_message(msg.get_type(), msg)
 
     def stop_receive_message(self) -> None:
+        self._stop_requested = True  # latched: stop-before-start must hold
         self._running = False
 
     def close(self) -> None:

@@ -44,6 +44,7 @@ class LoopbackCommManager(BaseCommunicationManager):
         self.size = network.size
         self._observers: List[Observer] = []
         self._running = False
+        self._stop_requested = False
 
     def send_message(self, msg: Message) -> None:
         self.network.post(int(msg.get_receiver_id()), msg)
@@ -55,7 +56,9 @@ class LoopbackCommManager(BaseCommunicationManager):
         self._observers.remove(observer)
 
     def handle_receive_message(self) -> None:
-        self._running = True
+        # Stop-before-start: the _STOP sentinel is already queued, but the
+        # latch also covers it without draining whatever preceded it.
+        self._running = not self._stop_requested
         inbox = self.network.inbox(self.rank)
         while self._running:
             msg = inbox.get()
@@ -65,6 +68,7 @@ class LoopbackCommManager(BaseCommunicationManager):
                 obs.receive_message(msg.get_type(), msg)
 
     def stop_receive_message(self) -> None:
+        self._stop_requested = True  # latched: stop-before-start must hold
         self._running = False
         self.network.post(self.rank, _STOP)
 

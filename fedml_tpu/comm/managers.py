@@ -25,24 +25,34 @@ from fedml_tpu.comm.message import Message
 
 def _build_backend(args, rank: int, size: int, backend: str) -> BaseCommunicationManager:
     if backend == "LOOPBACK":
-        return LoopbackCommManager(args.network, rank)
-    if backend == "TCP":
+        mgr: BaseCommunicationManager = LoopbackCommManager(args.network, rank)
+    elif backend == "TCP":
         from fedml_tpu.comm.tcp import TcpCommManager
 
-        return TcpCommManager(args.host_table, rank)
-    if backend == "GRPC":
+        mgr = TcpCommManager(args.host_table, rank)
+    elif backend == "GRPC":
         from fedml_tpu.comm.grpc_backend import GrpcCommManager
 
-        return GrpcCommManager(args.host_table, rank)
-    if backend == "MQTT":
+        mgr = GrpcCommManager(args.host_table, rank)
+    elif backend == "MQTT":
         from fedml_tpu.comm.mqtt import MqttCommManager
 
-        return MqttCommManager(args.mqtt_host, args.mqtt_port, rank, size)
-    if backend == "TRPC":
+        mgr = MqttCommManager(args.mqtt_host, args.mqtt_port, rank, size)
+    elif backend == "TRPC":
         from fedml_tpu.comm.trpc import TRPCCommManager
 
-        return TRPCCommManager(args.host_table, rank)
-    raise ValueError(f"unknown comm backend {backend!r}")
+        mgr = TRPCCommManager(args.host_table, rank)
+    else:
+        raise ValueError(f"unknown comm backend {backend!r}")
+    # Fault drills: ``args.chaos`` (a resilience.ChaosSpec, shared by the
+    # whole fleet) wraps the real backend in a ChaosTransport, so drills
+    # exercise the exact transport code paths production uses.
+    spec = getattr(args, "chaos", None)
+    if spec is not None:
+        from fedml_tpu.comm.resilience import ChaosTransport
+
+        mgr = ChaosTransport(mgr, spec, rank)
+    return mgr
 
 
 class _Manager(Observer):

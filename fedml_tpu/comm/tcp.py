@@ -20,11 +20,11 @@ from __future__ import annotations
 
 import ctypes
 import csv
-import time
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
 from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.resilience import RetryPolicy
 from fedml_tpu.comm.wire import WIRE_FORMATS, deserialize_message, serialize_message
 
 DEFAULT_BASE_PORT = 50000
@@ -56,14 +56,21 @@ class TcpCommManager(BaseCommunicationManager):
     """
 
     def __init__(self, ip_config: Dict[int, Tuple[str, int]], rank: int,
-                 backlog: int = 128, serializer: str = "pickle"):
+                 backlog: int = 128, serializer: str = "pickle",
+                 retry_first: Optional[RetryPolicy] = None,
+                 retry: Optional[RetryPolicy] = None):
         """``serializer``: 'pickle' or 'json' — see
-        :mod:`fedml_tpu.comm.wire` for the trust trade-off."""
+        :mod:`fedml_tpu.comm.wire` for the trust trade-off.
+
+        ``retry_first`` / ``retry``: the shared RetryPolicy pair — used
+        until a peer is first reached / afterwards (comm.resilience)."""
         from fedml_tpu.native import load_msgnet
 
         if serializer not in WIRE_FORMATS:
             raise ValueError(f"unknown serializer {serializer!r}")
         self._serializer = serializer
+        self._retry_first = retry_first or RetryPolicy.first_contact(seed=rank)
+        self._retry = retry or RetryPolicy.established(seed=rank)
         self._lib = load_msgnet()
         self.rank = rank
         # Shared BY REFERENCE: with ephemeral ports (port 0) each rank
@@ -80,37 +87,50 @@ class TcpCommManager(BaseCommunicationManager):
         self._sender = self._lib.mn_sender_create()
         self._observers: List[Observer] = []
         self._running = False
+        self._stop_requested = False
         self._contacted: set = set()  # peers reached at least once
 
     @property
     def port(self) -> int:
         return self.ip_config[self.rank][1]
 
+    @property
+    def retry_count(self) -> int:
+        return self._retry_first.retries + self._retry.retries
+
+    def _send_once(self, receiver: int, host: str, port: int,
+                   blob: bytes) -> None:
+        """One transport attempt — the unit the RetryPolicy wraps (also
+        the no-policy side of bench.py's ``chaos_clean_overhead`` A/B).
+        bytes → const uint8* zero-copy (argtype c_char_p)."""
+        rc = self._lib.mn_send(self._sender, host.encode(), port, blob,
+                               len(blob))
+        if rc != 0:
+            raise ConnectionError(
+                f"msgnet: send from rank {self.rank} to {receiver} "
+                f"({host}:{port}) failed (rc={rc})")
+        self._contacted.add(receiver)
+
     # -- BaseCommunicationManager ------------------------------------------
-    def send_message(self, msg: Message, retries: int = 20,
-                     backoff_s: float = 0.5) -> None:
-        """Send with connect retries ONLY until a peer is first reached:
-        cross-silo processes start in any order, so the first sends may
-        race the receiver's bind (the reference's MPI launcher sidesteps
-        this because mpirun barrier-starts all ranks). Once a peer has been
-        contacted, failures are treated as real (one quick re-attempt via
-        the C layer's reconnect, then raise) — a crashed silo must surface
-        in ~0 s, not after a 10 s retry window per message."""
+    def send_message(self, msg: Message) -> None:
+        """Send under the shared RetryPolicy: generous first-contact
+        retries (cross-silo processes start in any order, so the first
+        sends may race the receiver's bind — the reference's MPI launcher
+        sidesteps this because mpirun barrier-starts all ranks); once a
+        peer has been contacted, one quick re-attempt (the C layer
+        reconnects), then raise — a crashed silo must surface in ~0 s,
+        not after a retry window per message."""
         receiver = int(msg.get_receiver_id())
-        host, port = self.ip_config[receiver]
         blob = serialize_message(msg, self._serializer)
-        n_tries = (retries if receiver not in self._contacted else 0) + 1
-        # bytes → const uint8* zero-copy (argtype c_char_p).
-        for attempt in range(n_tries):
-            rc = self._lib.mn_send(self._sender, host.encode(), port, blob, len(blob))
-            if rc == 0:
-                self._contacted.add(receiver)
-                return
-            if attempt < n_tries - 1:
-                time.sleep(backoff_s)
-        raise ConnectionError(
-            f"msgnet: send from rank {self.rank} to {receiver} "
-            f"({host}:{port}) failed after {n_tries} attempts")
+        policy = (self._retry if receiver in self._contacted
+                  else self._retry_first)
+        # ip_config is re-read per attempt: a restarted peer may have
+        # rebound an ephemeral port into the shared table mid-retry.
+        policy.run(
+            lambda: self._send_once(receiver, *self.ip_config[receiver],
+                                    blob),
+            retriable=lambda e: isinstance(e, (ConnectionError, OSError)),
+            describe=f"msgnet send rank {self.rank} -> {receiver}")
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -119,8 +139,12 @@ class TcpCommManager(BaseCommunicationManager):
         self._observers.remove(observer)
 
     def handle_receive_message(self) -> None:
-        """Blocking receive loop; returns after ``stop_receive_message``."""
-        self._running = True
+        """Blocking receive loop; returns after ``stop_receive_message`` —
+        including a stop that ran BEFORE this loop started (a server
+        restored at the terminal round can finish inside send_init_msg;
+        re-arming unconditionally here would then spin forever on the
+        already-stopped native server)."""
+        self._running = not self._stop_requested
         out_len = ctypes.c_uint64()
         while self._running:
             ptr = self._lib.mn_server_recv(self._server, 200, ctypes.byref(out_len))
@@ -135,6 +159,7 @@ class TcpCommManager(BaseCommunicationManager):
                 obs.receive_message(msg.get_type(), msg)
 
     def stop_receive_message(self) -> None:
+        self._stop_requested = True  # latched: stop-before-start must hold
         self._running = False
 
     def close(self) -> None:

@@ -26,12 +26,12 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-import time
 from queue import Empty, Queue
 from typing import Dict, List, Optional, Tuple
 
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
 from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.resilience import RetryPolicy
 from fedml_tpu.comm.wire import deserialize_message, serialize_message
 
 _ACK = b"\x06"  # the servicer's "message received" response, one byte
@@ -70,7 +70,9 @@ class TRPCCommManager(BaseCommunicationManager):
 
     def __init__(self, ip_config: Optional[Dict[int, Tuple[str, int]]] = None,
                  rank: int = 0, *, trpc_master_config_path: Optional[str] = None,
-                 world_size: int = 0):
+                 world_size: int = 0,
+                 retry_first: Optional[RetryPolicy] = None,
+                 retry: Optional[RetryPolicy] = None):
         if ip_config is None:
             if trpc_master_config_path is None:
                 raise ValueError(
@@ -83,9 +85,16 @@ class TRPCCommManager(BaseCommunicationManager):
             ip_config = {r: (host, base + r) for r in range(world_size)}
         self.rank = rank
         self.ip_config = ip_config  # shared BY REFERENCE (ephemeral ports)
+        # The 30 s budget is for the CONNECT only (attempt_timeout_s); a
+        # model-sized sendall / ack wait on a slow link must not expire.
+        self._retry_first = retry_first or RetryPolicy.first_contact(
+            seed=rank, attempt_timeout_s=30.0)
+        self._retry = retry or RetryPolicy.established(
+            seed=rank, attempt_timeout_s=30.0)
         self._queue: Queue = Queue()
         self._observers: List[Observer] = []
         self._running = False
+        self._stop_requested = False
         self._conns: Dict[int, socket.socket] = {}
         self._send_lock = threading.Lock()
         self._send_seq = 0  # per-sender monotone id; receiver dedupes
@@ -153,14 +162,41 @@ class TRPCCommManager(BaseCommunicationManager):
                         self._queue.put(msg)
                 conn.sendall(_ACK)
 
+    @property
+    def retry_count(self) -> int:
+        return self._retry_first.retries + self._retry.retries
+
+    def _send_once(self, receiver: int, head: bytes, blob: bytes,
+                   connect_timeout_s: Optional[float] = None) -> None:
+        try:
+            conn = self._conns.get(receiver)
+            if conn is None:
+                conn = socket.create_connection(
+                    self.ip_config[receiver],
+                    timeout=(connect_timeout_s
+                             if connect_timeout_s is not None
+                             else self._retry.attempt_timeout_s))
+                conn.settimeout(None)
+                self._conns[receiver] = conn
+            # Two sendalls: concatenating would copy the whole (possibly
+            # model-sized) blob a second time.
+            conn.sendall(head)
+            conn.sendall(blob)
+            if _recv_exact(conn, 1) != _ACK:
+                raise ConnectionError("bad ack")
+        except OSError:
+            self._conns.pop(receiver, None)
+            raise
+
     # -- BaseCommunicationManager ------------------------------------------
-    def send_message(self, msg: Message, retries: int = 20,
-                     backoff_s: float = 0.5) -> None:
+    def send_message(self, msg: Message) -> None:
         """rpc_sync semantics: returns only after the receiver acked the
-        enqueue. Connect retries until a peer is first reached (workers
-        start in any order); an already-contacted peer gets exactly ONE
-        immediate reconnect+resend (safe: the receiver dedupes on
-        (sender, epoch, seq)) before the failure surfaces."""
+        enqueue, under the shared RetryPolicy — generous connect retries
+        until a peer is first reached (workers start in any order), one
+        immediate reconnect+resend afterwards. Retries are SAFE here
+        (unlike a naive resend): the receiver dedupes on (sender, epoch,
+        seq), so a frame whose ACK was lost is re-acked without a second
+        enqueue."""
         receiver = int(msg.get_receiver_id())
         blob = serialize_message(msg, "tensor")
         if len(blob) > self.max_frame_bytes:
@@ -173,33 +209,18 @@ class TRPCCommManager(BaseCommunicationManager):
             self._send_seq += 1
             head = struct.pack("<QQQ", len(blob), self._send_epoch,
                                self._send_seq)
-            first_contact = receiver not in self._conns
-            # Retries are SAFE here (unlike a naive resend): the receiver
-            # dedupes on (sender, epoch, seq), so a frame whose ACK was lost is
-            # re-acked without a second enqueue.
-            for attempt in range(retries + 1 if first_contact else 2):
-                try:
-                    conn = self._conns.get(receiver)
-                    if conn is None:
-                        conn = socket.create_connection(
-                            self.ip_config[receiver], timeout=30)
-                        # The 30s budget is for the CONNECT only; a send
-                        # of a model-sized blob (or the ack wait behind
-                        # it) on a slow link must not spuriously expire.
-                        conn.settimeout(None)
-                        self._conns[receiver] = conn
-                    # Two sendalls: concatenating would copy the whole
-                    # (possibly model-sized) blob a second time.
-                    conn.sendall(head)
-                    conn.sendall(blob)
-                    if _recv_exact(conn, 1) != _ACK:
-                        raise ConnectionError("bad ack")
-                    return
-                except OSError:
-                    self._conns.pop(receiver, None)
-                    if attempt >= (retries if first_contact else 1):
-                        raise
-                    time.sleep(backoff_s if first_contact else 0)
+            policy = (self._retry if receiver in self._conns
+                      else self._retry_first)
+            # The ACTIVE policy's per-attempt budget governs the connect:
+            # a custom first-contact attempt_timeout_s must be honored,
+            # not silently replaced by the established policy's.
+            timeout = (policy.attempt_timeout_s
+                       if policy.attempt_timeout_s is not None
+                       else self._retry.attempt_timeout_s)
+            policy.run(
+                lambda: self._send_once(receiver, head, blob, timeout),
+                retriable=lambda e: isinstance(e, OSError),
+                describe=f"trpc send rank {self.rank} -> {receiver}")
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -210,7 +231,9 @@ class TRPCCommManager(BaseCommunicationManager):
     def handle_receive_message(self) -> None:
         """Blocking dispatch loop over the servicer queue (the reference's
         message_handling_subroutine, trpc_comm_manager.py:~128)."""
-        self._running = True
+        # Honor a stop that ran BEFORE the loop started (stop-before-start
+        # race: a restored-at-terminal server finishes in send_init_msg).
+        self._running = not self._stop_requested
         while self._running:
             try:
                 msg = self._queue.get(timeout=0.2)
@@ -220,6 +243,7 @@ class TRPCCommManager(BaseCommunicationManager):
                 obs.receive_message(msg.get_type(), msg)
 
     def stop_receive_message(self) -> None:
+        self._stop_requested = True  # latched: stop-before-start must hold
         self._running = False
 
     def close(self) -> None:

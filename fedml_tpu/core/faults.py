@@ -159,27 +159,44 @@ class HeartbeatMonitor:
     """Failure detector for the host-side federation: ranks ``beat()``;
     ``failed()`` lists ranks silent for > timeout_s. The reference has no
     equivalent — a dead client hangs its server forever
-    (FedAVGAggregator.check_whether_all_receive waits unconditionally)."""
+    (FedAVGAggregator.check_whether_all_receive waits unconditionally).
+
+    Thread-safe: the distributed server manager beats from its receive
+    thread while its watchdog thread polls ``failed()`` /
+    ``wait_all_or_failed`` (algos/fedavg_distributed.py)."""
 
     def __init__(self, ranks: Sequence[int], timeout_s: float = 30.0,
                  clock=time.monotonic):
+        import threading
+
         self.timeout_s = timeout_s
         self._clock = clock
+        self._lock = threading.Lock()
         now = clock()
         self._last: Dict[int, float] = {r: now for r in ranks}
 
     def beat(self, rank: int):
         """Unknown ranks are registered on first beat."""
-        self._last[rank] = self._clock()
+        with self._lock:
+            self._last[rank] = self._clock()
+
+    def forget(self, rank: int):
+        """Drop a rank from monitoring (evicted from membership); a later
+        ``beat`` re-registers it."""
+        with self._lock:
+            self._last.pop(rank, None)
 
     def failed(self) -> List[int]:
         now = self._clock()
-        return sorted(
-            r for r, t in self._last.items() if now - t > self.timeout_s
-        )
+        with self._lock:
+            return sorted(
+                r for r, t in self._last.items() if now - t > self.timeout_s
+            )
 
     def alive(self) -> List[int]:
-        return sorted(set(self._last) - set(self.failed()))
+        with self._lock:
+            known = set(self._last)
+        return sorted(known - set(self.failed()))
 
     def wait_all_or_failed(self, expected: Sequence[int], have,
                            poll_s: float = 0.05,
@@ -192,8 +209,9 @@ class HeartbeatMonitor:
         total wait: anything still missing then is declared failed."""
         expected = set(expected)
         start = self._clock()
-        for r in expected - set(self._last):
-            self._last[r] = start  # start their timeout clocks now
+        with self._lock:
+            for r in expected - set(self._last):
+                self._last[r] = start  # start their timeout clocks now
         deadline = deadline_s if deadline_s is not None else 2 * self.timeout_s
         while True:
             failed = set(self.failed())

@@ -78,9 +78,26 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--run_dir", type=str, default=None,
                    help="directory for metrics.jsonl + checkpoints")
     p.add_argument("--checkpoint_frequency", type=int, default=0,
-                   help="save full run state every N rounds; 0 disables")
+                   help="save full run state every N rounds; 0 disables "
+                        "(also cfg.checkpoint_every for the distributed "
+                        "server's crash-resume checkpoints)")
     p.add_argument("--resume", action="store_true",
-                   help="resume from the latest checkpoint in --run_dir")
+                   help="resume from the latest checkpoint in --run_dir "
+                        "(with --checkpoint_frequency the distributed "
+                        "server auto-resumes on restart — that is the "
+                        "crash-resume contract: rerunning the same "
+                        "command continues the run; this flag arms "
+                        "restore when checkpointing itself is off, or a "
+                        "fresh run needs a clean --run_dir)")
+    # Distributed control plane (docs/ROBUSTNESS.md "Control plane";
+    # read only by the message-passing federations)
+    p.add_argument("--round_timeout_s", type=float, default=0.0,
+                   help="distributed server: abandon a round after this "
+                        "many seconds by evicting the silent ranks and "
+                        "aggregating over the survivors (0 = wait forever)")
+    p.add_argument("--heartbeat_interval_s", type=float, default=0.0,
+                   help="distributed workers: liveness beat cadence while "
+                        "training long rounds (0 = uploads only)")
     p.add_argument("--wandb_project", type=str, default=None)
     p.add_argument("--client_selection", type=str, default="random",
                    choices=["random", "pow_d", "oort"],
@@ -193,4 +210,7 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         oort_epsilon=args.oort_epsilon,
         oort_staleness_coef=args.oort_staleness_coef,
         compress=args.compress,
+        checkpoint_every=args.checkpoint_frequency,
+        round_timeout_s=args.round_timeout_s,
+        heartbeat_interval_s=args.heartbeat_interval_s,
     )

@@ -75,6 +75,15 @@ def main(argv=None):
                         help="straggler-tolerant first-k rounds: aggregate "
                              "as soon as k fresh uploads arrive (0 = wait "
                              "for all silos)")
+    # Control plane (docs/ROBUSTNESS.md): --round_timeout_s /
+    # --heartbeat_interval_s come from the shared flag set;
+    # --checkpoint_frequency + --run_dir arm the server's crash-resume
+    # checkpoints (kill rank 0, rerun the same command: it restores the
+    # latest checkpoint, bumps its epoch, and the federation continues).
+    parser.add_argument("--idle_timeout_s", type=float, default=0.0,
+                        help="silo self-termination bound: exit after this "
+                             "many seconds without server contact (0 = "
+                             "wait forever)")
     add_args(parser)
     args = parser.parse_args(argv)
     if not 0 <= args.rank < args.size:
@@ -117,17 +126,32 @@ def main(argv=None):
     net_args.host_table = build_host_table(args)
 
     if args.rank == 0:
+        import os
+
         sample_x = jnp.zeros((1,) + arrays.x.shape[3:], arrays.x.dtype)
         net0 = fns.init(jax.random.PRNGKey(cfg.seed), sample_x)
         eval_fn = jax.jit(make_eval_fn(fns.apply)) if test is not None else None
         aggregator = FedAVGAggregator(net0, worker_num, cfg, eval_fn, test)
+        checkpoint_dir = None
+        metrics = None
+        if args.run_dir:
+            from fedml_tpu.obs import MetricsLogger
+
+            metrics = MetricsLogger.for_run(run_dir=args.run_dir,
+                                            stdout=False)
+            if args.checkpoint_frequency or args.resume:
+                checkpoint_dir = os.path.join(args.run_dir, "ckpt")
         server = FedAVGServerManager(net_args, aggregator, cfg, args.size,
                                      backend=args.comm_backend,
                                      compress=args.compress,
-                                     aggregate_k=args.aggregate_k)
+                                     aggregate_k=args.aggregate_k,
+                                     checkpoint_dir=checkpoint_dir,
+                                     metrics=metrics)
         server.run()
+        if metrics is not None:
+            metrics.close()
         final = aggregator.test_history[-1] if aggregator.test_history else {}
-        print(json.dumps({"rank": 0, **final}))
+        print(json.dumps({"rank": 0, **final, **server.health()}))
     else:
         optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd,
                                           cfg.grad_clip)
@@ -136,7 +160,8 @@ def main(argv=None):
         client = FedAVGClientManager(net_args, args.rank, args.size, arrays,
                                      local_train, cfg,
                                      backend=args.comm_backend,
-                                     compress=args.compress)
+                                     compress=args.compress,
+                                     idle_timeout_s=args.idle_timeout_s)
         client.run()
         print(json.dumps({"rank": args.rank, "status": "done"}))
 

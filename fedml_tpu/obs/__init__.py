@@ -7,7 +7,15 @@ The reference's equivalents: wandb calls hard-wired into aggregators
 
 from fedml_tpu.obs.logger import JsonlSink, MetricsLogger, StdoutSink, WandbSink
 from fedml_tpu.obs.timing import RoundTimer, trace
-from fedml_tpu.obs.checkpoint import CheckpointManager, RunState, restore_run, save_run
+from fedml_tpu.obs.checkpoint import (
+    CheckpointManager,
+    RunState,
+    allocate_epoch,
+    restore_federation,
+    restore_run,
+    save_federation,
+    save_run,
+)
 from fedml_tpu.obs.flops import count_params, flops_str, model_cost
 from fedml_tpu.obs.sanitizer import (
     SanitizerError,
@@ -26,7 +34,10 @@ __all__ = [
     "trace",
     "CheckpointManager",
     "RunState",
+    "allocate_epoch",
+    "restore_federation",
     "restore_run",
+    "save_federation",
     "save_run",
     "count_params",
     "flops_str",

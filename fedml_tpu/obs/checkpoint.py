@@ -65,6 +65,14 @@ class CheckpointManager:
         if wait:
             self._mgr.wait_until_finished()
 
+    def wait(self):
+        """Block until any in-flight async save has committed."""
+        self._mgr.wait_until_finished()
+
+    def steps(self):
+        """Committed checkpoint steps (ascending)."""
+        return sorted(self._mgr.all_steps())
+
     def latest(self) -> Optional[int]:
         return self._mgr.latest_step()
 
@@ -80,6 +88,83 @@ class CheckpointManager:
 
     def close(self):
         self._mgr.close()
+
+
+def save_federation(mgr: CheckpointManager, net, round_idx: int, epoch: int,
+                    wait: bool = False):
+    """Checkpoint the message-passing federation's server state (the
+    distributed control plane — algos/fedavg_distributed.py): the global
+    net, the NEXT round to run, and the server epoch. ``wait=False`` by
+    default: the save runs async, off the round critical path. A step
+    that is already durable is skipped — a restarted server replaying
+    its restored round would otherwise collide with the crashed
+    instance's own save (orbax refuses to overwrite a committed step)."""
+    if round_idx in mgr.steps():
+        return
+    try:
+        mgr.save(round_idx, {
+            "round_idx": np.asarray(round_idx, np.int64),
+            "epoch": np.asarray(epoch, np.int64),
+            "net": net,
+        }, wait=wait)
+    except ValueError as err:
+        # steps() can be stale: the crashed instance's ASYNC save for
+        # this step may commit between the check and our save. Either
+        # way the step is durable — that is all this function promises.
+        if "already exists" not in str(err):
+            raise
+
+
+def allocate_epoch(mgr: CheckpointManager, restored_epoch: int = -1) -> int:
+    """Allocate a strictly monotonic server epoch for a (re)starting
+    federation server. The epoch cannot ride the orbax step cadence: a
+    restored instance cannot re-save its bumped epoch at the restored
+    round (the step is already durable), so two crashes inside one
+    checkpoint window would both restore the SAME stored epoch, bump it
+    to the SAME value, and the pre-crash-upload fence would pass the
+    previous incarnation's in-flight uploads. Instead a tiny ``EPOCH``
+    sidecar in the checkpoint directory records the last epoch ever
+    handed out; every server start takes
+    ``max(restored_epoch, sidecar) + 1`` and persists it synchronously
+    (write-then-rename) before any message is sent."""
+    import os
+
+    path = os.path.join(mgr._dir, "EPOCH")
+    prev = -1
+    try:
+        with open(path) as f:
+            prev = int(f.read().strip())
+    except (OSError, ValueError):
+        pass
+    epoch = max(int(restored_epoch), prev) + 1
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(epoch))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return epoch
+
+
+def restore_federation(mgr: CheckpointManager, like_net) -> Optional[Dict]:
+    """Restore the latest federation checkpoint; returns
+    ``{"round_idx", "epoch", "net"}`` or None when no checkpoint exists.
+    The stored value is the epoch the crashed instance ran under; a
+    restarted server must run under a fresh one via
+    :func:`allocate_epoch` (NOT a plain ``+ 1`` — see its docstring)."""
+    template = {
+        "round_idx": np.asarray(0, np.int64),
+        "epoch": np.asarray(0, np.int64),
+        "net": like_net,
+    }
+    restored = mgr.restore(like=template)
+    if restored is None:
+        return None
+    return {
+        "round_idx": int(restored["round_idx"]),
+        "epoch": int(restored["epoch"]),
+        "net": restored["net"],
+    }
 
 
 def save_run(mgr: CheckpointManager, api, round_idx: int):

@@ -83,7 +83,14 @@ class MetricsLogger:
                     "wandb unavailable; continuing without it")
         return cls(sinks)
 
-    def log(self, metrics: Dict, step: int):
+    def log(self, metrics: Dict, step: int, prefix: Optional[str] = None):
+        """``prefix`` namespaces the keys (``"ctrl"`` → ``ctrl/evictions``)
+        so structured subsystem streams — e.g. the distributed control
+        plane's per-round health counters (evictions, readmissions,
+        duplicate/epoch drops, send retries) — coexist with the training
+        curves in one history/sink without key collisions."""
+        if prefix:
+            metrics = {f"{prefix}/{k}": v for k, v in metrics.items()}
         entry = {"step": step, "ts": time.time(), **metrics}
         self.history.append(entry)
         for s in self.sinks:

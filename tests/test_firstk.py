@@ -52,6 +52,25 @@ def test_firstk_zero_is_full_participation():
     assert agg.test_history[-1]["accuracy"] > 0.5
 
 
+@pytest.mark.slow
+def test_firstk_federation_trains_over_tcp():
+    """First-k over the NATIVE TCP transport — the loopback test's twin
+    (same config/seed): straggler-tolerant rounds must behave identically
+    when the catch-up/reassignment messages cross a real wire (frame
+    serialization, connect retries, per-rank server threads)."""
+    fed, test = _setup()
+    cfg = FedConfig(
+        client_num_in_total=6, client_num_per_round=4, comm_round=8,
+        epochs=2, batch_size=16, lr=0.3, frequency_of_the_test=1,
+    )
+    agg = FedML_FedAvg_distributed(
+        LogisticRegression(num_classes=4), fed, test, cfg, backend="TCP",
+        aggregate_k=2
+    )
+    assert len(agg.test_history) == cfg.comm_round
+    assert agg.test_history[-1]["accuracy"] > 0.5
+
+
 def test_aggregate_k_validation():
     class A:
         pass

@@ -13,13 +13,17 @@ def test_entry_compiles():
     assert out.shape == (8, 10)
 
 
+import pytest
+
+
+@pytest.mark.slow
 def test_dryrun_multichip_8():
+    """Slow lane: the subprocess-bootstrapped 8-chip dryrun costs ~60 s
+    on a 2-CPU box and the fast lane keeps entry coverage via
+    ``test_entry_compiles``; the dryruns (8 and 32) ride the slow tier."""
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
-
-
-import pytest
 
 
 @pytest.mark.slow

@@ -5,6 +5,7 @@ import types
 
 import jax
 import numpy as np
+import pytest
 
 from fedml_tpu.data.loaders.common import batch_data
 from fedml_tpu.models import create_model
@@ -36,6 +37,8 @@ def test_classification_trainer_learns():
     assert after > max(before, 0.5)
 
 
+@pytest.mark.slow  # ~23 s of LSTM compile; fast-lane trainer coverage
+# stays via the classification-trainer tests above
 def test_nwp_trainer_runs_and_masks_pad():
     vocab, t = 23, 12
     rng = np.random.RandomState(1)

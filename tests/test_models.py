@@ -47,6 +47,7 @@ def test_resnet56_param_scale():
     assert 3e5 < n_params < 2e6
 
 
+@pytest.mark.slow  # ~15 s; the GAN also trains in test_fedgan_round_runs
 def test_mnist_gan_shapes():
     """Generator [B,100]→[B,28,28,1] tanh range; discriminator → [B,1] logits
     (reference model/cv/mnist_gan.py:6-65)."""
@@ -62,6 +63,7 @@ def test_mnist_gan_shapes():
     assert {"netg", "netd"} <= set(variables["params"].keys())
 
 
+@pytest.mark.slow  # ~21 s of BN-variant compile; GN twins stay fast
 def test_bn_variant_carries_batch_stats():
     model = create_model("resnet20", num_classes=10, norm="bn")
     fns = model_fns(model)
@@ -101,6 +103,7 @@ def test_resnet_bf16_mixed_precision_trains():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # ~17 s; ViT plumbing stays fast via test_vit_attn_fn
 def test_vit_shapes_and_trains():
     """ViT classifier: logits shape, no mutable state (federated-safe),
     and a few FedAvg rounds reduce the loss."""
@@ -170,6 +173,7 @@ def test_vit_attn_fn_is_plumbed():
     assert len(calls) == 3  # one per layer
 
 
+@pytest.mark.slow  # ~12 s; the default resnet56 stem stays fast
 def test_resnet56_s2d_stem_variant():
     """Space-to-depth stem: same input contract, ~equal FLOPs, doubled
     stage widths; bad stem names rejected."""

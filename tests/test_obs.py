@@ -155,10 +155,13 @@ def test_post_complete_message_fifo(tmp_path):
         {"model": "lr"}, pipe_path=str(tmp_path / "sub" / "nobody"))
 
 
+@pytest.mark.slow
 def test_xla_profiler_trace_produces_artifacts(tmp_path):
     """obs.timing.trace captures a real XLA profile on the CPU backend
     (the TPU tunnel cannot host the profiler — bench.py gates it behind
-    BENCH_PROFILE=1 — so this pins the subsystem works where it can)."""
+    BENCH_PROFILE=1 — so this pins the subsystem works where it can).
+    Slow lane: spinning up the profiler server costs ~20 s of the fast
+    lane's budget; ``test_run_with_obs_flags`` keeps obs wiring fast."""
     import jax
     import jax.numpy as jnp
 

@@ -1,0 +1,1082 @@
+"""Fault-tolerant federation control plane (docs/ROBUSTNESS.md "Control
+plane"): unified RetryPolicy, seeded-deterministic ChaosTransport,
+heartbeat-driven eviction/readmission in the distributed server,
+idempotent uploads, epoch-stamped crash-resume, bounded termination.
+
+Fast lane: policy/transport mechanics and the fake-clock server-manager
+protocol tests. The wall-clock drills (chaos federation with a killed
+worker, kill-the-server + restore) are ``slow``-marked.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algos import FedConfig
+from fedml_tpu.algos.fedavg_distributed import (
+    MSG_TYPE_C2S_HEARTBEAT,
+    MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+    MSG_TYPE_SRV_TICK,
+    FedAVGAggregator,
+    FedAVGClientManager,
+    FedAVGServerManager,
+    FedML_FedAvg_distributed,
+)
+from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackNetwork
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.resilience import (
+    ChaosSpec,
+    ChaosTransport,
+    HeartbeatSender,
+    RetryGiveUp,
+    RetryPolicy,
+)
+from fedml_tpu.data.batching import batch_global, build_federated_arrays
+from fedml_tpu.data.partition import partition_homo
+from fedml_tpu.data.synthetic import make_classification
+from fedml_tpu.models.lr import LogisticRegression
+
+
+# --------------------------------------------------------------------------
+# RetryPolicy
+
+
+def test_retry_policy_succeeds_after_transient_failures():
+    sleeps = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("not yet")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=5, backoff_s=0.1, multiplier=2.0,
+                    jitter=0.0, sleep=sleeps.append)
+    assert p.run(flaky) == "ok"
+    assert len(calls) == 3
+    assert sleeps == [0.1, 0.2]  # exponential
+    assert p.retries == 2 and p.giveups == 0
+
+
+def test_retry_policy_exhaustion_chains_last_error():
+    p = RetryPolicy(max_attempts=3, backoff_s=0.0, sleep=lambda s: None)
+    with pytest.raises(RetryGiveUp) as e:
+        p.run(lambda: (_ for _ in ()).throw(ConnectionError("dead")))
+    assert isinstance(e.value.__cause__, ConnectionError)
+    assert p.giveups == 1 and p.retries == 2
+
+
+def test_retry_policy_non_retriable_raises_immediately():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("logic bug")
+
+    p = RetryPolicy(max_attempts=5, backoff_s=0.0, sleep=lambda s: None)
+    with pytest.raises(ValueError):
+        p.run(bad, retriable=lambda e: isinstance(e, ConnectionError))
+    assert len(calls) == 1  # never retried
+
+
+def test_retry_policy_total_deadline_bounds_the_wait():
+    t = [0.0]
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        t[0] += s
+
+    p = RetryPolicy(max_attempts=100, backoff_s=1.0, multiplier=1.0,
+                    jitter=0.0, total_deadline_s=3.5, sleep=sleep,
+                    clock=lambda: t[0])
+    with pytest.raises(RetryGiveUp):
+        p.run(lambda: (_ for _ in ()).throw(ConnectionError()))
+    # 3 sleeps of 1 s fit under the 3.5 s deadline; the 4th would not.
+    assert len(sleeps) == 3
+
+
+def test_retry_policy_jitter_is_seeded_deterministic():
+    def backoffs(seed):
+        p = RetryPolicy(max_attempts=5, backoff_s=0.5, jitter=0.5, seed=seed)
+        return [p.backoff_for(a) for a in range(1, 5)]
+
+    assert backoffs(7) == backoffs(7)
+    assert backoffs(7) != backoffs(8)
+    for b, base in zip(backoffs(7), [0.5, 1.0, 2.0, 2.0]):
+        assert abs(b - base) <= 0.5 * base + 1e-9
+
+
+def test_backend_policies_share_the_retry_discipline():
+    """All three real backends expose the unified policy pair + counter —
+    the 'no remaining ad-hoc backoff loops' acceptance surface."""
+    from fedml_tpu.comm.tcp import TcpCommManager
+    from fedml_tpu.comm.trpc import TRPCCommManager
+
+    table = {0: ("127.0.0.1", 0), 1: ("127.0.0.1", 0)}
+    m = TcpCommManager(dict(table), 0)
+    try:
+        assert isinstance(m._retry_first, RetryPolicy)
+        assert isinstance(m._retry, RetryPolicy)
+        assert m.retry_count == 0
+    finally:
+        m.close()
+    m = TRPCCommManager({0: ("127.0.0.1", 0)}, 0)
+    try:
+        assert isinstance(m._retry_first, RetryPolicy)
+        assert m._retry.attempt_timeout_s == 30.0
+        assert m.retry_count == 0
+    finally:
+        m.close()
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from fedml_tpu.comm.grpc_backend import GrpcCommManager
+
+    m = GrpcCommManager({0: ("127.0.0.1", 0)}, 0)
+    try:
+        assert m._retry.attempt_timeout_s == 120.0  # the ex-hardcoded 120s
+        assert m.retry_count == 0
+    finally:
+        m.close()
+
+
+def test_tcp_send_failure_counts_retries_and_gives_up():
+    """A dead peer: the established policy's quick re-attempt runs through
+    RetryPolicy (counter visible), then the failure surfaces."""
+    from fedml_tpu.comm.tcp import TcpCommManager
+
+    table = {0: ("127.0.0.1", 0), 1: ("127.0.0.1", 1)}  # port 1: refuses
+    m = TcpCommManager(table, 0, retry_first=RetryPolicy(
+        max_attempts=2, backoff_s=0.0, jitter=0.0))
+    try:
+        msg = Message(type=1, sender_id=0, receiver_id=1)
+        with pytest.raises(ConnectionError):
+            m.send_message(msg)
+        assert m.retry_count == 1
+    finally:
+        m.close()
+
+
+# --------------------------------------------------------------------------
+# ChaosTransport
+
+
+def _drain(network, rank):
+    out = []
+    q = network.inbox(rank)
+    while True:
+        try:
+            out.append(q.get_nowait())
+        except queue.Empty:
+            return out
+
+
+def _chaos_pair(spec):
+    network = LoopbackNetwork(2)
+    sender = ChaosTransport(LoopbackCommManager(network, 0), spec, rank=0)
+    return network, sender
+
+
+def _msg(round_idx, receiver=1):
+    m = Message(type=3, sender_id=0, receiver_id=receiver)
+    m.add("round", round_idx)
+    return m
+
+
+def test_chaos_drop_is_seeded_deterministic():
+    def delivered(seed):
+        network, sender = _chaos_pair(ChaosSpec(seed=seed, drop_p=0.5))
+        for r in range(40):
+            sender.send_message(_msg(r))
+        return [m.get("round") for m in _drain(network, 1)]
+
+    a, b = delivered(3), delivered(3)
+    assert a == b
+    assert 0 < len(a) < 40  # some dropped, some delivered
+    assert delivered(4) != a  # seed matters
+
+
+def test_chaos_duplicate_and_counters():
+    spec = ChaosSpec(seed=0, dup_p=1.0)
+    network, sender = _chaos_pair(spec)
+    for r in range(5):
+        sender.send_message(_msg(r))
+    got = [m.get("round") for m in _drain(network, 1)]
+    assert got == [0, 0, 1, 1, 2, 2, 3, 3, 4, 4]
+    assert spec.counts["duplicated"] == 5 and spec.counts["sent"] == 5
+
+
+def test_chaos_one_way_partition_and_heal():
+    spec = ChaosSpec(seed=0)
+    network = LoopbackNetwork(2)
+    a = ChaosTransport(LoopbackCommManager(network, 0), spec, rank=0)
+    b = ChaosTransport(LoopbackCommManager(network, 1), spec, rank=1)
+    spec.partition(0, 1)
+    a.send_message(_msg(0, receiver=1))
+    back = Message(type=3, sender_id=1, receiver_id=0)
+    b.send_message(back)  # reverse direction flows
+    assert _drain(network, 1) == []
+    assert len(_drain(network, 0)) == 1
+    assert spec.counts["partitioned"] == 1
+    spec.heal(0, 1)
+    a.send_message(_msg(1, receiver=1))
+    assert [m.get("round") for m in _drain(network, 1)] == [1]
+
+
+def test_chaos_delay_delivers_late_but_delivers():
+    spec = ChaosSpec(seed=0, delay_p=1.0, max_delay_s=0.05)
+    network, sender = _chaos_pair(spec)
+    sender.send_message(_msg(0))
+    deadline = time.monotonic() + 2.0
+    got = []
+    while not got and time.monotonic() < deadline:
+        got = _drain(network, 1)
+        time.sleep(0.005)
+    assert [m.get("round") for m in got] == [0]
+    assert spec.counts["delayed"] == 1
+
+
+def test_chaos_reorder_swaps_with_next_send():
+    spec = ChaosSpec(seed=0, reorder_p=1.0, max_delay_s=5.0)
+    network, sender = _chaos_pair(spec)
+    sender.send_message(_msg(0))  # held
+    spec.reorder_p = 0.0
+    sender.send_message(_msg(1))  # ships first, then releases the held one
+    got = [m.get("round") for m in _drain(network, 1)]
+    assert got == [1, 0]
+    assert spec.counts["reordered"] == 1
+
+
+def test_chaos_dup_plus_reorder_ships_both_copies():
+    """A message drawing BOTH duplicate and reorder used to count
+    'duplicated' while shipping exactly one copy — the counter overstated
+    what the wire saw and the dup fault was silently unexercised on
+    reordered messages."""
+    spec = ChaosSpec(seed=0, dup_p=1.0, reorder_p=1.0, max_delay_s=5.0)
+    network, sender = _chaos_pair(spec)
+    sender.send_message(_msg(0))  # held, with its duplicate riding along
+    spec.dup_p = 0.0
+    spec.reorder_p = 0.0
+    sender.send_message(_msg(1))  # ships, then releases the held pair
+    got = [m.get("round") for m in _drain(network, 1)]
+    assert got == [1, 0, 0]
+    assert spec.counts["duplicated"] == 1
+
+
+def test_chaos_self_sends_bypass_injection():
+    """The server watchdog's self-addressed ticks never cross the network
+    and must never be dropped — eviction depends on them."""
+    spec = ChaosSpec(seed=0, drop_p=1.0)
+    network = LoopbackNetwork(2)
+    sender = ChaosTransport(LoopbackCommManager(network, 0), spec, rank=0)
+    m = Message(type=9, sender_id=0, receiver_id=0)
+    sender.send_message(m)
+    assert len(_drain(network, 0)) == 1
+    sender.send_message(_msg(0, receiver=1))  # cross-rank: dropped
+    assert _drain(network, 1) == []
+
+
+def test_heartbeat_sender_beats_and_idle_quits():
+    beats = []
+    idle = []
+    hb = HeartbeatSender(lambda: beats.append(1), interval_s=0.02,
+                         idle_timeout_s=0.15, on_idle=lambda: idle.append(1))
+    hb.start()
+    time.sleep(0.08)
+    hb.touch()
+    assert len(beats) >= 1
+    deadline = time.monotonic() + 2.0
+    while not idle and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert idle == [1]  # fired once after contact went silent
+    time.sleep(0.05)
+    assert idle == [1]  # and only once; the thread stopped
+
+
+# --------------------------------------------------------------------------
+# Server-manager protocol (fake clock, handlers invoked directly — the
+# receive loop dispatches serially, so direct invocation is faithful)
+
+
+def _server(aggregate_k=0, comm_round=3, workers=3, clock=None,
+            checkpoint_dir=None, metrics=None, cfg_kw=None):
+    class A:
+        pass
+
+    args = A()
+    args.network = LoopbackNetwork(workers + 1)
+    cfg = FedConfig(client_num_in_total=workers, client_num_per_round=workers,
+                    comm_round=comm_round, frequency_of_the_test=1,
+                    **(cfg_kw or {}))
+    net0 = {"w": np.zeros(2, np.float32)}
+    agg = FedAVGAggregator(net0, workers, cfg)
+    srv = FedAVGServerManager(
+        args, agg, cfg, workers + 1, aggregate_k=aggregate_k,
+        round_timeout_s=10.0, clock=clock or time.monotonic,
+        checkpoint_dir=checkpoint_dir, metrics=metrics)
+    return srv, agg, args.network
+
+
+def _upload(srv, worker, round_idx, value, epoch=0, n=10):
+    m = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, worker, 0)
+    m.add(Message.MSG_ARG_KEY_MODEL_PARAMS, {"w": np.full(2, value, np.float32)})
+    m.add(Message.MSG_ARG_KEY_NUM_SAMPLES, n)
+    m.add("round", round_idx)
+    m.add("epoch", epoch)
+    srv.handle_message_receive_model_from_client(m)
+
+
+def _tick(srv, round_idx, failed, epoch=0):
+    m = Message(MSG_TYPE_SRV_TICK, 0, 0)
+    m.add("round", round_idx)
+    m.add("failed", failed)
+    m.add("epoch", epoch)
+    srv._handle_tick(m)
+
+
+def test_aggregate_from_empty_keeps_previous_net():
+    """Regression: an all-evicted round used to set self.net = None,
+    poisoning every later round."""
+    net0 = {"w": np.ones(3, np.float32)}
+    agg = FedAVGAggregator(net0, 3, FedConfig())
+    out = agg.aggregate_from([])
+    np.testing.assert_array_equal(out["w"], net0["w"])
+    assert agg.net is net0
+
+
+def test_eviction_aggregates_over_survivors():
+    from fedml_tpu.obs import MetricsLogger
+
+    logger = MetricsLogger()
+    srv, agg, network = _server(metrics=logger)
+    _upload(srv, 1, 0, 1.0)
+    _upload(srv, 2, 0, 3.0)
+    assert srv.round_idx == 0  # waiting on rank 3 (aggregate_k=all)
+    _tick(srv, 0, [3])
+    assert srv.round_idx == 1  # deadline: round completed over survivors
+    np.testing.assert_allclose(agg.net["w"], np.full(2, 2.0))  # mean(1, 3)
+    h = srv.health()
+    assert h["evictions"] == 1 and h["members"] == 2
+    # Survivors got round-1 assignments; the evicted rank got nothing.
+    for w in (1, 2):
+        msgs = [m for m in network.inbox(w).queue
+                if m.get_type() == MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT]
+        assert msgs and msgs[-1].get("round") == 1
+    assert not [m for m in network.inbox(3).queue
+                if m.get_type() == MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT]
+    # Structured health metrics flowed through the logger, namespaced.
+    assert logger.history and "ctrl/evictions" in logger.history[-1]
+    assert logger.history[-1]["ctrl/arrived"] == 2
+
+
+def test_stale_tick_is_ignored():
+    srv, agg, _ = _server()
+    _upload(srv, 1, 0, 1.0)
+    _upload(srv, 2, 0, 1.0)
+    _upload(srv, 3, 0, 1.0)
+    assert srv.round_idx == 1
+    _tick(srv, 0, [2])  # queued before the round advanced: stale
+    assert srv.health()["evictions"] == 0 and srv.health()["members"] == 3
+
+
+def test_readmission_via_stale_catchup():
+    srv, agg, network = _server()
+    _upload(srv, 1, 0, 1.0)
+    _upload(srv, 2, 0, 1.0)
+    _tick(srv, 0, [3])
+    assert srv.health()["members"] == 2
+    # Rank 3 returns with its abandoned round-0 result: model discarded,
+    # rank re-admitted and caught up on the current round.
+    _upload(srv, 3, 0, 9.0)
+    h = srv.health()
+    assert h["members"] == 3 and h["readmissions"] == 1
+    assert srv.straggler_drops == 1
+    catchup = [m for m in network.inbox(3).queue
+               if m.get_type() == MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT]
+    assert catchup and catchup[-1].get("round") == 1
+    np.testing.assert_allclose(agg.net["w"], np.full(2, 1.0))  # 9.0 unused
+
+
+def test_readmission_via_heartbeat_reassigns_current_round():
+    srv, _, network = _server()
+    _upload(srv, 1, 0, 1.0)
+    _upload(srv, 2, 0, 1.0)
+    _tick(srv, 0, [3])
+    beat = Message(MSG_TYPE_C2S_HEARTBEAT, 3, 0)
+    srv._handle_heartbeat(beat)
+    h = srv.health()
+    assert h["members"] == 3 and h["readmissions"] == 1
+    assigned = [m for m in network.inbox(3).queue
+                if m.get_type() == MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT]
+    assert assigned and assigned[-1].get("round") == 1
+
+
+def test_duplicate_upload_is_idempotent():
+    srv, agg, _ = _server()
+    _upload(srv, 1, 0, 1.0)
+    _upload(srv, 1, 0, 1.0)  # transport duplicate: dropped, no reply
+    assert srv.duplicate_drops == 1
+    assert len(srv._arrived) == 1
+    _upload(srv, 2, 0, 3.0)
+    _upload(srv, 3, 0, 5.0)
+    assert srv.round_idx == 1
+    np.testing.assert_allclose(agg.net["w"], np.full(2, 3.0))
+
+
+def test_pre_crash_epoch_upload_rejected():
+    srv, agg, _ = _server()
+    srv.epoch = 2  # as after two restarts
+    _upload(srv, 1, 0, 7.0, epoch=1)
+    assert srv.epoch_drops == 1
+    assert len(srv._arrived) == 0
+    _upload(srv, 1, 0, 1.0, epoch=2)
+    assert len(srv._arrived) == 1
+
+
+def test_firstk_threshold_shrinks_with_membership():
+    srv, agg, _ = _server(aggregate_k=3, workers=4)
+    _tick(srv, 0, [3, 4])  # two ranks dead before anything arrived
+    assert srv.health()["members"] == 2
+    _upload(srv, 1, 0, 1.0)
+    assert srv.round_idx == 0  # k_eff = min(3, 2) = 2: still waiting
+    _upload(srv, 2, 0, 3.0)
+    assert srv.round_idx == 1  # completes with the shrunken cohort
+    np.testing.assert_allclose(agg.net["w"], np.full(2, 2.0))
+
+
+def test_all_evicted_aborts_instead_of_hanging():
+    t = [0.0]
+    srv, _, _ = _server(clock=lambda: t[0])
+    t[0] = 100.0  # silent far past the heartbeat timeout: truly dead
+    _tick(srv, 0, [1, 2, 3])
+    assert srv.aborted and srv._stopped
+
+
+def test_all_evicted_but_beating_holds_the_round_open():
+    """An eviction storm over alive-but-slow ranks (the whole fleet still
+    jit-compiling round 0) must NOT abort: fresh beats re-admit them and
+    their uploads complete the round."""
+    t = [0.0]
+    srv, agg, _ = _server(clock=lambda: t[0])
+    _tick(srv, 0, [1, 2, 3])  # deadline missed, but every beat is fresh
+    assert not srv.aborted and srv.health()["members"] == 0
+    for w in (1, 2, 3):
+        srv._handle_heartbeat(Message(MSG_TYPE_C2S_HEARTBEAT, w, 0))
+    assert srv.health()["members"] == 3
+    assert srv.health()["readmissions"] == 3
+    for w in (1, 2, 3):
+        _upload(srv, w, 0, 1.0)
+    assert srv.round_idx == 1  # the held-open round completed
+
+
+def test_terminal_phase_bounded_done_handshake():
+    srv, _, network = _server(comm_round=1)
+    _upload(srv, 1, 0, 1.0)
+    _upload(srv, 2, 0, 1.0)
+    _upload(srv, 3, 0, 1.0)
+    assert srv.round_idx == 1  # terminal
+    # All three uploaded in the same dispatch, so all got done already.
+    assert srv._stopped
+
+
+def test_terminal_dead_rank_evicted_by_tick():
+    srv, _, _ = _server(comm_round=1, aggregate_k=2)
+    _upload(srv, 1, 0, 1.0)
+    _upload(srv, 2, 0, 1.0)
+    assert srv.round_idx == 1 and not srv._stopped  # rank 3 owes a visit
+    _tick(srv, 1, [3])  # permanently dead: done-deadline evicts it
+    assert srv._stopped
+    assert srv.health()["evictions"] == 1
+
+
+@pytest.mark.parametrize("backend", ["loopback", "tcp"])
+def test_stop_before_receive_loop_is_latched(backend):
+    """Regression: ``handle_receive_message`` used to re-arm
+    ``_running = True`` on entry, clobbering a ``stop_receive_message``
+    that ran BEFORE the loop started — the dispatch loop then spun
+    forever on the stopped transport. That is exactly the shape of a
+    server restored at the terminal round: every ``_send_done`` to the
+    long-gone fleet fails, the last eviction calls ``finish()`` inside
+    ``send_init_msg``, and only afterwards does ``run()`` enter the
+    receive loop."""
+    if backend == "loopback":
+        m = LoopbackCommManager(LoopbackNetwork(1), 0)
+    else:
+        from fedml_tpu.comm.tcp import TcpCommManager
+
+        m = TcpCommManager({0: ("127.0.0.1", 0)}, 0)
+    # Mirror ServerManager.finish(): stop, then close — before the loop.
+    m.stop_receive_message()
+    close = getattr(m, "close", None)
+    if close is not None:
+        close()
+    t = threading.Thread(target=m.handle_receive_message, daemon=True)
+    t.start()
+    t.join(timeout=5.0)
+    assert not t.is_alive(), f"{backend} receive loop ignored a prior stop"
+
+
+def test_restored_at_terminal_with_dead_fleet_exits_bounded():
+    """A server restored at (or past) the terminal round whose whole
+    fleet is gone: each done-send fails, every rank is evicted, and
+    ``run()`` must RETURN — not hang in the receive loop it enters after
+    ``send_init_msg`` already finished the run."""
+    srv, _, _ = _server(comm_round=1)
+    srv.round_idx = 1  # what restore_federation hands a finished run
+
+    def dead_send(msg):
+        if int(msg.get_receiver_id()) != 0:
+            raise ConnectionError("fleet is gone")
+
+    srv.send_message = dead_send
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+    t.join(timeout=10.0)
+    assert not t.is_alive(), "restored-at-terminal server hung in run()"
+    assert srv._stopped
+    assert srv.health()["members"] == 0
+    assert srv.health()["evictions"] == 3
+
+
+def test_terminal_heartbeat_resends_lost_done():
+    srv, _, network = _server(comm_round=1)
+    for w in (1, 2, 3):
+        _upload(srv, w, 0, 1.0)
+    n_done = len([m for m in network.inbox(1).queue if m.get("done")])
+    srv._handle_heartbeat(Message(MSG_TYPE_C2S_HEARTBEAT, 1, 0))
+    assert len([m for m in network.inbox(1).queue
+                if m.get("done")]) == n_done + 1
+
+
+def test_terminal_beat_from_evicted_rank_gets_done():
+    """An alive rank evicted AT the terminal round (slow past the done
+    deadline, then resumed beating) used to get nothing back — with
+    idle_timeout_s=0 it would block on its receive loop forever."""
+    srv, _, network = _server(comm_round=1, aggregate_k=2)
+    _upload(srv, 1, 0, 1.0)
+    _upload(srv, 2, 0, 1.0)
+    _tick(srv, 1, [3])  # done-deadline eviction of the silent rank 3
+    assert srv.health()["evictions"] == 1
+    srv._handle_heartbeat(Message(MSG_TYPE_C2S_HEARTBEAT, 3, 0))
+    assert any(m.get("done") for m in network.inbox(3).queue)
+
+
+def test_client_resends_lost_upload_on_same_round_reassignment():
+    """Livelock regression: a resend-flagged re-assignment of the round
+    the client already trained means its upload was lost (the server
+    flags re-admission assignments). Dropping it as a duplicate left a
+    round whose every upload was lost unable to ever complete; the
+    client now resends the cached upload instead. An UNFLAGGED copy of
+    the same assignment is a plain transport duplicate and must NOT cost
+    a model-sized resend."""
+    class A:
+        pass
+
+    args = A()
+    args.network = LoopbackNetwork(2)
+    cfg = FedConfig(client_num_in_total=1, client_num_per_round=1)
+    cm = FedAVGClientManager(args, 1, 2, train_fed=None, local_train=None,
+                             cfg=cfg)
+    cm._train = lambda net, idx: None
+
+    def assign(r, epoch=0, resend=False):
+        m = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, 1)
+        m.add("round", r)
+        m.add("epoch", epoch)
+        if resend:
+            m.add("resend", True)
+        cm._handle_assignment(m)
+
+    assign(2)
+    upload = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, 1, 0)
+    upload.add("round", 2)
+    cm._last_upload = upload  # what _train would have cached
+    n0 = len(args.network.inbox(0).queue)
+    assign(2)  # ChaosTransport duplicate of the assignment: dropped
+    assert cm.upload_resends == 0 and cm.duplicate_drops == 1
+    assert len(args.network.inbox(0).queue) == n0
+    assign(2, resend=True)  # re-admission re-assignment of the trained round
+    assert cm.upload_resends == 1 and cm.duplicate_drops == 1
+    assert len(args.network.inbox(0).queue) == n0 + 1
+    assign(1, resend=True)  # resend of an OLDER assignment: still dropped
+    assert cm.upload_resends == 1 and cm.duplicate_drops == 2
+    assert len(args.network.inbox(0).queue) == n0 + 1
+
+
+def test_async_duplicate_upload_is_idempotent():
+    """The async server mixes each update once: a duplicated upload
+    (ChaosTransport dup, sender retry after a lost ACK) used to be mixed
+    twice, advance the version twice, and hand the worker a second live
+    assignment."""
+    from fedml_tpu.algos.fedasync import (MSG_ARG_KEY_MODEL_VERSION,
+                                          FedAsyncServerManager)
+
+    class A:
+        pass
+
+    args = A()
+    args.network = LoopbackNetwork(2)
+    cfg = FedConfig(client_num_in_total=1, client_num_per_round=1,
+                    comm_round=5)
+    srv = FedAsyncServerManager(args, {"w": np.zeros(2, np.float32)}, cfg, 2)
+    up = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, 1, 0)
+    up.add(Message.MSG_ARG_KEY_MODEL_PARAMS, {"w": np.ones(2, np.float32)})
+    up.add(MSG_ARG_KEY_MODEL_VERSION, 0)
+    srv.handle_upload(up)
+    assert srv.version == 1
+    n_replies = len(args.network.inbox(1).queue)
+    srv.handle_upload(up)  # duplicate delivery
+    assert srv.version == 1
+    assert srv.duplicate_drops == 1
+    assert len(args.network.inbox(1).queue) == n_replies
+
+
+def test_epoch_monotonic_across_restores_within_checkpoint_window(tmp_path):
+    """Two crashes inside one checkpoint window must not reuse an epoch:
+    the bumped epoch cannot be re-saved at the restored round (that orbax
+    step is already durable), so a restart that crashed again before the
+    next periodic save used to restore the SAME stored epoch and bump it
+    to the SAME value — letting the previous incarnation's in-flight
+    uploads through the epoch fence. The EPOCH sidecar makes every
+    server start strictly monotonic."""
+    d = str(tmp_path / "ckpt")
+    srv1, _, _ = _server(checkpoint_dir=d)
+    assert srv1.epoch == 0  # fresh start
+    srv1._save_checkpoint(wait=True)  # (round 0, epoch 0) durable
+    srv1._ckpt.close()
+    srv1._ckpt = None
+    srv2, _, _ = _server(checkpoint_dir=d)
+    assert srv2.epoch == 1
+    srv2._ckpt.close()
+    srv2._ckpt = None
+    # Crash again BEFORE any new checkpoint step commits: the third
+    # incarnation restores the same (round 0, epoch 0) checkpoint but
+    # must still advance past instance 2's epoch.
+    srv3, _, _ = _server(checkpoint_dir=d)
+    assert srv3.epoch == 2
+    srv3._ckpt.close()
+    srv3._ckpt = None
+
+
+def _async_harness(workers=2, comm_round=5):
+    from fedml_tpu.algos.fedasync import FedAsyncServerManager
+
+    class A:
+        pass
+
+    args = A()
+    args.network = LoopbackNetwork(workers + 1)
+    cfg = FedConfig(client_num_in_total=workers,
+                    client_num_per_round=workers, comm_round=comm_round)
+    srv = FedAsyncServerManager(args, {"w": np.zeros(2, np.float32)}, cfg,
+                                workers + 1)
+    return srv, args.network
+
+
+def test_async_init_dead_worker_evicted_not_crashing():
+    """A silo dead at startup used to raise out of the async
+    send_init_msg and kill the whole server; it is now evicted like the
+    sync control plane's, and repeated send failures to the same dead
+    rank must not inflate the eviction counter."""
+    srv, network = _async_harness()
+    real = srv.send_message
+
+    def flaky(msg):
+        if int(msg.get_receiver_id()) == 2:
+            raise ConnectionError("dead at startup")
+        real(msg)
+
+    srv.send_message = flaky
+    srv.send_init_msg()  # must not raise
+    with srv._lock:
+        assert srv._members == {1}
+    assert srv.evictions == 1
+    assert len(network.inbox(1).queue) == 1  # the survivor got its init
+    srv._send_assignment(2)  # a later send to the evicted rank fails too
+    assert srv.evictions == 1  # guarded: not double-counted
+
+
+def test_async_client_recovery_resends_instead_of_retraining():
+    """A worker whose local round legitimately outlasts done_timeout_s
+    used to train every recovery assignment the server's beats-based
+    stall detector issued — an unbounded backlog of live assignments.
+    A recovery assignment whose ``expected`` predates our latest upload
+    now resends the cached upload instead; only a recovery confirming
+    the server ACCEPTED that upload (our reply was lost) trains fresh
+    work. Plain duplicated assignments are dropped without retraining."""
+    from fedml_tpu.algos.fedasync import (MSG_ARG_KEY_MODEL_VERSION,
+                                          FedAsyncClientManager)
+
+    class A:
+        pass
+
+    args = A()
+    args.network = LoopbackNetwork(2)
+    cfg = FedConfig(client_num_in_total=2, client_num_per_round=1)
+
+    class F:
+        pass
+
+    fed = F()
+    fed.x = fed.y = fed.mask = np.zeros((2, 1, 1), np.float32)
+    fed.counts = np.array([4, 4])
+    cm = FedAsyncClientManager(
+        args, 1, 2, fed,
+        lambda *a: ({"w": np.zeros(2, np.float32)}, 0.0), cfg)
+
+    def assign(version, recovery=False, expected=-1):
+        m = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, 1)
+        m.add(Message.MSG_ARG_KEY_CLIENT_INDEX, 0)
+        m.add(Message.MSG_ARG_KEY_MODEL_PARAMS,
+              {"w": np.zeros(2, np.float32)})
+        m.add(MSG_ARG_KEY_MODEL_VERSION, version)
+        if recovery:
+            m.add("recovery", True)
+            m.add("expected", expected)
+        cm.handle_model(m)
+
+    assign(0)  # trains, uploads, caches
+    assert cm.steps == 1
+    n0 = len(args.network.inbox(0).queue)
+    assign(0)  # ChaosTransport duplicate: dropped, no retrain, no upload
+    assert cm.duplicate_drops == 1 and cm.steps == 1
+    assert len(args.network.inbox(0).queue) == n0
+    # Recovery issued while our upload was still in flight (server's
+    # accepted high-water mark predates it): resend, don't retrain.
+    assign(3, recovery=True, expected=-1)
+    assert cm.upload_resends == 1 and cm.steps == 1
+    assert len(args.network.inbox(0).queue) == n0 + 1
+    # Recovery confirming the upload WAS accepted (our reply was lost):
+    # this is fresh work — train it.
+    assign(3, recovery=True, expected=0)
+    assert cm.steps == 2
+    assert len(args.network.inbox(0).queue) == n0 + 2
+
+
+def test_trpc_connect_honors_first_contact_attempt_timeout(monkeypatch):
+    """The first-contact policy's per-attempt budget governs the connect;
+    it used to be silently replaced by the established policy's 30 s."""
+    import fedml_tpu.comm.trpc as trpc_mod
+    from fedml_tpu.comm.trpc import TRPCCommManager
+
+    seen = []
+
+    def refuse(addr, timeout=None):
+        seen.append(timeout)
+        raise OSError("refused")
+
+    m = TRPCCommManager({0: ("127.0.0.1", 0), 1: ("127.0.0.1", 1)}, 0,
+                        retry_first=RetryPolicy(max_attempts=1,
+                                                attempt_timeout_s=2.5))
+    try:
+        monkeypatch.setattr(trpc_mod.socket, "create_connection", refuse)
+        with pytest.raises(ConnectionError):
+            m.send_message(Message(type=1, sender_id=0, receiver_id=1))
+    finally:
+        monkeypatch.undo()
+        m.close()
+    assert seen == [2.5]
+
+
+def test_client_manager_dedupes_and_adopts_epoch():
+    class A:
+        pass
+
+    args = A()
+    args.network = LoopbackNetwork(2)
+    cfg = FedConfig(client_num_in_total=1, client_num_per_round=1)
+    trained = []
+
+    cm = FedAVGClientManager(args, 1, 2, train_fed=None, local_train=None,
+                             cfg=cfg)
+    cm._train = lambda net, idx: trained.append((cm.round_idx, cm.epoch))
+
+    def assign(r, epoch, done=False):
+        m = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, 1)
+        m.add("round", r)
+        m.add("epoch", epoch)
+        m.add("done", done)
+        cm._handle_assignment(m)
+
+    assign(0, 0)
+    assign(0, 0)  # duplicate: dropped
+    assign(1, 0)
+    assert trained == [(0, 0), (1, 0)] and cm.duplicate_drops == 1
+    # Server restarted from its round-0 checkpoint: new epoch REPLAYS
+    # round 0 — the dedupe resets, the stale-epoch copy is ignored.
+    assign(0, 1)
+    assign(1, 0)  # pre-crash straggler assignment: dead epoch
+    assert trained == [(0, 0), (1, 0), (0, 1)]
+
+
+# --------------------------------------------------------------------------
+# Live drills
+
+
+def _task(n_clients=6, seed=1):
+    x, y = make_classification(240, n_features=8, n_classes=4, seed=seed)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), n_clients),
+                                 batch_size=16)
+    test = batch_global(x[:64], y[:64], 16)
+    return fed, test
+
+
+@pytest.mark.slow
+def test_dead_rank_cannot_hang_the_federation():
+    """One permanently dead worker (never even starts), aggregate_k=0 —
+    the exact config that used to block forever. The watchdog evicts it
+    at the round-0 deadline and the survivors finish every round.
+    (Wall-clock drill — slow lane; the fake-clock protocol tests above
+    cover the same eviction/termination logic in the fast lane.)"""
+    from fedml_tpu.algos.fedavg_distributed import (FedAVGAggregator,
+                                                    build_federation_setup)
+    from fedml_tpu.comm.loopback import run_workers
+
+    fed, test = _task()
+    cfg = FedConfig(client_num_in_total=6, client_num_per_round=3,
+                    comm_round=3, epochs=1, batch_size=16, lr=0.3,
+                    frequency_of_the_test=1, round_timeout_s=4.0,
+                    heartbeat_interval_s=0.2)
+    from fedml_tpu.trainer.local import softmax_ce
+
+    size, net0, local_train, eval_fn, args = build_federation_setup(
+        LogisticRegression(num_classes=4), fed, test, cfg, "LOOPBACK",
+        softmax_ce)
+    agg = FedAVGAggregator(net0, size - 1, cfg, eval_fn, test)
+    server = FedAVGServerManager(args, agg, cfg, size)
+    clients = [
+        FedAVGClientManager(args, rank, size, fed, local_train, cfg,
+                            idle_timeout_s=8.0)
+        for rank in range(1, size - 1)  # rank 3 never runs: dead
+    ]
+    t0 = time.monotonic()
+    run_workers([server.run] + [c.run for c in clients])
+    assert time.monotonic() - t0 < 30.0
+    assert server.round_idx == cfg.comm_round  # every round completed
+    assert server.health()["evictions"] >= 1
+    assert 3 not in server._members
+    assert len(agg.test_history) == cfg.comm_round
+
+
+@pytest.mark.slow
+def test_fedasync_dead_worker_cannot_hang_termination():
+    """The async server never blocks mid-run on one worker, but its
+    terminal handshake did (done_workers == size-1 unreachable with a
+    dead rank). The terminal watchdog bounds it. (Wall-clock drill —
+    slow lane.)"""
+    from fedml_tpu.algos.fedasync import (FedAsyncClientManager,
+                                          FedAsyncServerManager)
+    from fedml_tpu.algos.fedavg_distributed import build_federation_setup
+    from fedml_tpu.comm.loopback import run_workers
+    from fedml_tpu.trainer.local import softmax_ce
+
+    fed, test = _task()
+    cfg = FedConfig(client_num_in_total=6, client_num_per_round=3,
+                    comm_round=6, epochs=1, batch_size=16, lr=0.3,
+                    frequency_of_the_test=2, heartbeat_interval_s=0.2)
+    size, net0, local_train, eval_fn, args = build_federation_setup(
+        LogisticRegression(num_classes=4), fed, test, cfg, "LOOPBACK",
+        softmax_ce)
+    server = FedAsyncServerManager(args, net0, cfg, size, eval_fn=eval_fn,
+                                   test_data=test, done_timeout_s=2.0)
+    clients = [
+        FedAsyncClientManager(args, rank, size, fed, local_train, cfg,
+                              idle_timeout_s=10.0)
+        for rank in range(1, size - 1)  # last rank never runs: dead
+    ]
+    t0 = time.monotonic()
+    run_workers([server.run] + [c.run for c in clients])
+    assert time.monotonic() - t0 < 30.0
+    assert server.version == cfg.comm_round  # full run despite the death
+    assert server.evictions >= 1
+
+
+@pytest.mark.slow
+def test_chaos_drill_loopback_with_killed_worker():
+    """Acceptance drill: seeded drop+delay+duplicate chaos AND one worker
+    killed mid-run — the loopback federation terminates within its
+    deadline and still reaches the clean run's accuracy ballpark."""
+
+    class DyingClient(FedAVGClientManager):
+        """Crash-stop after 2 trained rounds: goes silent (no upload, no
+        beats), exactly like a killed process."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self._trained = 0
+
+        def _train(self, net, idx):
+            self._trained += 1
+            if self._trained > 2:
+                self.finish()
+                return
+            super()._train(net, idx)
+
+    from fedml_tpu.algos.fedavg_distributed import build_federation_setup
+    from fedml_tpu.comm.loopback import run_workers
+    from fedml_tpu.trainer.local import softmax_ce
+
+    fed, test = _task()
+    cfg = FedConfig(client_num_in_total=6, client_num_per_round=3,
+                    comm_round=8, epochs=2, batch_size=16, lr=0.3,
+                    frequency_of_the_test=1, round_timeout_s=2.0,
+                    heartbeat_interval_s=0.2)
+    chaos = ChaosSpec(seed=11, drop_p=0.05, dup_p=0.05, delay_p=0.2,
+                      max_delay_s=0.02)
+    size, net0, local_train, eval_fn, args = build_federation_setup(
+        LogisticRegression(num_classes=4), fed, test, cfg, "LOOPBACK",
+        softmax_ce, chaos=chaos)
+    agg = FedAVGAggregator(net0, size - 1, cfg, eval_fn, test)
+    server = FedAVGServerManager(args, agg, cfg, size)
+    clients = [DyingClient(args, 1, size, fed, local_train, cfg,
+                           idle_timeout_s=10.0)]
+    clients += [
+        FedAVGClientManager(args, rank, size, fed, local_train, cfg,
+                            idle_timeout_s=10.0)
+        for rank in range(2, size)
+    ]
+    t0 = time.monotonic()
+    run_workers([server.run] + [c.run for c in clients])
+    assert time.monotonic() - t0 < 60.0  # terminates, no hang
+    assert server.round_idx == cfg.comm_round
+    assert agg.test_history[-1]["accuracy"] > 0.5  # clean-run ballpark
+    assert server.health()["evictions"] >= 1  # the killed worker
+
+
+@pytest.mark.slow
+def test_chaos_drill_tcp_with_killed_worker():
+    """The same acceptance drill over the native TCP transport — chaos
+    rides ABOVE the real wire, so the production serialize/send/receive
+    paths run under fault injection."""
+
+    class DyingClient(FedAVGClientManager):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self._trained = 0
+
+        def _train(self, net, idx):
+            self._trained += 1
+            if self._trained > 2:
+                self.finish()
+                return
+            super()._train(net, idx)
+
+    from fedml_tpu.algos.fedavg_distributed import build_federation_setup
+    from fedml_tpu.comm.loopback import run_workers
+    from fedml_tpu.trainer.local import softmax_ce
+
+    fed, test = _task()
+    cfg = FedConfig(client_num_in_total=6, client_num_per_round=3,
+                    comm_round=6, epochs=2, batch_size=16, lr=0.3,
+                    frequency_of_the_test=1, round_timeout_s=3.0,
+                    heartbeat_interval_s=0.3)
+    chaos = ChaosSpec(seed=5, drop_p=0.05, dup_p=0.05, delay_p=0.1,
+                      max_delay_s=0.02)
+    size, net0, local_train, eval_fn, args = build_federation_setup(
+        LogisticRegression(num_classes=4), fed, test, cfg, "TCP",
+        softmax_ce, chaos=chaos)
+    agg = FedAVGAggregator(net0, size - 1, cfg, eval_fn, test)
+    server = FedAVGServerManager(args, agg, cfg, size, backend="TCP")
+    clients = [DyingClient(args, 1, size, fed, local_train, cfg,
+                           backend="TCP", idle_timeout_s=12.0)]
+    clients += [
+        FedAVGClientManager(args, rank, size, fed, local_train, cfg,
+                            backend="TCP", idle_timeout_s=12.0)
+        for rank in range(2, size)
+    ]
+    t0 = time.monotonic()
+    run_workers([server.run] + [c.run for c in clients])
+    assert time.monotonic() - t0 < 90.0
+    assert server.round_idx == cfg.comm_round
+    assert agg.test_history[-1]["accuracy"] > 0.5
+    assert server.health()["evictions"] >= 1
+
+
+@pytest.mark.slow
+def test_server_crash_and_resume_matches_uninterrupted(tmp_path):
+    """Kill the server mid-run, restart it from the latest checkpoint:
+    the federation continues and lands in the uninterrupted run's
+    final-accuracy ballpark; pre-crash uploads are epoch-rejected."""
+    from fedml_tpu.algos.fedavg_distributed import build_federation_setup
+    from fedml_tpu.trainer.local import softmax_ce
+
+    fed, test = _task()
+
+    def make_cfg():
+        # Generous deadlines: this drill shares the box with the rest of
+        # the suite, and a loaded machine stretches jit compile + orbax
+        # construction well past a tight round deadline. Self-healing
+        # (beat re-admission) covers spurious evictions either way.
+        return FedConfig(client_num_in_total=6, client_num_per_round=3,
+                         comm_round=8, epochs=2, batch_size=16, lr=0.3,
+                         frequency_of_the_test=1, round_timeout_s=5.0,
+                         heartbeat_interval_s=0.2, checkpoint_every=2)
+
+    # Uninterrupted twin.
+    cfg = make_cfg()
+    clean = FedML_FedAvg_distributed(
+        LogisticRegression(num_classes=4), fed, test, cfg)
+    clean_acc = clean.test_history[-1]["accuracy"]
+
+    class Crash(Exception):
+        pass
+
+    class CrashingServer(FedAVGServerManager):
+        def _complete_round(self):
+            super()._complete_round()
+            if self.round_idx == 4:  # past the round-4 checkpoint
+                raise Crash("kill -9")
+
+    cfg = make_cfg()
+    ckpt_dir = str(tmp_path / "ckpt")
+    size, net0, local_train, eval_fn, args = build_federation_setup(
+        LogisticRegression(num_classes=4), fed, test, cfg, "LOOPBACK",
+        softmax_ce)
+    agg1 = FedAVGAggregator(net0, size - 1, cfg, eval_fn, test)
+    server1 = CrashingServer(args, agg1, cfg, size,
+                             checkpoint_dir=ckpt_dir)
+    clients = [
+        FedAVGClientManager(args, rank, size, fed, local_train, cfg,
+                            idle_timeout_s=60.0)
+        for rank in range(1, size)
+    ]
+    crashed = []
+
+    def run_server1():
+        try:
+            server1.run()
+        except Crash:
+            crashed.append(True)
+
+    threads = [threading.Thread(target=run_server1, daemon=True)]
+    threads += [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    threads[0].join(timeout=60)
+    assert crashed, "server did not crash as scripted"
+    # The dead instance never stopped its loop cleanly; workers are idle,
+    # their uploads for the in-flight round queued in inbox 0. Restart:
+    # a NEW manager on the same network restores the checkpoint, bumps
+    # the epoch, and re-broadcasts assignments.
+    agg2 = FedAVGAggregator(net0, size - 1, cfg, eval_fn, test)
+    server2 = FedAVGServerManager(args, agg2, cfg, size,
+                                  checkpoint_dir=ckpt_dir)
+    assert server2.epoch == 1
+    assert 0 < server2.round_idx <= 4  # restored, not restarted from 0
+    t2 = threading.Thread(target=server2.run, daemon=True)
+    t2.start()
+    t2.join(timeout=90)
+    assert not t2.is_alive(), "restarted server did not terminate"
+    for t in threads[1:]:
+        t.join(timeout=30)
+        assert not t.is_alive(), "worker did not terminate after resume"
+    assert server2.round_idx == cfg.comm_round
+    resumed_acc = agg2.test_history[-1]["accuracy"]
+    assert resumed_acc > 0.5
+    assert abs(resumed_acc - clean_acc) < 0.15  # same ballpark
+    # Pre-crash uploads were deterministically rejected by the epoch.
+    assert server2.health()["epoch_drops"] >= 1
