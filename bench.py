@@ -214,6 +214,7 @@ def bench_cifar_resnet56(profile_dir=None):
     # Device-work floor (currently a no-op at ~0.6 s/call; guards the
     # metric's honesty if this config ever speeds past the tunnel RTT).
     for _ in range(4):
+        _check_section_deadline()
         t0 = time.perf_counter()
         losses = api.train_rounds_on_device(rounds)
         float(np.asarray(losses).sum())
@@ -225,6 +226,16 @@ def bench_cifar_resnet56(profile_dir=None):
 
     sps_trials, rps_trials = [], []
     for trial in range(TRIALS):
+        if sps_trials:
+            # Primary cap (BENCH_PRIMARY_S): keep the trials already
+            # timed — a 3-trial median beats a {"timeout": ...} hole in
+            # the headline; raise only while there is nothing to report.
+            try:
+                _check_section_deadline()
+            except _SectionTimeout:
+                break
+        else:
+            _check_section_deadline()
         ctx = None
         if profile_dir is not None and trial == TRIALS - 1:
             try:  # best-effort: profiling through the tunnel may not work
@@ -262,7 +273,7 @@ def bench_cifar_resnet56(profile_dir=None):
         "samples_per_sec_iqr": sps_iqr,
         "rounds_per_sec": round(rps, 3),
         "rounds_per_sec_iqr": rps_iqr,
-        "trials": TRIALS,
+        "trials": len(sps_trials),
         "chip": kind,
         "delivered_tflops": round(delivered_tflops, 3),
         "flops_model": "3x forward (XLA cost analysis), bf16 compute",
@@ -719,6 +730,72 @@ def bench_chaos():
     }
 
 
+def bench_fleet_sim():
+    """Serving under churn on the REAL control plane (fedml_tpu.sim):
+    one fixed seeded fleet trace — staggered arrivals, diurnal
+    availability windows, power-law device speeds, mid-round churn —
+    replayed against sync first-k (fedavg_distributed), buffered
+    semi-sync (fedbuff, aggregate every k arrivals with polynomial
+    staleness discounting), and pure async (fedasync). Virtual clock:
+    a four-virtual-hour diurnal scenario replays in wall seconds, the
+    training math is exact (final_accuracy is real), and the whole
+    interleaving is pinned by the seed (tests/test_fleet_sim.py diffs
+    two runs' full arrival logs). The serving story the headline
+    carries: buffered(k) beats first-k(k) round-throughput (no barrier,
+    no discarded straggler work) while holding a lower staleness tail
+    than pure async (docs/ROBUSTNESS.md "Serving under churn")."""
+    import dataclasses
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.data.batching import batch_global, build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.sim import FleetSimulator, FleetSpec, make_fleet_trace
+
+    x, y = make_classification(320, n_features=10, n_classes=4, seed=1)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 8),
+                                 batch_size=16)
+    test = batch_global(x[:96], y[:96], 16)
+    cfg = FedConfig(client_num_in_total=8, client_num_per_round=8,
+                    comm_round=12, epochs=1, batch_size=16, lr=0.3,
+                    frequency_of_the_test=4)
+    spec = FleetSpec(n_devices=8, seed=11, horizon_s=14400.0,
+                     mean_online=0.75, base_round_s=30.0, slot_s=180.0,
+                     speed_alpha=1.3, diurnal_amplitude=0.3,
+                     arrival_spread_s=120.0)
+    k = 4
+
+    def go(mode, spec=spec, **kw):
+        sim = FleetSimulator(LogisticRegression(num_classes=4), fed, test,
+                             cfg, make_fleet_trace(spec), mode=mode, **kw)
+        return sim.run()
+
+    out = {"k": k, "trace": make_fleet_trace(spec).describe()}
+    # Accuracy yardstick: the same federation on an always-on fleet.
+    _check_section_deadline()
+    clean = go("sync", spec=dataclasses.replace(spec, mean_online=1.0,
+                                                diurnal_amplitude=0.0),
+               aggregate_k=0)
+    out["clean_accuracy"] = clean.final_accuracy
+    runs = {}
+    for label, mode, kw in (("sync_firstk", "sync", {"aggregate_k": k}),
+                            ("buffered", "fedbuff", {"buffer_k": k}),
+                            ("async", "fedasync", {})):
+        _check_section_deadline()
+        runs[label] = go(mode, **kw)
+        out[label] = runs[label].summary()
+    sync_tp = runs["sync_firstk"].updates_per_vmin
+    buf_tp = runs["buffered"].updates_per_vmin
+    out["buffered_vs_firstk_throughput"] = (round(buf_tp / sync_tp, 3)
+                                            if sync_tp else None)
+    bp = out["buffered"].get("staleness_p95")
+    ap = out["async"].get("staleness_p95")
+    out["buffered_vs_async_stale_p95"] = (round(bp / ap, 3)
+                                          if bp is not None and ap else None)
+    return out
+
+
 def bench_stackoverflow_342k():
     """BASELINE.md's largest row at its TRUE scale: 342,477 clients
     (the reference enumerates exactly that many stackoverflow_nwp
@@ -1111,42 +1188,65 @@ def main():
     profile_dir = ("runs/bench_profile"
                    if (os.environ.get("BENCH_PROFILE") == "1" or attached)
                    else None)
-    # Wall-clock budget over the SECONDARY sections (r5 satellite: the
-    # r5 run hit the driver timeout inside transformer_flash_e2e — rc
-    # 124, parsed: null — and the headline line never printed). The
-    # budget check runs before each section starts; r6 adds the
-    # PER-SECTION hard cap (BENCH_SECTION_S, enforced subprocess-free by
-    # _check_section_deadline inside every A/B repeat/calibration loop)
-    # so a single long section can no longer blow past the driver kill
-    # timer, and drops the default budget 1350 → 900 s — worst case is
-    # now primary + budget + ONE section cap + the JSON dump. Sections
-    # the budget skips are recorded as {"skipped": ...}, capped sections
-    # as {"timeout": ...} — explicit holes, not silent ones — and the
-    # headline ALWAYS lands as the final line.
+    # Wall-clock budget re-fit (r7; the r5-era scheme stopped bounding
+    # the REAL wall clock and BENCH_r05 exited rc=124 with no headline):
+    # 1. the PRIMARY now runs under its own cap (BENCH_PRIMARY_S — its
+    #    calibration/trial loops check the section deadline, keeping
+    #    whatever trials completed), so an uncapped primary can no
+    #    longer eat the whole driver window before the budget loop even
+    #    starts;
+    # 2. a section is started only if its WORST CASE fits — elapsed +
+    #    BENCH_SECTION_S <= BENCH_BUDGET_S — instead of merely starting
+    #    before the budget line and overrunning it by a full section cap;
+    # 3. the chronically compile-bound transformer_flash_e2e section
+    #    (single uninterruptible XLA compiles at T=8192 that no
+    #    between-units deadline check can preempt — what actually blew
+    #    r05) is rotated out of the default list; BENCH_HEAVY=1 restores
+    #    it, and flash/MFU coverage stays via flash_attention_sweep +
+    #    transformer_fed_mfu.
+    # Worst case is now BENCH_PRIMARY_S-bounded primary, sections ending
+    # AT the budget line, + the JSON dump. Sections the budget skips are
+    # recorded as {"skipped": ...}, capped sections as {"timeout": ...}
+    # — explicit holes, not silent ones — and the headline ALWAYS lands
+    # as the final line.
     global _SECTION_DEADLINE
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "900"))
     section_s = float(os.environ.get("BENCH_SECTION_S", "240"))
+    primary_s = float(os.environ.get("BENCH_PRIMARY_S", "420"))
     _t0 = time.perf_counter()
-    primary = bench_cifar_resnet56(profile_dir=profile_dir)
+    _SECTION_DEADLINE = time.perf_counter() + primary_s
+    try:
+        primary = bench_cifar_resnet56(profile_dir=profile_dir)
+    except _SectionTimeout as e:
+        # Not even one timed trial inside the cap: an honest hole beats
+        # a headline that never prints.
+        primary = {"samples_per_sec": None,
+                   "timeout": f"primary cap {primary_s:.0f}s: {e}"}
+    finally:
+        _SECTION_DEADLINE = None
     _log("primary done")
+    sections = [("femnist_cnn_3400clients", bench_femnist_cnn_3400),
+                ("store_windowed", bench_store_windowed),
+                ("store_windowed_fedopt", bench_store_windowed_fedopt),
+                ("robust_agg", bench_robust_agg),
+                ("chaos", bench_chaos),
+                ("fleet_sim", bench_fleet_sim),
+                ("stackoverflow_342k", bench_stackoverflow_342k),
+                ("vit_cifar_shaped", bench_vit),
+                ("resnet56_batch128_tuned", bench_resnet56_b128),
+                ("resnet56_s2d_stem", bench_resnet56_s2d),
+                ("sharded_path_mesh1", bench_sharded_path),
+                ("flash_attention_sweep", bench_flash_attention_sweep),
+                ("transformer_fed_mfu", bench_transformer_fed_mfu)]
+    if os.environ.get("BENCH_HEAVY") == "1":
+        sections.append(("transformer_flash_e2e", bench_transformer_flash_e2e))
     sub = {}
-    for name, fn in (("femnist_cnn_3400clients", bench_femnist_cnn_3400),
-                     ("store_windowed", bench_store_windowed),
-                     ("store_windowed_fedopt", bench_store_windowed_fedopt),
-                     ("robust_agg", bench_robust_agg),
-                     ("chaos", bench_chaos),
-                     ("stackoverflow_342k", bench_stackoverflow_342k),
-                     ("vit_cifar_shaped", bench_vit),
-                     ("resnet56_batch128_tuned", bench_resnet56_b128),
-                     ("resnet56_s2d_stem", bench_resnet56_s2d),
-                     ("sharded_path_mesh1", bench_sharded_path),
-                     ("flash_attention_sweep", bench_flash_attention_sweep),
-                     ("transformer_fed_mfu", bench_transformer_fed_mfu),
-                     ("transformer_flash_e2e", bench_transformer_flash_e2e)):
+    for name, fn in sections:
         elapsed = time.perf_counter() - _t0
-        if elapsed > budget_s:
+        if elapsed + section_s > budget_s:
             sub[name] = {"skipped": (f"wall-clock budget {budget_s:.0f}s "
-                                     f"exhausted at +{elapsed:.0f}s")}
+                                     f"cannot fit a {section_s:.0f}s "
+                                     f"section cap at +{elapsed:.0f}s")}
             _log(f"{name} SKIPPED (budget)")
             continue
         _SECTION_DEADLINE = time.perf_counter() + section_s
@@ -1184,7 +1284,8 @@ def main():
         "metric": "fedavg_cifar10_resnet56_samples_per_sec_per_chip",
         "value": sps,
         "unit": "samples/sec/chip",
-        "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
+        "vs_baseline": (round(sps / BASELINE_SAMPLES_PER_SEC, 3)
+                        if sps else None),
         **primary,
         "tuned_best": tuned,
         "submetrics": sub,
@@ -1196,9 +1297,10 @@ def main():
     # stable repo-relative pointer, not a machine-specific absolute path
     # (r5 ADVICE: the final stdout line is an artifact other machines
     # read).
-    blob_rel = "docs/bench_r5_local.json"
-    blob_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             *blob_rel.split("/"))
+    blob_rel = os.environ.get("BENCH_BLOB", "docs/bench_r6_local.json")
+    blob_path = (blob_rel if os.path.isabs(blob_rel)
+                 else os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   *blob_rel.split("/")))
     try:
         with open(blob_path, "w") as f:
             json.dump(out, f, indent=1)
@@ -1210,7 +1312,7 @@ def main():
     print(json.dumps(build_headline(out, full_path=blob_rel)))
 
 
-def build_headline(out, full_path="docs/bench_r5_local.json"):
+def build_headline(out, full_path="docs/bench_r6_local.json"):
     """Compact headline emitted as the FINAL stdout line (r4 VERDICT #1):
     the driver records a bounded TAIL of stdout, and by r3/r4 the full
     line had outgrown it — BENCH_r0{3,4}.json carried neither the primary
@@ -1251,6 +1353,12 @@ def build_headline(out, full_path="docs/bench_r5_local.json"):
                                            "robust_agg_overhead"),
             "chaos_clean_overhead": _scalar("chaos",
                                             "chaos_clean_overhead"),
+            "fleet_buffered_vs_firstk": _scalar(
+                "fleet_sim", "buffered_vs_firstk_throughput"),
+            "fleet_buffered_stale_p95_vs_async": _scalar(
+                "fleet_sim", "buffered_vs_async_stale_p95"),
+            "fleet_buffered_acc": _scalar("fleet_sim", "buffered",
+                                          "final_accuracy"),
             "stackoverflow_342k_rps": _scalar("stackoverflow_342k",
                                               "rounds_per_sec"),
             "vit_sps": _scalar("vit_cifar_shaped", "samples_per_sec"),
@@ -1264,9 +1372,10 @@ def build_headline(out, full_path="docs/bench_r5_local.json"):
             "flash_speedup_t16384": _scalar("flash_attention_sweep",
                                             "points", "t16384", "speedup"),
             "transformer_mfu": _scalar("transformer_fed_mfu", "mfu"),
-            "flash_e2e_speedup_t8192": _scalar("transformer_flash_e2e",
-                                               "points", "t8192",
-                                               "speedup"),
+            # transformer_flash_e2e rides only under BENCH_HEAVY=1 (it
+            # is what blew the r05 wall clock); its scalar stays out of
+            # the default headline so the <1KB tail budget funds the
+            # fleet_sim serving story instead.
         },
         "full": full_path,
     }

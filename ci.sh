@@ -71,6 +71,10 @@ echo "== async FL (no-barrier staleness-weighted) =="
 python -m fedml_tpu.exp.main_extra --algorithm FedAsync \
     --model lr --dataset synthetic_1_1 $common
 
+echo "== buffered semi-sync FL (aggregate every k arrivals) =="
+python -m fedml_tpu.exp.main_extra --algorithm FedBuff --buffer_k 2 \
+    --model lr --dataset synthetic_1_1 $common
+
 echo "== message-passing framework templates =="
 python -m fedml_tpu.exp.main_extra --algorithm BaseFramework $common
 

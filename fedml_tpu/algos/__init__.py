@@ -15,6 +15,7 @@ from fedml_tpu.algos.split_nn import SplitNNAPI
 from fedml_tpu.algos.turboaggregate import TurboAggregateAPI
 from fedml_tpu.algos.ditto import DittoAPI
 from fedml_tpu.algos.fedasync import FedML_FedAsync_distributed
+from fedml_tpu.algos.fedbuff import FedML_FedBuff_distributed
 from fedml_tpu.algos.fedbn import FedBNAPI
 from fedml_tpu.algos.qfedavg import QFedAvgAPI
 from fedml_tpu.algos.feddyn import FedDynAPI
@@ -25,6 +26,7 @@ __all__ = [
     "DittoAPI",
     "FedBNAPI",
     "FedML_FedAsync_distributed",
+    "FedML_FedBuff_distributed",
     "QFedAvgAPI",
     "FedDynAPI",
     "ScaffoldAPI",

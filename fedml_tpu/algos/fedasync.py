@@ -58,6 +58,10 @@ from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.trainer.local import softmax_ce
 
 MSG_ARG_KEY_MODEL_VERSION = "model_version"
+# Strictly increasing per-worker assignment id, echoed in uploads: the
+# dedupe key on BOTH ends (the model version cannot serve — the buffered
+# tier re-assigns at an unchanged version until the buffer flushes).
+MSG_ARG_KEY_TASK_SEQ = "task_seq"
 
 log = logging.getLogger(__name__)
 
@@ -86,19 +90,29 @@ class FedAsyncServerManager(ServerManager):
         self.test_data = test_data
         self.version = 0
         self.staleness_history: List[int] = []
+        # Accepted-upload order, (worker, base_version) per arrival — the
+        # aggregation order the trace-determinism tests pin (sim/).
+        self.arrival_log: List[tuple] = []
         self.test_history: List[dict] = []
         self.evictions = 0
         self.duplicate_drops = 0
         self.reassignments = 0
         self._members: Set[int] = set(range(1, size))
         self._done_set: Set[int] = set()
-        # Per-worker high-water mark of the model version its uploads
-        # trained FROM: a worker's assigned versions strictly increase,
-        # so a repeat (ChaosTransport duplication, sender retry after a
-        # lost ACK) is dropped WITHOUT reply — mixing it twice would
-        # double-count one real update and hand the worker a second live
-        # assignment.
-        self._last_upload_ver: Dict[int, int] = {}
+        # Per-worker high-water mark of the ASSIGNMENT SEQUENCE its
+        # uploads answer: every assignment carries a strictly increasing
+        # per-worker task id, so a repeat upload (ChaosTransport
+        # duplication, sender retry after a lost ACK) is dropped WITHOUT
+        # reply — mixing it twice would double-count one real update and
+        # hand the worker a second live assignment. The id must be the
+        # task, not the model version: the buffered tier (fedbuff.py)
+        # legitimately re-assigns a worker at an UNCHANGED version until
+        # the buffer flushes, so version-keyed dedupe would starve it.
+        # Uploads without the task key (older peers, hand-built test
+        # messages) fall back to the version — exact pure-async
+        # equivalence, where versions do strictly increase per worker.
+        self._last_upload_task: Dict[int, int] = {}
+        self._task_seq: Dict[int, int] = {}
         # Wall-clock of the last time each worker made request/response
         # progress (assignment sent or upload arrived). The strict
         # request/response flow means a LOST server reply leaves an
@@ -245,6 +259,12 @@ class FedAsyncServerManager(ServerManager):
         if done and not self._stopped:
             self.finish()
 
+    def _next_task(self, worker: int) -> int:
+        with self._lock:
+            seq = self._task_seq.get(worker, 0)
+            self._task_seq[worker] = seq + 1
+        return seq
+
     def _assign_client(self, worker: int) -> int:
         """Deterministic per-(version, worker) client assignment — the
         async analogue of the reference's seeded per-round sampling."""
@@ -258,6 +278,7 @@ class FedAsyncServerManager(ServerManager):
             msg.add(MSG_ARG_KEY_MODEL_PARAMS, self.net)
             msg.add(MSG_ARG_KEY_CLIENT_INDEX, self._assign_client(worker))
             msg.add(MSG_ARG_KEY_MODEL_VERSION, 0)
+            msg.add(MSG_ARG_KEY_TASK_SEQ, self._next_task(worker))
             self._last_progress[worker] = self._clock()
             try:
                 self.send_message(msg)
@@ -275,8 +296,9 @@ class FedAsyncServerManager(ServerManager):
         out.add(MSG_ARG_KEY_MODEL_PARAMS, self.net)
         out.add(MSG_ARG_KEY_CLIENT_INDEX, self._assign_client(worker))
         out.add(MSG_ARG_KEY_MODEL_VERSION, self.version)
+        out.add(MSG_ARG_KEY_TASK_SEQ, self._next_task(worker))
         if recovery:
-            # Stalled-worker recovery: tell the client which upload we
+            # Stalled-worker recovery: tell the client which TASK we
             # last ACCEPTED from it, so a worker that is merely SLOW (its
             # upload still in flight, or lost) resends its cached upload
             # instead of training this extra assignment — beats arriving
@@ -284,7 +306,7 @@ class FedAsyncServerManager(ServerManager):
             # backlog an unbounded queue of live assignments.
             out.add("recovery", True)
             with self._lock:
-                out.add("expected", self._last_upload_ver.get(worker, -1))
+                out.add("expected", self._last_upload_task.get(worker, -1))
         self._last_progress[worker] = self._clock()
         try:
             self.send_message(out)
@@ -313,18 +335,20 @@ class FedAsyncServerManager(ServerManager):
             self._send_done(worker)
             return
         base_ver = int(msg.get(MSG_ARG_KEY_MODEL_VERSION))
+        task = msg.get(MSG_ARG_KEY_TASK_SEQ)
+        task = base_ver if task is None else int(task)
         with self._lock:
-            if base_ver <= self._last_upload_ver.get(worker, -1):
+            if task <= self._last_upload_task.get(worker, -1):
                 self.duplicate_drops += 1
                 return
-            self._last_upload_ver[worker] = base_ver
+            self._last_upload_task[worker] = task
         staleness = self.version - base_ver
-        w = staleness_weight(self.alpha, staleness, self.staleness_exp)
-        self.net = self._mix(self.net, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
-                             jnp.float32(w))
-        self.version += 1
         self.staleness_history.append(staleness)
-        if (self.eval_fn is not None and self.test_data is not None and
+        self.arrival_log.append((worker, base_ver))
+        v0 = self.version
+        self._ingest(msg, staleness)
+        if (self.version != v0 and self.eval_fn is not None
+                and self.test_data is not None and
                 (self.version % self.cfg.frequency_of_the_test == 0
                  or self.version >= self.cfg.comm_round)):
             m = self.eval_fn(self.net, *self.test_data)
@@ -335,6 +359,17 @@ class FedAsyncServerManager(ServerManager):
             self._send_done(worker)
             return
         self._send_assignment(worker)
+
+    def _ingest(self, msg: Message, staleness: int) -> None:
+        """Fold one accepted upload into the server state. The async
+        server mixes immediately (every arrival is a model version); the
+        buffered subclass (algos/fedbuff.py) accumulates and bumps the
+        version only every ``buffer_k``-th arrival — the surrounding
+        protocol (dedupe, terminal handshake, recovery) is shared."""
+        w = staleness_weight(self.alpha, staleness, self.staleness_exp)
+        self.net = self._mix(self.net, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
+                             jnp.float32(w))
+        self.version += 1
 
 
 class FedAsyncClientManager(ClientManager):
@@ -355,17 +390,20 @@ class FedAsyncClientManager(ClientManager):
         self.steps = 0
         self.duplicate_drops = 0
         self.upload_resends = 0
-        # Assigned versions strictly increase, so an assignment at or
+        # Assigned TASK ids strictly increase, so an assignment at or
         # below the high-water mark is a transport duplicate — dropped
         # without retraining (the sync client's round dedupe, keyed on
-        # the version counter instead).
-        self._last_version = -1
-        # Cached last upload + the version it trained FROM: a recovery
-        # assignment whose ``expected`` is below that version means the
+        # the round counter instead). The id must be the task, not the
+        # model version: the buffered tier re-assigns at an unchanged
+        # version until the buffer flushes (assignments without the key
+        # fall back to the version — pure-async equivalence).
+        self._last_task = -1
+        # Cached last upload + the task it answers: a recovery
+        # assignment whose ``expected`` is below that task means the
         # server never saw our latest upload (in flight, or lost) —
         # resend the cache instead of training the recovery assignment.
         self._last_upload: Optional[Message] = None
-        self._last_upload_base = -1
+        self._last_upload_task = -1
         self._beats = HeartbeatSender(
             self._send_beat,
             interval_s=(cfg.heartbeat_interval_s if beat_interval_s is None
@@ -397,10 +435,12 @@ class FedAsyncClientManager(ClientManager):
             return
         c = int(msg.get(MSG_ARG_KEY_CLIENT_INDEX))
         version = int(msg.get(MSG_ARG_KEY_MODEL_VERSION))
+        task = msg.get(MSG_ARG_KEY_TASK_SEQ)
+        task = version if task is None else int(task)
         if msg.get("recovery"):
             exp = msg.get("expected")
             exp = int(exp) if exp is not None else -1
-            if self._last_upload is not None and self._last_upload_base > exp:
+            if self._last_upload is not None and self._last_upload_task > exp:
                 # The server thinks we are idle, but our latest upload
                 # postdates what it has accepted: it is in flight or was
                 # lost. Resend the cache (idempotent at the server's
@@ -411,27 +451,36 @@ class FedAsyncClientManager(ClientManager):
                 self.upload_resends += 1
                 self.send_message(self._last_upload)
                 return
-        if version <= self._last_version:
+        if task <= self._last_task:
             # Transport duplicate (ChaosTransport dup of an assignment):
             # retraining it would upload a copy the server drops anyway.
             self.duplicate_drops += 1
             return
-        self._last_version = version
+        self._last_task = task
         rng = jax.random.fold_in(
             jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), self.steps),
             self.rank)
         self.steps += 1
+        global_net = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         net, loss = self.local_train(
-            msg.get(MSG_ARG_KEY_MODEL_PARAMS),
+            global_net,
             self.train_fed.x[c], self.train_fed.y[c], self.train_fed.mask[c],
             rng)
         out = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
-        out.add(MSG_ARG_KEY_MODEL_PARAMS, jax.device_get(net))
+        out.add(MSG_ARG_KEY_MODEL_PARAMS, self._upload_payload(net, global_net))
         out.add(MSG_ARG_KEY_NUM_SAMPLES, int(self.train_fed.counts[c]))
         out.add(MSG_ARG_KEY_MODEL_VERSION, version)
+        out.add(MSG_ARG_KEY_TASK_SEQ, task)
         self._last_upload = out
-        self._last_upload_base = version
+        self._last_upload_task = task
         self.send_message(out)
+
+    def _upload_payload(self, net, global_net):
+        """What goes on the wire: the async protocol ships the full
+        trained model; the buffered subclass ships the client-side DELTA
+        against the model it trained from (the server keeps no version
+        history, so only the client can form it)."""
+        return jax.device_get(net)
 
 
 def FedML_FedAsync_distributed(

@@ -788,6 +788,12 @@ def build_federation_setup(model, train_fed: FederatedArrays, test_global,
     args.chaos = chaos
     if backend == "LOOPBACK":
         args.network = LoopbackNetwork(size)
+    elif backend == "SIM":
+        # Virtual-clock fleet simulation: the FleetSimulator installs
+        # args.network (a sim.transport.SimNetwork) and args.chaos_after
+        # (the event-queue scheduler for ChaosTransport's timers) itself
+        # before constructing the managers.
+        pass
     elif backend in ("TCP", "GRPC", "TRPC"):
         # Single-host table on ephemeral ports: bind rank servers first
         # (port 0), then share the resolved table. Multi-host deployments

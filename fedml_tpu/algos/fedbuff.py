@@ -1,0 +1,256 @@
+"""Buffered semi-synchronous aggregation (FedBuff-style) — the tier
+between sync first-k and pure async.
+
+Sync first-k (algos/fedavg_distributed.py) pays a round barrier: the
+fleet idles while the k-th upload is in flight, and every straggler's
+work is DISCARDED at the catch-up. Pure async (algos/fedasync.py) pays
+maximal staleness: the model version advances on every arrival, so a
+slow device's update lands against a model that moved `W-1` versions
+under it. FedBuff (Nguyen et al. 2022, "Federated Learning with Buffered
+Asynchronous Aggregation") sits between: clients train continuously with
+no barrier (async's request/response flow), but the server folds uploads
+into the global model only every ``buffer_k``-th arrival, each update
+discounted polynomially in its staleness (the same
+``fedasync.staleness_weight`` — why averaging stale local updates still
+converges is Parallel Restarted SGD, arXiv:1807.06629):
+
+    disc_i = 1 / (1 + s_i)^a                 (s_i = versions since pull)
+    delta  = Agg(stack(d_1..d_k), disc)       (cfg-pluggable aggregator)
+    global <- global + alpha * delta          (alpha = server step size)
+
+**Accumulate on arrival.** For the mean aggregator (the default) the
+server never stores the buffered updates: it keeps one running
+``acc += disc_i * d_i`` and ``wsum += disc_i`` — O(model) server memory
+regardless of ``buffer_k`` or the fleet size (the server ingest path is
+the engineering bottleneck at scale — arXiv:2307.06561). A non-mean
+aggregator from :mod:`fedml_tpu.core.robust_agg` (coord_median, trimmed
+mean, Krum, geometric median) needs the k updates side by side, so that
+path retains the k-deep buffer — O(buffer_k × model), still independent
+of the fleet size. Both paths share the weight semantics of the
+Aggregator protocol: ``disc_i`` is the weight VALUE for mean/geometric
+median and the participation gate for the order statistics, and a
+non-finite delta (a diverged or NaN-corrupted client —
+``core/faults.UpdateCorruptor``) is weight-zeroed exactly like the
+windowed tier's ``nan_guard``, so robust-vs-Byzantine and
+buffered-vs-stale compose (docs/ROBUSTNESS.md "Serving under churn").
+
+Everything else — per-worker upload dedupe, heartbeat-driven recovery of
+stalled workers, the bounded terminal handshake, chaos drills — is
+INHERITED from the async control plane: :class:`FedBuffServerManager`
+overrides only the ``_ingest`` hook, and :class:`FedBuffClientManager`
+only the wire payload (the client ships ``net - global_received``, the
+delta against the exact model it trained from; the server keeps no
+version history, so only the client can form it). ``cfg.comm_round``
+counts server AGGREGATIONS (model versions), matching the async tier's
+"server updates, not barrier rounds" contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedasync import (
+    FedAsyncClientManager,
+    FedAsyncServerManager,
+    staleness_weight,
+)
+from fedml_tpu.algos.fedavg_distributed import (
+    MSG_ARG_KEY_MODEL_PARAMS,
+    build_federation_setup,
+)
+from fedml_tpu.comm.loopback import run_workers
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.resilience import ChaosSpec
+from fedml_tpu.core.robust_agg import make_aggregator
+from fedml_tpu.core.tree import tree_sub
+from fedml_tpu.data.batching import FederatedArrays
+from fedml_tpu.trainer.local import softmax_ce
+
+log = logging.getLogger(__name__)
+
+
+def _tree_finite(tree) -> bool:
+    """Host-side finiteness gate for one arriving delta — the buffered
+    tier's ``nan_guard``: cheap next to the deserialize the upload just
+    paid, and it keeps a poisoned update out of BOTH aggregation paths."""
+    return all(bool(np.isfinite(np.asarray(l)).all())
+               for l in jax.tree.leaves(tree))
+
+
+class FedBuffServerManager(FedAsyncServerManager):
+    """Aggregate every ``buffer_k`` accepted arrivals with polynomial
+    staleness discounting; the model version counts AGGREGATIONS.
+
+    ``alpha`` is the server step size on the aggregated delta (1.0 =
+    apply the discounted-mean update as-is), NOT the async mixing rate;
+    ``staleness_exp`` is the discount exponent shared with fedasync.
+    ``aggregator`` is any :func:`core.robust_agg.make_aggregator` spec —
+    ``mean`` keeps the O(model) accumulate-on-arrival fast path.
+    """
+
+    def __init__(self, args, net, cfg: FedConfig, size: int,
+                 backend: str = "LOOPBACK", alpha: float = 1.0,
+                 staleness_exp: float = 0.5, buffer_k: int = 2,
+                 aggregator="mean", eval_fn=None, test_data=None, *,
+                 nan_guard: bool = True,
+                 done_timeout_s: Optional[float] = None,
+                 clock=time.monotonic):
+        super().__init__(args, net, cfg, size, backend=backend, alpha=alpha,
+                         staleness_exp=staleness_exp, eval_fn=eval_fn,
+                         test_data=test_data, done_timeout_s=done_timeout_s,
+                         clock=clock)
+        if buffer_k < 1:
+            raise ValueError(f"buffer_k must be >= 1, got {buffer_k}")
+        self.buffer_k = buffer_k
+        self.aggregator = make_aggregator(aggregator)
+        self.nan_guard = nan_guard
+        self.guard_drops = 0  # non-finite deltas weight-zeroed out
+        # Mean fast path: running discounted sum + weight, O(model).
+        self._acc = None
+        self._wsum = 0.0
+        # Robust path: the k-deep buffer of (delta, discount) pairs.
+        self._pending: List[Tuple[object, float]] = []
+        self._count = 0
+        self._accum = jax.jit(
+            lambda acc, d, w: jax.tree.map(
+                lambda a_, d_: a_ + w * d_.astype(jnp.float32), acc, d))
+        self._lift = jax.jit(
+            lambda d, w: jax.tree.map(
+                lambda d_: w * d_.astype(jnp.float32), d))
+        self._apply = jax.jit(
+            lambda g, d, s: jax.tree.map(
+                lambda g_, d_: (g_.astype(jnp.float32)
+                                + s * d_.astype(jnp.float32)
+                                ).astype(g_.dtype), g, d))
+
+    @property
+    def aggregations(self) -> int:
+        return self.version
+
+    def _ingest(self, msg: Message, staleness: int) -> None:
+        disc = staleness_weight(1.0, staleness, self.staleness_exp)
+        delta = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        if self.nan_guard and not _tree_finite(delta):
+            # Weight-zeroed like the windowed tier's nan_guard: the slot
+            # still fills its buffer position (the arrival happened) but
+            # is EXCLUDED from the statistics — disc=0 is the Aggregator
+            # protocol's participation gate.
+            self.guard_drops += 1
+            disc = 0.0
+        if self.aggregator.is_mean:
+            if disc > 0.0:
+                self._acc = (self._lift(delta, jnp.float32(disc))
+                             if self._acc is None
+                             else self._accum(self._acc, delta,
+                                              jnp.float32(disc)))
+                self._wsum += disc
+        else:
+            if disc <= 0.0:
+                # A guard-dropped delta must not enter the stacked
+                # buffer as raw NaN/inf: weight 0 excludes it from every
+                # aggregator's STATISTICS, but 0 x NaN = NaN would still
+                # poison the weighted recombination (krum / geometric
+                # median; the windowed tier zeroes via where for the
+                # same reason, parallel/shard.py).
+                delta = jax.tree.map(
+                    lambda l: jnp.zeros_like(jnp.asarray(l, jnp.float32)),
+                    delta)
+            self._pending.append((delta, disc))
+        self._count += 1
+        if self._count >= self.buffer_k:
+            self._flush()
+
+    def _flush(self) -> None:
+        """Apply the buffered aggregate and bump the model version. An
+        all-excluded buffer (every delta weight-zeroed) keeps the
+        previous net, mirroring the round builders' all-excluded
+        contract — the version still advances (the k arrivals were
+        consumed)."""
+        if self.aggregator.is_mean:
+            if self._wsum > 0.0:
+                delta = self._lift(self._acc, jnp.float32(1.0 / self._wsum))
+                self.net = self._apply(self.net, delta,
+                                       jnp.float32(self.alpha))
+            self._acc = None
+            self._wsum = 0.0
+        else:
+            weights = jnp.asarray([w for _, w in self._pending],
+                                  jnp.float32)
+            if bool(jnp.any(weights > 0)):
+                stacked = jax.tree.map(
+                    lambda *ls: jnp.stack(
+                        [jnp.asarray(l, jnp.float32) for l in ls]),
+                    *[d for d, _ in self._pending])
+                delta = self.aggregator(stacked, weights)
+                self.net = self._apply(self.net, delta,
+                                       jnp.float32(self.alpha))
+            self._pending = []
+        self._count = 0
+        self.version += 1
+
+
+class FedBuffClientManager(FedAsyncClientManager):
+    """The async client with a delta wire format: uploads
+    ``net - global_received`` (the update against the exact model it
+    trained from). ``corruptor`` (a :class:`core.faults.UpdateCorruptor`)
+    marks this rank Byzantine for attack-vs-defense drills: the trained
+    model is corrupted BEFORE the delta is formed — the same threat
+    order as the windowed tier's device-side drill."""
+
+    def __init__(self, *args_, corruptor=None, **kw):
+        super().__init__(*args_, **kw)
+        self.corruptor = corruptor
+
+    def _upload_payload(self, net, global_net):
+        if self.corruptor is not None:
+            net = self.corruptor.corrupt(net, global_net)
+        return jax.device_get(tree_sub(net, global_net))
+
+
+def FedML_FedBuff_distributed(
+    model,
+    train_fed: FederatedArrays,
+    test_global,
+    cfg: FedConfig,
+    backend: str = "LOOPBACK",
+    loss_fn=softmax_ce,
+    alpha: float = 1.0,
+    staleness_exp: float = 0.5,
+    buffer_k: int = 2,
+    aggregator="mean",
+    *,
+    chaos: Optional[ChaosSpec] = None,
+    done_timeout_s: Optional[float] = None,
+    idle_timeout_s: float = 0.0,
+    corrupt_ranks=(),
+    corruptor=None,
+):
+    """Run the buffered federation: ``cfg.comm_round`` server
+    AGGREGATIONS (each consuming ``buffer_k`` arrivals) across
+    ``cfg.client_num_per_round`` workers. Returns the server manager
+    (net, staleness/arrival history, test history). ``corrupt_ranks`` +
+    ``corruptor`` flag Byzantine workers for drills; ``aggregator`` is
+    the server-side defense (core/robust_agg spec)."""
+    size, net0, local_train, eval_fn, args = build_federation_setup(
+        model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos)
+    server = FedBuffServerManager(
+        args, net0, cfg, size, backend=backend, alpha=alpha,
+        staleness_exp=staleness_exp, buffer_k=buffer_k,
+        aggregator=aggregator, eval_fn=eval_fn, test_data=test_global,
+        done_timeout_s=done_timeout_s)
+    clients = [
+        FedBuffClientManager(args, rank, size, train_fed, local_train, cfg,
+                             backend=backend, idle_timeout_s=idle_timeout_s,
+                             corruptor=(corruptor if rank in set(corrupt_ranks)
+                                        else None))
+        for rank in range(1, size)
+    ]
+    run_workers([server.run] + [c.run for c in clients])
+    return server

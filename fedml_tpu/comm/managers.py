@@ -42,16 +42,27 @@ def _build_backend(args, rank: int, size: int, backend: str) -> BaseCommunicatio
         from fedml_tpu.comm.trpc import TRPCCommManager
 
         mgr = TRPCCommManager(args.host_table, rank)
+    elif backend == "SIM":
+        # Virtual-clock fleet simulation (fedml_tpu.sim): the event-queue
+        # fabric dispatches deliveries in deterministic virtual-time
+        # order; ``args.network`` is a sim.transport.SimNetwork.
+        from fedml_tpu.sim.transport import SimCommManager
+
+        mgr = SimCommManager(args.network, rank)
     else:
         raise ValueError(f"unknown comm backend {backend!r}")
     # Fault drills: ``args.chaos`` (a resilience.ChaosSpec, shared by the
     # whole fleet) wraps the real backend in a ChaosTransport, so drills
     # exercise the exact transport code paths production uses.
+    # ``args.chaos_after`` (set by the fleet simulator) reroutes the
+    # wrapper's delay/reorder timers through the virtual-clock event
+    # queue so chaos drills stay deterministic under simulation.
     spec = getattr(args, "chaos", None)
     if spec is not None:
         from fedml_tpu.comm.resilience import ChaosTransport
 
-        mgr = ChaosTransport(mgr, spec, rank)
+        mgr = ChaosTransport(mgr, spec, rank,
+                             after=getattr(args, "chaos_after", None))
     return mgr
 
 

@@ -204,10 +204,16 @@ class ChaosTransport(BaseCommunicationManager):
     """
 
     def __init__(self, inner: BaseCommunicationManager, spec: ChaosSpec,
-                 rank: int):
+                 rank: int, after: Optional[Callable] = None):
         self.inner = inner
         self.spec = spec
         self.rank = rank
+        # Deferred-delivery scheduler override: ``after(delay_s, fn)``.
+        # Default is a real threading.Timer; the virtual-clock fleet
+        # simulator (fedml_tpu.sim) injects its event queue here so the
+        # delay/reorder faults fire in deterministic virtual-time order
+        # instead of racing wall-clock timers.
+        self._after_fn = after
         self._occurrence: Dict[Tuple, int] = {}
         # receiver -> (reordered msg, copies): duplication drawn for a
         # held message applies when it is finally shipped, so the
@@ -334,6 +340,9 @@ class ChaosTransport(BaseCommunicationManager):
             pass  # late delivery to a dead peer: genuine loss
 
     def _after(self, delay_s: float, fn) -> None:
+        if self._after_fn is not None:
+            self._after_fn(max(delay_s, 1e-4), fn)
+            return
         t = threading.Timer(max(delay_s, 1e-4), fn)
         t.daemon = True
         with self._lock:

@@ -89,6 +89,21 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "command continues the run; this flag arms "
                         "restore when checkpointing itself is off, or a "
                         "fresh run needs a clean --run_dir)")
+    # Async / buffered serving tiers (algos/fedasync.py, algos/fedbuff.py;
+    # docs/ROBUSTNESS.md "Serving under churn"). Read only by the
+    # message-passing FedAsync/FedBuff runners — every other main refuses
+    # a non-default value via reject_async_tier_flags.
+    p.add_argument("--fedasync_alpha", type=float, default=-1.0,
+                   help="async mixing rate / fedbuff server step size; "
+                        "< 0 keeps the tier default (0.6 async, 1.0 "
+                        "fedbuff)")
+    p.add_argument("--staleness_exp", type=float, default=0.5,
+                   help="polynomial staleness-discount exponent a in "
+                        "1/(1+s)^a (fedasync mixing, fedbuff buffer "
+                        "weights)")
+    p.add_argument("--buffer_k", type=int, default=2,
+                   help="fedbuff: aggregate every k accepted arrivals "
+                        "(the semi-sync buffer depth)")
     # Distributed control plane (docs/ROBUSTNESS.md "Control plane";
     # read only by the message-passing federations)
     p.add_argument("--round_timeout_s", type=float, default=0.0,
@@ -167,6 +182,30 @@ def reject_fedavg_family_flags(args, algorithm: str) -> None:
             "aggregation and the corruption drill ride the FedAvg "
             "family's shared rounds only (the flag would be silently "
             "inert here)")
+
+
+def reject_async_tier_flags(args, algorithm: str, *,
+                            allow_mixing: bool = False) -> None:
+    """Refuse the async/buffered-tier knobs for runners that never read
+    them (same convention as :func:`reject_fedavg_family_flags`): a
+    churn drill whose ``--staleness_exp`` silently does nothing is worse
+    than one that refuses. ``allow_mixing`` lets FedAsync — which shares
+    ``--fedasync_alpha``/``--staleness_exp`` with FedBuff but has no
+    buffer — still refuse a stray ``--buffer_k``."""
+    bad = []
+    if not allow_mixing:
+        if getattr(args, "fedasync_alpha", -1.0) >= 0:
+            bad.append(f"--fedasync_alpha {args.fedasync_alpha}")
+        if getattr(args, "staleness_exp", 0.5) != 0.5:
+            bad.append(f"--staleness_exp {args.staleness_exp}")
+    if getattr(args, "buffer_k", 2) != 2:
+        bad.append(f"--buffer_k {args.buffer_k}")
+    if bad:
+        raise SystemExit(
+            f"{algorithm} does not support {', '.join(bad)}: staleness "
+            "weighting and the arrival buffer belong to the async/"
+            "buffered message-passing tiers (FedAsync/FedBuff in "
+            "main_extra) — the flag would be silently inert here")
 
 
 def parse_args(argv=None) -> argparse.Namespace:

@@ -93,12 +93,15 @@ def main(argv=None):
             f"--client_selection {args.client_selection} is a simulator "
             "feature; the cross-silo server samples uniformly (it has no "
             "access to silo-local losses before assignment)")
-    from fedml_tpu.exp.args import reject_fedavg_family_flags
+    from fedml_tpu.exp.args import (reject_async_tier_flags,
+                                    reject_fedavg_family_flags)
 
     # The cross-silo server reduces with FedAVGAggregator-parity math —
     # the simulator's pluggable aggregator/corruption drill would be
-    # silently inert here.
+    # silently inert here, and the barrier rounds have no staleness
+    # stream for the async-tier knobs to act on.
     reject_fedavg_family_flags(args, "the cross-silo pipeline")
+    reject_async_tier_flags(args, "the cross-silo pipeline")
 
     logging.basicConfig(
         level=logging.INFO,

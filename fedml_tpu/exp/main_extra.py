@@ -18,6 +18,7 @@ import logging
 import numpy as np
 
 from fedml_tpu.exp.args import (add_args, config_from_args,
+                                reject_async_tier_flags,
                                 reject_fedavg_family_flags)
 from fedml_tpu.exp.setup import global_test_batches, load_data
 from fedml_tpu.data.loaders import to_federated_arrays
@@ -136,8 +137,40 @@ def run_fedasync(args):
 
     fed, arrays, test, cfg = _setup(args)
     model = create_model_for(args, fed)
-    srv = FedML_FedAsync_distributed(model, arrays, test, cfg)
+    srv = FedML_FedAsync_distributed(
+        model, arrays, test, cfg,
+        alpha=(0.6 if args.fedasync_alpha < 0 else args.fedasync_alpha),
+        staleness_exp=args.staleness_exp)
     logging.info("fedasync staleness history: %s", srv.staleness_history)
+    return srv.test_history or [{"version": srv.version}]
+
+
+def run_fedbuff(args):
+    """Buffered semi-sync FL (aggregate every ``--buffer_k`` arrivals
+    with polynomial staleness discounting) — fedbuff.py. Composes with
+    ``--aggregator`` (robust buffer reduction) and ``--corrupt_mode``
+    (the first ``--attack_num_adversaries`` worker ranks turn
+    Byzantine), so churn and Byzantine drills run from one CLI."""
+    from fedml_tpu.algos.fedbuff import FedML_FedBuff_distributed
+    from fedml_tpu.core.faults import UpdateCorruptor
+    from fedml_tpu.exp.setup import create_model_for
+
+    fed, arrays, test, cfg = _setup(args)
+    model = create_model_for(args, fed)
+    corruptor = None
+    corrupt_ranks = ()
+    if args.corrupt_mode != "none":
+        corruptor = UpdateCorruptor(args.corrupt_mode, args.corrupt_scale,
+                                    seed=cfg.seed)
+        corrupt_ranks = tuple(range(1, 1 + args.attack_num_adversaries))
+    srv = FedML_FedBuff_distributed(
+        model, arrays, test, cfg,
+        alpha=(1.0 if args.fedasync_alpha < 0 else args.fedasync_alpha),
+        staleness_exp=args.staleness_exp, buffer_k=args.buffer_k,
+        aggregator=args.aggregator, corrupt_ranks=corrupt_ranks,
+        corruptor=corruptor)
+    logging.info("fedbuff staleness history: %s (guard_drops=%d)",
+                 srv.staleness_history, srv.guard_drops)
     return srv.test_history or [{"version": srv.version}]
 
 
@@ -170,6 +203,7 @@ def _loop(api, cfg):
 
 RUNNERS = {
     "FedAsync": run_fedasync,
+    "FedBuff": run_fedbuff,
     "FedGAN": run_fedgan,
     "FedGKT": run_fedgkt,
     "FedNAS": run_fednas,
@@ -188,9 +222,14 @@ def main(argv=None):
                         help="Decentralized only: dsgd | pushsum")
     add_args(parser)
     args = parser.parse_args(argv)
-    # None of these specialty algorithms ride the FedAvg-family rounds,
-    # so the robust-aggregation/drill flags must refuse, not no-op.
-    reject_fedavg_family_flags(args, args.algorithm)
+    # FedBuff composes with the robust aggregator + corruption drill
+    # (buffered ingest reduces through core/robust_agg); every other
+    # specialty algorithm must refuse those flags, not no-op. The
+    # async-tier knobs are read by FedAsync/FedBuff only.
+    if args.algorithm != "FedBuff":
+        reject_fedavg_family_flags(args, args.algorithm)
+        reject_async_tier_flags(args, args.algorithm,
+                                allow_mixing=args.algorithm == "FedAsync")
     logging.basicConfig(level=logging.INFO,
                         format=f"[{args.algorithm} %(asctime)s] %(message)s")
     history = RUNNERS[args.algorithm](args)

@@ -106,6 +106,12 @@ def run(args, algorithm: str = "FedAvg"):
             "on-device collective simulator; for message-passing cross-silo "
             "runs use fedml_tpu.algos.fedavg_distributed with a comm "
             "backend from fedml_tpu.comm")
+    # The synchronous simulator tiers have no arrival buffer or
+    # staleness stream — those knobs belong to main_extra's
+    # FedAsync/FedBuff runners and must refuse, not no-op.
+    from fedml_tpu.exp.args import reject_async_tier_flags
+
+    reject_async_tier_flags(args, algorithm)
     fed, arrays, test, model, cfg, mesh = setup_standard(args)
     api = make_api(algorithm, args, model, arrays, test, cfg, mesh,
                    class_num=fed.class_num)

@@ -1,0 +1,117 @@
+"""The ``backend="SIM"`` comm fabric: message delivery as virtual-time
+events.
+
+``SimNetwork`` replaces LoopbackNetwork's per-rank blocking queues with
+the event queue: a send schedules a delivery event at
+``now + latency_fn(msg)`` and the delivery dispatches the message to the
+receiving manager's registered handlers directly — the same serialized
+one-message-at-a-time semantics as the real receive loops (the fake-
+clock protocol tests already rely on direct handler invocation being
+faithful), but ordered by VIRTUAL time instead of thread scheduling.
+
+The fleet simulator owns the two policy hooks:
+
+- ``latency_fn(msg) -> float | None`` at SEND time — wire latency,
+  per-device compute time for uploads, or ``None`` to drop (sender
+  offline / churn killed the upload mid-training);
+- ``deliver_guard(msg) -> bool`` at DELIVERY time — receiver
+  reachability (a message to an offline phone is lost).
+
+A stopped rank (its manager called ``finish()``) drops deliveries like
+a dead process. ChaosTransport wraps a ``SimCommManager`` exactly as it
+wraps any real backend (``args.chaos``), with its delay/reorder timers
+rerouted through the same event queue (``args.chaos_after``), so chaos
+drills stay deterministic under simulation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.message import Message
+from fedml_tpu.sim.clock import EventQueue
+
+
+class SimNetwork:
+    """Shared virtual-time router: observers per rank, deliveries as
+    events. Single-threaded by construction."""
+
+    def __init__(self, size: int, events: EventQueue,
+                 latency_fn: Optional[Callable[[Message],
+                                               Optional[float]]] = None,
+                 deliver_guard: Optional[Callable[[Message], bool]] = None,
+                 default_latency_s: float = 0.0):
+        self.size = size
+        self.events = events
+        self.latency_fn = latency_fn
+        self.deliver_guard = deliver_guard
+        self.default_latency_s = default_latency_s
+        self._observers: Dict[int, List[Observer]] = {}
+        self._stopped: Set[int] = set()
+        self.counts: Dict[str, int] = {
+            "sent": 0, "delivered": 0, "dropped_send": 0,
+            "dropped_offline": 0, "dropped_stopped": 0,
+        }
+
+    def attach(self, rank: int, observer: Observer) -> None:
+        self._observers.setdefault(rank, []).append(observer)
+
+    def detach(self, rank: int, observer: Observer) -> None:
+        self._observers.get(rank, []).remove(observer)
+
+    def stop(self, rank: int) -> None:
+        self._stopped.add(rank)
+
+    def stopped(self, rank: int) -> bool:
+        return rank in self._stopped
+
+    def post(self, msg: Message) -> None:
+        self.counts["sent"] += 1
+        latency = self.default_latency_s
+        if self.latency_fn is not None:
+            latency = self.latency_fn(msg)
+        if latency is None:
+            self.counts["dropped_send"] += 1
+            return
+        self.events.after(latency, lambda m=msg: self._deliver(m))
+
+    def _deliver(self, msg: Message) -> None:
+        receiver = int(msg.get_receiver_id())
+        if receiver in self._stopped:
+            self.counts["dropped_stopped"] += 1
+            return
+        if self.deliver_guard is not None and not self.deliver_guard(msg):
+            self.counts["dropped_offline"] += 1
+            return
+        self.counts["delivered"] += 1
+        for obs in list(self._observers.get(receiver, ())):
+            obs.receive_message(msg.get_type(), msg)
+
+
+class SimCommManager(BaseCommunicationManager):
+    """Per-rank handle on the SimNetwork, implementing the backend
+    surface the managers expect. ``handle_receive_message`` is a no-op:
+    under simulation the EVENT LOOP dispatches (the fleet simulator
+    never calls the managers' blocking ``run()``)."""
+
+    def __init__(self, network: SimNetwork, rank: int):
+        self.network = network
+        self.rank = rank
+
+    def send_message(self, msg: Message) -> None:
+        if self.network.stopped(self.rank):
+            raise ConnectionError(f"sim rank {self.rank} is stopped")
+        self.network.post(msg)
+
+    def add_observer(self, observer: Observer) -> None:
+        self.network.attach(self.rank, observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self.network.detach(self.rank, observer)
+
+    def handle_receive_message(self) -> None:
+        """No blocking loop: deliveries are event-queue callbacks."""
+
+    def stop_receive_message(self) -> None:
+        self.network.stop(self.rank)

@@ -55,6 +55,86 @@ def test_headline_roundtrips_and_tolerates_errored_submetrics():
     assert len(json.dumps(h)) < 1024
 
 
+def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
+                                                  capsys):
+    """The r05 postmortem machinery, end-to-end with stubbed sections:
+    the primary runs under BENCH_PRIMARY_S (a timeout degrades to an
+    honest null, not a missing headline), a section starts only if its
+    full BENCH_SECTION_S cap still fits inside BENCH_BUDGET_S (skipped
+    otherwise), and the headline is ALWAYS the final stdout line."""
+    fake_clock = [0.0]
+    real_perf = bench.time.perf_counter
+    monkeypatch.setattr(bench.time, "perf_counter",
+                        lambda: fake_clock[0] or real_perf())
+
+    def slow_primary(profile_dir=None):
+        fake_clock[0] = 100.0  # primary ends at +100s on the fake clock
+        return {"samples_per_sec": 1000.0, "trials": 5}
+
+    def quick_section():
+        fake_clock[0] += 50.0
+        return {"ok": 1.0}
+
+    fake_clock[0] = 1.0
+    monkeypatch.setattr(bench, "bench_cifar_resnet56", slow_primary)
+    for name in ("bench_femnist_cnn_3400", "bench_store_windowed",
+                 "bench_store_windowed_fedopt", "bench_robust_agg",
+                 "bench_chaos", "bench_fleet_sim",
+                 "bench_stackoverflow_342k", "bench_vit",
+                 "bench_resnet56_b128", "bench_resnet56_s2d",
+                 "bench_sharded_path", "bench_flash_attention_sweep",
+                 "bench_transformer_fed_mfu"):
+        monkeypatch.setattr(bench, name, quick_section)
+    # Budget 300s: primary ends at +100, sections take 50s each under a
+    # 120s cap — only sections whose WORST CASE (+120s) fits start, so
+    # the loop admits at +100, +150 (ends 170 < 180=300-120 boundary ok)
+    # and skips once elapsed + 120 > 300.
+    monkeypatch.setenv("BENCH_BUDGET_S", "300")
+    monkeypatch.setenv("BENCH_SECTION_S", "120")
+    monkeypatch.setenv("BENCH_PRIMARY_S", "400")
+    monkeypatch.setenv("BENCH_BLOB", str(tmp_path / "blob.json"))
+    monkeypatch.delenv("BENCH_HEAVY", raising=False)  # un-stubbed section
+    bench.main()
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    headline = json.loads(lines[-1])  # the FINAL line parses
+    assert headline["value"] == 1000.0
+    blob = json.loads((tmp_path / "blob.json").read_text())
+    ran = [k for k, v in blob["submetrics"].items() if "ok" in v]
+    skipped = [k for k, v in blob["submetrics"].items() if "skipped" in v]
+    assert ran and skipped  # reservation admitted some, skipped the rest
+    # Every section that RAN finished inside the budget: elapsed at its
+    # start + the full section cap fit under 300s.
+    assert len(ran) * 50 + 100 <= 300
+    assert len(ran) + len(skipped) == 13
+
+
+def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
+                                                capsys):
+    def dead_primary(profile_dir=None):
+        raise bench._SectionTimeout("compile ate the cap")
+
+    monkeypatch.setattr(bench, "bench_cifar_resnet56", dead_primary)
+    for name in ("bench_femnist_cnn_3400", "bench_store_windowed",
+                 "bench_store_windowed_fedopt", "bench_robust_agg",
+                 "bench_chaos", "bench_fleet_sim",
+                 "bench_stackoverflow_342k", "bench_vit",
+                 "bench_resnet56_b128", "bench_resnet56_s2d",
+                 "bench_sharded_path", "bench_flash_attention_sweep",
+                 "bench_transformer_fed_mfu"):
+        monkeypatch.setattr(bench, name, lambda: {"ok": 1.0})
+    monkeypatch.setenv("BENCH_BUDGET_S", "9999")
+    monkeypatch.setenv("BENCH_SECTION_S", "9999")
+    monkeypatch.setenv("BENCH_BLOB", str(tmp_path / "blob.json"))
+    monkeypatch.delenv("BENCH_HEAVY", raising=False)  # un-stubbed section
+    bench.main()
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    headline = json.loads(lines[-1])
+    assert headline["value"] is None  # null, not a missing headline
+    assert headline["vs_baseline"] is None
+    blob = json.loads((tmp_path / "blob.json").read_text())
+    assert "timeout" in blob  # the hole is recorded, not silent
+
+
 def test_headline_tolerates_budget_skipped_submetrics():
     """Sections the wall-clock budget skips land as {"skipped": ...} in
     the blob; the headline must still build, carry None scalars for
