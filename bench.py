@@ -86,6 +86,36 @@ def _check_section_deadline():
             f"(+{time.perf_counter() - _SECTION_DEADLINE:.0f}s past "
             "deadline)")
 
+
+def _rss_mb():
+    """CURRENT host RSS in MB (/proc/self/statm — Linux; falls back to
+    getrusage peak elsewhere). Current, not ru_maxrss: the process peak
+    is monotone across sections, so per-section memory claims (the
+    sharded store's flat-RSS story) need point-in-time samples. Sampled
+    once per timed block by the section machinery, so every section's
+    record carries its memory trajectory for free."""
+    import os
+
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE") / 1e6
+    except Exception:
+        # Non-Linux fallback: ru_maxrss is the MONOTONE process peak
+        # (point-in-time claims like synthetic_1m's flat-RSS ratio
+        # degenerate toward 1.0 here — Linux is the measured platform),
+        # and macOS reports it in bytes where Linux uses KB.
+        import resource
+        import sys
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return peak / (1024.0 ** 2 if sys.platform == "darwin" else 1024.0)
+
+
+# Cross-section scale-comparison state (the 342k flat-store point vs the
+# 1M sharded-directory point must report RATIOS measured in the SAME
+# process): section fns record {"rps": ..., "rss_peak_mb": ...} here.
+_scale_state = {}
+
 # Advertised peak bf16 TFLOP/s per chip (public spec sheets), keyed by
 # device_kind substring. Unknown kinds → MFU omitted.
 CHIP_PEAK_BF16_TFLOPS = {
@@ -290,10 +320,12 @@ def _warm_store_buckets(api, store, counts, cpr, batch):
     bench section."""
     import jax
 
-    from fedml_tpu.data.store import _bucket_steps
+    from fedml_tpu.data.store import bucket_steps_for_counts
 
-    buckets = np.array([_bucket_steps(int(np.ceil(c / batch)))
-                        for c in counts])
+    # Vectorized (a per-client Python loop costs seconds of the section
+    # cap at the 1M-client scale); single-sourced with the store's
+    # bucket policy so warmed shapes can never drift from gathered ones.
+    buckets = bucket_steps_for_counts(counts, batch)
     for bkt in sorted(set(buckets)):
         c = int(np.argmax(buckets == bkt))
         sub = store.gather_cohort(np.full(cpr, c))
@@ -372,12 +404,13 @@ def _timed_store_windows(api, store, windows=5, window=10,
             f"window calibration could not reach the {min_window_s:.1f}s "
             f"target (last window {window} rounds, {dt:.2f}s)")
 
-    rps_w, sps_w, window_s = [], [], []
+    rps_w, sps_w, window_s, rss_w = [], [], [], []
     for _ in range(windows):
         dt, samples = run_window(r, window)
         rps_w.append(window / dt)
         sps_w.append(samples / dt)
         window_s.append(dt)
+        rss_w.append(_rss_mb())  # one RSS sample per timed block
         r += window
     # EVERY timed window must clear the floor, not just the median — with
     # median-only, 2 of 5 windows could sit inside the RTT noise band
@@ -390,7 +423,8 @@ def _timed_store_windows(api, store, windows=5, window=10,
            "rounds_per_sec_iqr": rps_iqr, "windows": windows,
            "window_rounds": window,
            "window_s_floor": min_window_s,
-           "window_s_median": round(statistics.median(window_s), 2)}
+           "window_s_median": round(statistics.median(window_s), 2),
+           "rss_peak_mb": round(max(rss_w), 1)}
     if count_samples:
         sps_med, sps_iqr = _med_iqr(sps_w)
         out["samples_per_sec"] = round(sps_med, 2)
@@ -512,12 +546,13 @@ def _timed_windowed_blocks(api, window, blocks=3, min_block_s=4.0,
     # zero to assert in tests/test_fedlint.py's uniform-bucket pin.
     from fedml_tpu.obs.sanitizer import sanitized
 
-    rps, block_s = [], []
+    rps, block_s, rss_b = [], [], []
     with sanitized(strict=False) as san:
         for _ in range(blocks):
             dt = run_block(r, rounds)
             rps.append(rounds / dt)
             block_s.append(dt)
+            rss_b.append(_rss_mb())  # one RSS sample per timed block
             r += rounds
     assert min(block_s) >= floor_s, block_s
     med, iqr = _med_iqr(rps)
@@ -526,7 +561,8 @@ def _timed_windowed_blocks(api, window, blocks=3, min_block_s=4.0,
     # tautologically — not a measurement, so not a metric).
     return {"rounds_per_sec": round(med, 3), "rounds_per_sec_iqr": iqr,
             "block_rounds": rounds, "blocks": blocks,
-            "steady_state_compiles": san.compiles}
+            "steady_state_compiles": san.compiles,
+            "rss_peak_mb": round(max(rss_b), 1)}
 
 
 def bench_store_windowed():
@@ -796,14 +832,40 @@ def bench_fleet_sim():
     return out
 
 
+def _gather_overlap_probe(api, store, probe_rounds=10, start=90_001):
+    """Median SYNCHRONOUS cohort gather+H2D seconds per round, measured
+    on rounds the timed windows never visit (fresh seeds, warm shapes).
+    Divided by the measured round wall-clock this yields the
+    prefetch-overlap ratio: the fraction of a round the prefetcher must
+    hide (<1 = the host gather fits entirely under the device compute —
+    the store's stated design point, now measured; >1 = gather-bound).
+    Checks the section deadline per round (cold memmap page-ins at 1M
+    clients are IO-bound); both callers catch the resulting
+    _SectionTimeout as a probe error so an overrun never discards the
+    primary measurement already taken."""
+    import jax
+
+    ts = []
+    for r in range(start, start + probe_rounds):
+        _check_section_deadline()
+        idx, _ = api._sample_round_uncached(r)
+        t0 = time.perf_counter()
+        sub = store.gather_cohort(np.asarray(idx))
+        jax.block_until_ready((sub.x, sub.y, sub.mask))
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
 def bench_stackoverflow_342k():
     """BASELINE.md's largest row at its TRUE scale: 342,477 clients
     (the reference enumerates exactly that many stackoverflow_nwp
     users), reference model dims (embed 96, LSTM 670, vocab 10004),
     50 clients/round, batch 16. Host-resident CSR store (~360 MB for
     ~2.25M synthetic sentences); each round's device cohort is a few MB
-    regardless of the client count."""
-    import resource
+    regardless of the client count. Reports samples/sec and the
+    measured host-gather vs round-time split (VERDICT r6 #8) so this
+    point and the 1M sharded-directory point (``synthetic_1m``) carry
+    comparable units."""
     from functools import partial
 
     from fedml_tpu.algos.config import FedConfig
@@ -826,12 +888,108 @@ def bench_stackoverflow_342k():
     api = FedAvgAPI(RNNStackOverflow(vocab_size=V), store, None, cfg,
                     loss_fn=partial(seq_softmax_ce, pad_id=0), pad_id=0)
     _warm_store_buckets(api, store, counts, cpr, batch)
-    timed = _timed_store_windows(api, store)
-    return {"clients": C, **timed,
-            "host_dataset_mb": round(store.nbytes() / 1e6, 1),
-            "host_rss_mb": round(
-                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024,
-                0)}
+    timed = _timed_store_windows(api, store, count_samples=True)
+    # Record the scale point and assemble the result BEFORE the
+    # auxiliary probe: a probe failure must not discard the primary
+    # throughput/RSS measurement already taken.
+    _scale_state["342k"] = {"rps": timed["rounds_per_sec"],
+                            "rss_peak_mb": timed["rss_peak_mb"]}
+    out = {"clients": C, **timed,
+           "host_dataset_mb": round(store.nbytes() / 1e6, 1)}
+    try:
+        gather_s = _gather_overlap_probe(api, store)
+        out["host_gather_ms_per_round"] = round(gather_s * 1e3, 1)
+        out["prefetch_overlap_ratio"] = round(
+            gather_s * timed["rounds_per_sec"], 3)
+    except Exception as e:  # incl. _SectionTimeout: the probe is
+        # auxiliary and deadline-checked per round — degrade to an
+        # explicit hole, keep the timed measurement.
+        out["gather_probe_error"] = f"{type(e).__name__}: {e}"[:120]
+    return out
+
+
+def bench_synthetic_1m(C=1_048_576, G=64, cpr=50, model_kw=None,
+                       min_window_s=6.0):
+    """The MILLION-CLIENT tier (ROADMAP open item 1): 2^20 = 1,048,576
+    synthetic StackOverflow-NWP clients through the SHARDED client
+    directory (``data/directory.py`` — G memmap-spilled shards built one
+    at a time, directory metadata O(clients), gathers page in only the
+    cohort's rows) on the same model/round config as
+    ``stackoverflow_342k``, so the two points differ ONLY in client
+    count and storage tier. The claims this section records, as
+    measured ratios against the 342k flat-store point (same process,
+    same units): host RSS stays FLAT as the client count grows 3x past
+    the flat store's scale (``peak_rss_ratio`` — sampled current RSS
+    per timed block, the flat-RSS story of the sharded tier), and
+    rounds/sec stays within 2x (``rps_vs_342k`` — cohort cost is
+    independent of the client count; the extra price is directory
+    sampling at 1M and memmap page-ins). The parameters exist for the
+    machinery test (tests/test_bench_headline.py) — the section always
+    runs the defaults."""
+    import shutil
+    import tempfile
+    from functools import partial
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.data.directory import ShardedFederatedStore
+    from fedml_tpu.models.rnn import RNNStackOverflow
+    from fedml_tpu.trainer.local import seq_softmax_ce
+
+    from fedml_tpu.data.synthetic import make_stackoverflow_shard
+
+    T, V, batch = 20, 10004, 16
+    # Remainder-aware shard sizes: sum(sizes) == C exactly, so the
+    # directory's client count always matches cfg.client_num_in_total
+    # (the sampler-delegation guard) even for non-dividing C/G.
+    sizes = [C // G + (1 if s < C % G else 0) for s in range(G)]
+
+    def builder(s):
+        # THE make_stackoverflow_nwp law (single source — data/
+        # synthetic.py), seeded per shard so build peak RSS is O(one
+        # shard).
+        return make_stackoverflow_shard(sizes[s], seq_len=T, vocab=V,
+                                        seed=10_000 + s)
+
+    spill = tempfile.mkdtemp(prefix="bench_synth1m_")
+    try:
+        store = ShardedFederatedStore.from_shard_builder(
+            builder, G, batch_size=batch, spill_dir=spill,
+            progress=lambda s: _check_section_deadline())
+        build_rss = _rss_mb()
+        cfg = FedConfig(client_num_in_total=C, client_num_per_round=cpr,
+                        comm_round=100_000, epochs=1, batch_size=batch,
+                        lr=10 ** -0.5)
+        api = FedAvgAPI(RNNStackOverflow(vocab_size=V, **(model_kw or {})),
+                        store, None, cfg,
+                        loss_fn=partial(seq_softmax_ce, pad_id=0), pad_id=0)
+        _warm_store_buckets(api, store, np.asarray(store.counts), cpr,
+                            batch)
+        timed = _timed_store_windows(api, store, count_samples=True,
+                                     min_window_s=min_window_s)
+        ref = _scale_state.get("342k")
+        out = {"clients": C, "shards": G, "memmap_spill": True, **timed,
+               "dataset_disk_mb": round(store.nbytes() / 1e6, 1),
+               "directory_mb": round(store.directory.nbytes() / 1e6, 2),
+               "build_rss_mb": round(build_rss, 1),
+               # Ratios vs the flat-store 342k point (None if its
+               # section was skipped/errored this run):
+               "rps_vs_342k": (round(timed["rounds_per_sec"] / ref["rps"],
+                                     3) if ref else None),
+               "peak_rss_ratio": (round(timed["rss_peak_mb"]
+                                        / ref["rss_peak_mb"], 3)
+                                  if ref else None)}
+        try:  # auxiliary (incl. _SectionTimeout — deadline-checked per
+            # round): must not discard the measurements above
+            gather_s = _gather_overlap_probe(api, store)
+            out["host_gather_ms_per_round"] = round(gather_s * 1e3, 1)
+            out["prefetch_overlap_ratio"] = round(
+                gather_s * timed["rounds_per_sec"], 3)
+        except Exception as e:
+            out["gather_probe_error"] = f"{type(e).__name__}: {e}"[:120]
+        return out
+    finally:
+        shutil.rmtree(spill, ignore_errors=True)
 
 
 def bench_vit():
@@ -1232,6 +1390,7 @@ def main():
                 ("chaos", bench_chaos),
                 ("fleet_sim", bench_fleet_sim),
                 ("stackoverflow_342k", bench_stackoverflow_342k),
+                ("synthetic_1m", bench_synthetic_1m),
                 ("vit_cifar_shaped", bench_vit),
                 ("resnet56_batch128_tuned", bench_resnet56_b128),
                 ("resnet56_s2d_stem", bench_resnet56_s2d),
@@ -1259,6 +1418,11 @@ def main():
             sub[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
         finally:
             _SECTION_DEADLINE = None
+        if isinstance(sub[name], dict):
+            # Memory trajectory for free: every section's record carries
+            # the process RSS right after it ran (current, not the
+            # monotone ru_maxrss peak — see _rss_mb).
+            sub[name]["rss_after_mb"] = round(_rss_mb(), 1)
         _log(f"{name} done")
 
     sps = primary.pop("samples_per_sec")
@@ -1361,6 +1525,9 @@ def build_headline(out, full_path="docs/bench_r6_local.json"):
                                           "final_accuracy"),
             "stackoverflow_342k_rps": _scalar("stackoverflow_342k",
                                               "rounds_per_sec"),
+            "synthetic_1m_rps": _scalar("synthetic_1m", "rounds_per_sec"),
+            "synthetic_1m_peak_rss_ratio": _scalar("synthetic_1m",
+                                                   "peak_rss_ratio"),
             "vit_sps": _scalar("vit_cifar_shaped", "samples_per_sec"),
             "b128_sps": _scalar("resnet56_batch128_tuned",
                                 "samples_per_sec"),

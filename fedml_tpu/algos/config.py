@@ -49,6 +49,15 @@ class FedConfig:
     # pipelined, windowed, on-device scan); on a client mesh non-mean
     # aggregators all_gather the cohort. docs/ROBUSTNESS.md.
     aggregator: str = "mean"
+    # Hierarchical sparse reduction on a client mesh (parallel/shard.py):
+    # group-composable aggregators (mean, coord_median, trimmed_mean)
+    # aggregate shard-locally first, then across the G group partials —
+    # the mesh collective shrinks from C client models to G ≪ C group
+    # partials (arXiv:1903.05133 shape). Mean keeps its bit-equal
+    # partial-sum psum fast path; non-composable aggregators (krum,
+    # geometric_median) refuse this flag loudly and keep the exact
+    # all_gather path. docs/EXECUTION.md "Scale tiers".
+    group_reduce: bool = False
     # Device-side update-corruption drill (core/faults.UpdateCorruptor
     # .device_fn, wired through FedAvgRobustAPI): adversary clients'
     # trained updates are corrupted INSIDE the jitted round — "none",

@@ -75,6 +75,17 @@ class FedAvgAPI(FederatedLoop):
     #: deep inside their round.
     supports_streaming = True
 
+    #: Subclasses whose round aggregates WITHIN groups and then ACROSS
+    #: group partials (HierarchicalFedAvgAPI) set this True to accept
+    #: group-composable robust aggregators (coord_median, trimmed_mean)
+    #: through the custom-round guard below — the two-stage statistic is
+    #: their documented semantics, not a silent drift. Non-composable
+    #: aggregators (krum, geometric_median) are still refused loudly.
+    #: The guard reads this from the concrete class's __dict__ — the
+    #: opt-in is NOT inherited: a further subclass that re-customizes
+    #: the round must re-declare it (or be refused).
+    composes_group_aggregation = False
+
     def __init__(
         self,
         model,
@@ -122,17 +133,54 @@ class FedAvgAPI(FederatedLoop):
         # protocol's philosophy — refuse loudly instead of silently
         # keeping a subclass's own aggregation.
         self._aggregator = make_aggregator(getattr(cfg, "aggregator", "mean"))
-        if not self._aggregator.is_mean and (
-                type(self).train_one_round is not FedAvgAPI.train_one_round
-                or type(self).run_round is not FederatedLoop.run_round
-                or type(self)._make_vmap_round is not FedAvgAPI._make_vmap_round
-                or type(self)._make_sharded_round
-                is not FedAvgAPI._make_sharded_round):
-            raise NotImplementedError(
-                f"{type(self).__name__} customizes the round or its "
-                f"aggregation; cfg.aggregator={cfg.aggregator!r} only rides "
-                "the FedAvg family's shared round builders (a custom round "
-                "would silently keep its own aggregation)")
+        if not self._aggregator.is_mean:
+            # The opt-in must be declared ON the concrete class itself
+            # (__dict__, not inheritance): a subclass of an opted-in
+            # class that customizes the round again would otherwise
+            # inherit the exemption and silently drop the aggregator —
+            # the exact drift the strict branch below exists to refuse.
+            if type(self).__dict__.get("composes_group_aggregation", False):
+                # The subclass runs the TWO-STAGE (within-group → across-
+                # group) aggregation (HierarchicalFedAvgAPI): only group-
+                # composable aggregators keep their semantics there.
+                if not getattr(self._aggregator, "group_composable", False):
+                    raise NotImplementedError(
+                        f"cfg.aggregator={cfg.aggregator!r} does not "
+                        "compose group-wise (krum needs pairwise client "
+                        "distances, geometric_median a joint fixpoint); "
+                        f"{type(self).__name__} aggregates within groups "
+                        "then across group partials — use a composable "
+                        "aggregator (coord_median, trimmed_mean<beta>) "
+                        "here, or the flat FedAvg family for the exact "
+                        "full-cohort all_gather path")
+            elif (type(self).train_one_round is not FedAvgAPI.train_one_round
+                    or type(self).run_round is not FederatedLoop.run_round
+                    or type(self)._make_vmap_round
+                    is not FedAvgAPI._make_vmap_round
+                    or type(self)._make_sharded_round
+                    is not FedAvgAPI._make_sharded_round):
+                raise NotImplementedError(
+                    f"{type(self).__name__} customizes the round or its "
+                    f"aggregation; cfg.aggregator={cfg.aggregator!r} only "
+                    "rides the FedAvg family's shared round builders (a "
+                    "custom round would silently keep its own aggregation)")
+        self._group_reduce = bool(getattr(cfg, "group_reduce", False))
+        if self._group_reduce:
+            if mesh is None:
+                raise NotImplementedError(
+                    "cfg.group_reduce shrinks the client-mesh collective "
+                    "(shard-local partials + a G-sized gather); on a "
+                    "single device there are no shards to group — drop "
+                    "the flag, or use HierarchicalFedAvgAPI for host-side "
+                    "grouping")
+            if not self._aggregator.is_mean and not getattr(
+                    self._aggregator, "group_composable", False):
+                raise NotImplementedError(
+                    f"cfg.aggregator={cfg.aggregator!r} does not compose "
+                    "group-wise; set group_reduce=False to keep the exact "
+                    "full client-stack all_gather path (krum, "
+                    "geometric_median), or pick a composable aggregator "
+                    "(mean, coord_median, trimmed_mean<beta>)")
         if (getattr(cfg, "corrupt_mode", "none") != "none"
                 and type(self)._corruptor is FedAvgAPI._corruptor):
             raise NotImplementedError(
@@ -248,7 +296,8 @@ class FedAvgAPI(FederatedLoop):
             client_transform=transform, nan_guard=guard,
             with_client_losses=self.cfg.client_selection == "oort",
             aggregator=self._round_aggregator(),
-            corruptor=self._corruptor())
+            corruptor=self._corruptor(),
+            group_reduce=self._group_reduce)
 
     def _round_aggregator(self):
         """The aggregator handed to the round builders: ``None`` for mean
@@ -398,8 +447,15 @@ class FedAvgAPI(FederatedLoop):
                 f"(d={d} < m={m}); raise --pow_d_candidates")
         # Cho et al. 2020 draw the candidate set proportional to client
         # data fraction, not uniformly (matters on power-law partitions).
-        candidates = sample_clients_weighted(
-            round_idx, cfg.client_num_in_total, d, self.train_fed.counts)
+        # A sharded store's ClientDirectory serves the same draw from its
+        # count metadata (identical stream — it delegates here).
+        directory = getattr(self.train_fed, "directory", None)
+        if directory is not None \
+                and directory.num_clients == cfg.client_num_in_total:
+            candidates = directory.sample_cohort_weighted(round_idx, d)
+        else:
+            candidates = sample_clients_weighted(
+                round_idx, cfg.client_num_in_total, d, self.train_fed.counts)
         if self._streaming:
             # Store path: host-gather the candidate cohort, one vmapped
             # eval pass (same kernel the resident path jits the gather

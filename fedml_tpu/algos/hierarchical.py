@@ -11,6 +11,29 @@ Invariant carried from the reference CI (CI-script-fedavg.sh:49-56): with
 full participation + full batch + 1 local epoch, a fixed product of
 global×group rounds yields the same model regardless of group count
 (asserted exactly in tests/test_algos.py).
+
+Beyond the reference, this is the HOST-SIDE half of the hierarchical
+sparse reduction (arXiv:1903.05133 shape; the mesh half is
+``parallel/shard.make_sharded_round(group_reduce=True)``):
+
+- **Streaming**: per-group cohorts gather through the layout-agnostic
+  ``_group_cohort`` — ``FederatedStore.gather_cohort`` on a host store
+  (including the sharded million-client ``ShardedFederatedStore``,
+  data/directory.py), device ``gather_clients`` on the resident layout —
+  so hierarchical rounds stream like every other algorithm (equivalence
+  vs the resident path tested).
+- **Sparse global step**: only the groups that SAMPLED clients this
+  round produce partials and enter the global reduction — at
+  reference-cohort sizes (50 of 342k clients) that is a handful of the
+  G groups, and the global step touches exactly those.
+- **Composable robust aggregation**: with a ``group_composable``
+  ``cfg.aggregator`` (coord_median, trimmed_mean<beta>) each group's
+  inner rounds aggregate its clients robustly (the aggregator is baked
+  into ``round_fn``) and the global step applies the SAME statistic
+  across the group partials — median-of-medians / trim-of-trims, the
+  hierarchical robust construction. Non-composable aggregators (krum,
+  geometric_median) are refused loudly at construction: their exact
+  semantics need the flat FedAvg family's full-cohort path.
 """
 
 from __future__ import annotations
@@ -31,7 +54,8 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
     """``group_ids[client] -> group`` assigns every client to a group;
     ``cfg.group_comm_round`` controls the inner loop."""
 
-    supports_streaming = False  # per-group device gathers bypass run_round
+    supports_streaming = True  # group cohorts ride _group_cohort
+    composes_group_aggregation = True  # two-stage robust aggregation
 
     def __init__(self, model, train_fed, test_global, cfg, group_ids: Sequence[int],
                  mesh=None, **kwargs):
@@ -41,11 +65,43 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
             raise ValueError("group_ids must have one entry per client")
         if cfg.group_comm_round < 1:
             raise ValueError(f"group_comm_round must be >= 1, got {cfg.group_comm_round}")
+        if getattr(cfg, "group_reduce", False):
+            raise NotImplementedError(
+                "HierarchicalFedAvgAPI already groups host-side; "
+                "cfg.group_reduce (the mesh-shard grouping) would nest a "
+                "second grouping inside each group's round — drop one")
+
+    def _group_cohort(self, g_idx_p):
+        """The group's padded cohort as a ``FederatedArrays`` — host
+        gather on a (possibly sharded) ``FederatedStore``, device gather
+        on the resident layout. The streaming seam that used to force
+        ``supports_streaming = False``."""
+        if self._streaming:
+            return self.train_fed.gather_cohort(np.asarray(g_idx_p))
+        return gather_clients(self.train_fed, jnp.asarray(g_idx_p))
+
+    def _global_reduce(self, group_nets, group_weights):
+        """The sparse global step over the ROUND's participating groups:
+        weighted mean (the reference semantics, bit-equal to the
+        pre-refactor path) or, with a composable ``cfg.aggregator``, the
+        same robust statistic across group partials — each group one
+        vote, ``weight > 0`` the participation gate (a group whose
+        sampled clients were all empty drops out)."""
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *group_nets)
+        gw = jnp.asarray(group_weights, jnp.float32)
+        if self._aggregator.is_mean:
+            return tree_weighted_mean(stacked, gw)
+        agg = self._aggregator(stacked, gw)
+        any_ok = jnp.sum(jnp.where(gw > 0, 1.0, 0.0)) > 0
+        return jax.tree.map(lambda a, p: jnp.where(any_ok, a, p),
+                            agg, self.net)
 
     def train_one_round(self, round_idx: int):
         idx, wmask = self.sample_round(round_idx)
         idx = idx[np.asarray(wmask) > 0]  # grouping handles padding itself
         group_nets, group_weights, losses = [], [], []
+        # Sparse: only groups that sampled clients this round train and
+        # enter the global reduction.
         for g in np.unique(self.group_ids[idx]):
             g_idx = idx[self.group_ids[idx] == g]
             # Pad to a power-of-two multiple of n_shards: bounds the number
@@ -55,7 +111,7 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
             while target < len(g_idx):
                 target *= 2
             g_idx_p, g_mask = pad_to_multiple(g_idx, target)
-            sub = gather_clients(self.train_fed, g_idx_p)
+            sub = self._group_cohort(g_idx_p)
             weights = sub.counts.astype(jnp.float32) * jnp.asarray(g_mask)
             net_g = self.net
             for _ in range(self.cfg.group_comm_round):
@@ -67,7 +123,11 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
             group_nets.append(net_g)
             group_weights.append(float(np.asarray(weights).sum()))
             losses.append(float(loss))
-        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *group_nets)
-        self.net = tree_weighted_mean(stacked, jnp.asarray(group_weights))
+        if sum(group_weights) <= 0:
+            # Every sampled client empty: no group trained a real step —
+            # keep the previous global model (a zero-total reduction
+            # would zero or inf-poison the params).
+            return {"round": round_idx, "train_loss": 0.0}
+        self.net = self._global_reduce(group_nets, group_weights)
         w = np.asarray(group_weights) / max(sum(group_weights), 1e-12)
         return {"round": round_idx, "train_loss": float((w * np.asarray(losses)).sum())}

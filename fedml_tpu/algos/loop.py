@@ -64,9 +64,21 @@ class FederatedLoop:
                 "loss-biased selection")
         from fedml_tpu.core.sampling import pad_to_multiple, sample_clients
 
-        idx = sample_clients(
-            round_idx, self.cfg.client_num_in_total, self.cfg.client_num_per_round
-        )
+        directory = getattr(self.train_fed, "directory", None)
+        if directory is not None \
+                and directory.num_clients == self.cfg.client_num_in_total:
+            # Sharded store (data/directory.py): the ClientDirectory IS
+            # the cohort sampler — a metadata-only service whose draw
+            # delegates to the same reference-seeded stream, so the
+            # cohort is bit-identical to the flat path (and invariant
+            # under re-sharding, tested).
+            idx = directory.sample_cohort(round_idx,
+                                          self.cfg.client_num_per_round)
+        else:
+            idx = sample_clients(
+                round_idx, self.cfg.client_num_in_total,
+                self.cfg.client_num_per_round
+            )
         idx, wmask = pad_to_multiple(idx, self.n_shards)
         return idx, wmask
 

@@ -60,9 +60,26 @@ import jax.numpy as jnp
 from fedml_tpu.core.tree import tree_weighted_mean
 
 
-def _mark(fn, name: str, is_mean: bool = False):
+def _mark(fn, name: str, is_mean: bool = False,
+          group_composable: bool = False):
     fn.name = name
     fn.is_mean = is_mean
+    # Hierarchical sparse reduction (arXiv:1903.05133 shape;
+    # parallel/shard.py ``group_reduce``, algos/hierarchical.py): a
+    # GROUP-COMPOSABLE aggregator may be applied in two stages — within
+    # each group over that group's clients, then across the group
+    # partials (each surviving group one vote, weight>0 = participation)
+    # — shrinking the mesh collective from C client updates to G group
+    # partials. Mean composes EXACTLY (partial weighted sums + psum is
+    # already the deployed fast path); the coordinate-wise order
+    # statistics compose as median-of-medians / trim-of-trims — the
+    # standard hierarchical robust construction, deliberately NOT
+    # numerically identical to the flat statistic (Byzantine tolerance
+    # now holds per group). Krum (pairwise client distances) and the
+    # geometric median (joint Weiszfeld fixpoint) do NOT decompose; they
+    # keep the exact full client-stacked ``all_gather`` path, and the
+    # round builders refuse ``group_reduce`` for them loudly.
+    fn.group_composable = group_composable
     return fn
 
 
@@ -80,7 +97,7 @@ def mean():
     def agg(stacked, weights):
         return tree_weighted_mean(stacked, weights)
 
-    return _mark(agg, "mean", is_mean=True)
+    return _mark(agg, "mean", is_mean=True, group_composable=True)
 
 
 def coord_median():
@@ -105,7 +122,7 @@ def coord_median():
 
         return jax.tree.map(med, stacked)
 
-    return _mark(agg, "coord_median")
+    return _mark(agg, "coord_median", group_composable=True)
 
 
 def trimmed_mean(beta: float = 0.1):
@@ -136,7 +153,7 @@ def trimmed_mean(beta: float = 0.1):
 
         return jax.tree.map(tm, stacked)
 
-    return _mark(agg, f"trimmed_mean{beta}")
+    return _mark(agg, f"trimmed_mean{beta}", group_composable=True)
 
 
 def multi_krum(f: int = 1, m: int = 1):

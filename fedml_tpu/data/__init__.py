@@ -10,8 +10,16 @@ from fedml_tpu.data.batching import (
     build_federated_arrays,
     gather_clients,
 )
+from fedml_tpu.data.directory import (
+    ClientDirectory,
+    ShardedFederatedStore,
+    StoreShard,
+)
 
 __all__ = [
+    "ClientDirectory",
+    "ShardedFederatedStore",
+    "StoreShard",
     "partition_dirichlet",
     "partition_homo",
     "partition_power_law",

@@ -29,6 +29,12 @@ sampled cohort per round:
     execution tier (``FedAvgAPI.train_rounds_windowed``), with
     ``WindowPrefetcher`` double-buffering the next window's gather + H2D
     against the current window's scan.
+
+Past the flat store's own wall (host RSS is O(dataset)), the
+million-client tier shards this layout behind the SAME contract:
+``data/directory.py``'s ``ShardedFederatedStore`` overrides only the
+``_fill_rows`` storage primitive (per-shard, memmap-backed gathers,
+bit-equal — see docs/EXECUTION.md "Scale tiers").
 """
 
 from __future__ import annotations
@@ -49,6 +55,22 @@ def _bucket_steps(steps: int) -> int:
     shapes (→ jit retraces) at log2(max_steps)."""
     steps = max(int(steps), 1)
     return 1 << (steps - 1).bit_length()
+
+
+def bucket_steps_for_counts(counts, batch_size: int) -> np.ndarray:
+    """Vectorized :func:`_bucket_steps` of every client's step need —
+    the ONE other place the bucket policy is computed (bench warmup must
+    warm exactly the shapes the store will produce; a drifted copy would
+    let jit recompiles land inside timed windows). Exact bit-twiddle
+    round-up, no float log2; pinned equal to the scalar form in
+    tests/test_store.py."""
+    steps = np.maximum(
+        -(-np.asarray(counts, np.int64) // int(batch_size)),
+        1).astype(np.uint64)
+    v = steps - 1
+    for shift in (1, 2, 4, 8, 16, 32):
+        v |= v >> np.uint64(shift)
+    return (v + 1).astype(np.int64)
 
 
 class FederatedStore:
@@ -79,11 +101,25 @@ class FederatedStore:
             np.zeros((0,), np.int64)
         self._x = np.ascontiguousarray(x[order])
         self._y = np.ascontiguousarray(y[order])
+        self._init_meta(counts, batch_size, max_steps,
+                        x.shape[1:], x.dtype, y.shape[1:], y.dtype)
+
+    def _init_meta(self, counts, batch_size, max_steps,
+                   sample_shape, sample_dtype, label_shape, label_dtype):
+        """Shared metadata/staging init — everything about the store that
+        is NOT the backing sample storage. ``ShardedFederatedStore``
+        (data/directory.py) reuses the whole gather contract through this
+        plus the :meth:`_fill_rows` storage primitive."""
+        counts = np.asarray(counts, np.int64)
         self.offsets = np.concatenate([[0], np.cumsum(counts)])
         self.counts = counts.astype(np.int32)
         self.batch_size = int(batch_size)
         self.max_steps = max_steps
-        self.num_clients = n_clients
+        self.num_clients = len(counts)
+        self._sample_shape = tuple(sample_shape)
+        self._sample_dtype = np.dtype(sample_dtype)
+        self._label_shape = tuple(label_shape)
+        self._label_dtype = np.dtype(label_dtype)
         # Reused host staging buffers for window superbatches (one buffer
         # per (field, shape) — windows of the same span length and bucket
         # refill the same memory instead of re-faulting fresh pages every
@@ -96,7 +132,8 @@ class FederatedStore:
     def example_input(self) -> np.ndarray:
         """One zero batch with the store's sample shape/dtype — what model
         init needs (mirrors ``train_fed.x[0, 0]`` on the resident path)."""
-        return np.zeros((self.batch_size,) + self._x.shape[1:], self._x.dtype)
+        return np.zeros((self.batch_size,) + self._sample_shape,
+                        self._sample_dtype)
 
     def nbytes(self) -> int:
         return self._x.nbytes + self._y.nbytes
@@ -140,6 +177,23 @@ class FederatedStore:
             rows = np.where(empty[..., None], 0, rows)
         return rows, empty
 
+    def _fill_rows(self, idx: np.ndarray, cap: int,
+                   xs: np.ndarray, ys: np.ndarray) -> np.ndarray:
+        """The STORAGE PRIMITIVE behind both gathers: fill the
+        preallocated ``xs [*idx.shape, cap, ...]`` / ``ys`` with each
+        cohort slot's rows (positions past a client's count repeat its
+        first row — the masked own-first-sample pad rule) and return the
+        ``[*idx.shape]`` bool mask of EMPTY (zero-count) slots, whose
+        rows the caller zeroes (this method may leave them unwritten).
+        The flat store gathers from its one CSR array pair;
+        ``ShardedFederatedStore`` overrides this with per-shard gathers —
+        everything above (bucketing, masks, staging, H2D, put contracts)
+        is storage-agnostic and shared."""
+        rows, empty = self._rowmap(idx, cap)
+        np.take(self._x, rows, axis=0, out=xs)
+        np.take(self._y, rows, axis=0, out=ys)
+        return empty
+
     def gather_cohort(self, indices,
                       steps: Optional[int] = None) -> FederatedArrays:
         """Materialize the sampled clients as a device-resident
@@ -162,9 +216,9 @@ class FederatedStore:
         steps = self._resolve_steps(ccounts, steps)
         cap = steps * self.batch_size
 
-        rows, empty = self._rowmap(idx, cap)
-        xs = self._x[rows]
-        ys = self._y[rows]
+        xs = np.empty((k, cap) + self._sample_shape, self._sample_dtype)
+        ys = np.empty((k, cap) + self._label_shape, self._label_dtype)
+        empty = self._fill_rows(idx, cap, xs, ys)
         mask = (np.arange(cap) < ccounts[:, None]).astype(np.float32)
         if empty.any():
             xs[empty] = 0
@@ -300,14 +354,12 @@ class FederatedStore:
         else:
             put_copies = bool(getattr(put, "copies", False))
 
-        rows, empty = self._rowmap(idx, cap)
         with self._staging_lock:
-            xs = self._staged("x", (w, k, cap) + self._x.shape[1:],
-                              self._x.dtype)
-            ys = self._staged("y", (w, k, cap) + self._y.shape[1:],
-                              self._y.dtype)
-            np.take(self._x, rows, axis=0, out=xs)
-            np.take(self._y, rows, axis=0, out=ys)
+            xs = self._staged("x", (w, k, cap) + self._sample_shape,
+                              self._sample_dtype)
+            ys = self._staged("y", (w, k, cap) + self._label_shape,
+                              self._label_dtype)
+            empty = self._fill_rows(idx, cap, xs, ys)
             if empty.any():
                 xs[empty] = 0
                 ys[empty] = 0

@@ -106,6 +106,28 @@ def synthetic_alpha_beta(
     return np.concatenate(xs), np.concatenate(ys), idx_map
 
 
+def make_stackoverflow_shard(
+    n_clients: int,
+    seq_len: int = 20,
+    vocab: int = 10004,
+    seed: int = 0,
+):
+    """ONE shard's worth of the StackOverflow-NWP law — ``(x, y,
+    counts)`` with pareto per-client sentence counts and next-token
+    targets over [1, vocab). The single source of the count/token
+    distribution: :func:`make_stackoverflow_nwp` builds the flat
+    federation from it, and ``bench.py``'s million-client
+    ``synthetic_1m`` section feeds it per shard to
+    ``ShardedFederatedStore.from_shard_builder`` — the 342k and 1M
+    scale points can never drift apart in law."""
+    rng = np.random.RandomState(seed)
+    counts = 1 + (rng.pareto(1.5, n_clients) * 4).astype(np.int64).clip(0, 63)
+    tot = int(counts.sum())
+    x = rng.randint(1, vocab, (tot, seq_len)).astype(np.int32)
+    y = np.roll(x, -1, axis=1)
+    return x, y, counts
+
+
 def make_stackoverflow_nwp(
     n_clients: int,
     seq_len: int = 20,
@@ -119,11 +141,7 @@ def make_stackoverflow_nwp(
     collides. Returns ``(x, y, client_indices)`` for FederatedStore /
     build_federated_arrays. Shared by the full-scale store test and the
     bench submetric so the two can never drift."""
-    rng = np.random.RandomState(seed)
-    counts = 1 + (rng.pareto(1.5, n_clients) * 4).astype(np.int64).clip(0, 63)
-    tot = int(counts.sum())
-    x = rng.randint(1, vocab, (tot, seq_len)).astype(np.int32)
-    y = np.roll(x, -1, axis=1)
+    x, y, counts = make_stackoverflow_shard(n_clients, seq_len, vocab, seed)
     edges = np.concatenate([[0], np.cumsum(counts)])
     parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(n_clients)}
     return x, y, parts

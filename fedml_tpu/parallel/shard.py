@@ -186,7 +186,7 @@ def client_rngs(rng, n_local, offset):
 def make_sharded_round(local_train, mesh, axis: str = "clients",
                        client_transform=None, nan_guard: bool = False,
                        with_client_losses: bool = False, aggregator=None,
-                       corruptor=None):
+                       corruptor=None, group_reduce: bool = False):
     """Sharded round: client axis split over ``mesh[axis]``; output replicated.
 
     Weighted average = psum of per-shard weighted partial sums / psum of
@@ -203,10 +203,38 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
     sees bit-identical inputs on one chip and on a mesh. ``None`` / mean
     keeps the partial-sum ``psum`` fast path untouched (bit-equal).
 
+    ``group_reduce`` — the HIERARCHICAL SPARSE REDUCTION (group-level
+    partial aggregation + sparse global step, the arXiv:1903.05133
+    shape) for ``group_composable`` aggregators, with each mesh shard a
+    group: stage 1 runs the aggregator SHARD-LOCALLY over the shard's
+    own clients (no communication); stage 2 ``all_gather``s only the G
+    group partials + participation weights and applies the same
+    aggregator across groups (a group whose clients were all excluded
+    carries weight 0 and drops out — the "sparse" in sparse global
+    reduction; the collective shrinks from C client models to G ≪ C
+    group partials). Mean is already this reduction EXACTLY (per-shard
+    partial sums + ``psum``) and keeps its bit-equal fast path; the
+    coordinate-wise statistics compose as median-of-medians /
+    trim-of-trims — the hierarchical robust construction, semantically
+    distinct from the flat statistic by design. Non-composable
+    aggregators (krum, geometric_median) refuse ``group_reduce``
+    LOUDLY here: their exact semantics need the full client-stacked
+    ``all_gather`` fallback (``group_reduce=False``).
+
     ``corruptor`` as in :func:`make_vmap_round`: the round grows a
     trailing client-sharded ``adv`` operand."""
     if _is_mean(aggregator):
         aggregator = None
+    if group_reduce and aggregator is not None \
+            and not getattr(aggregator, "group_composable", False):
+        raise ValueError(
+            f"aggregator {getattr(aggregator, 'name', aggregator)!r} does "
+            "not compose group-wise (krum needs pairwise client "
+            "distances, geometric_median a joint Weiszfeld fixpoint); "
+            "use group_reduce=False to keep the exact full client-stack "
+            "all_gather path, or a composable aggregator "
+            "(mean/coord_median/trimmed_mean) for the hierarchical "
+            "sparse reduction")
 
     def body(params, x, y, mask, weights, loss_weights, rng, adv):
         # Same global-slot-keyed streams as the vmap path.
@@ -231,6 +259,20 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
                 # All-diverged round: keep the previous global model.
                 avg = jax.tree.map(
                     lambda a, p: jnp.where(total > 0, a, p), avg, params)
+        elif group_reduce:
+            # Hierarchical sparse reduction: shard-local robust partial
+            # (stage 1, zero communication), then a G-sized gather of
+            # group partials + participation mass for the cross-group
+            # statistic (stage 2). An all-excluded shard's partial may
+            # carry the aggregator's ±inf exclusion sentinels — its zero
+            # participation weight gates it out of stage 2, exactly the
+            # client-level weight semantics lifted one level up.
+            part = aggregator(client_params, w)
+            pw = jnp.sum(jnp.maximum(w, 0.0))
+            parts = jax.tree.map(
+                lambda p: jax.lax.all_gather(p, axis), part)  # [G, ...]
+            pws = jax.lax.all_gather(pw, axis)  # [G]
+            avg = _robust_avg(aggregator, parts, pws, params)
         else:
             full = jax.tree.map(
                 lambda p: jax.lax.all_gather(p, axis, axis=0, tiled=True),

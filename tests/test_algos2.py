@@ -166,6 +166,72 @@ def test_hierarchical_group_invariance_fullbatch():
     _params_equal(a.net.params, b.net.params, atol=5e-3)
 
 
+def test_hierarchical_streams_from_store():
+    """Satellite of the million-client tier: hierarchical rounds now
+    gather per-group cohorts through ``FederatedStore.gather_cohort``
+    (flat AND sharded) — equal-count clients make the streamed cohort
+    identical to the resident gather, so whole runs must match the
+    resident path, and the flat/sharded streaming twins must match each
+    other bitwise."""
+    import pytest
+
+    from fedml_tpu.data.directory import ShardedFederatedStore
+    from fedml_tpu.data.store import FederatedStore
+
+    n, n_clients, per = 512, 8, 64
+    rng = np.random.RandomState(0)
+    w = rng.randn(10)
+    x = rng.randn(n, 10).astype(np.float32)
+    y = (x @ w > 0).astype(np.int32) + 2 * (x[:, 0] > 0).astype(np.int32)
+    parts = {c: np.arange(c * per, (c + 1) * per) for c in range(n_clients)}
+    gids = np.array([0, 0, 0, 1, 1, 2, 2, 2])
+    cfg = lambda: FedConfig(**{**CFG, "client_num_per_round": 8,
+                               "batch_size": 16}, group_comm_round=2)
+
+    def mk(fed):
+        return HierarchicalFedAvgAPI(LogisticRegression(num_classes=4),
+                                     fed, None, cfg(), group_ids=gids)
+
+    resident = mk(build_federated_arrays(x, y, parts, batch_size=16))
+    flat = mk(FederatedStore(x, y, parts, batch_size=16))
+    sharded = mk(ShardedFederatedStore.from_flat(x, y, parts, 16,
+                                                 shard_of=gids))
+    for r in range(3):
+        lr_ = resident.train_one_round(r)["train_loss"]
+        lf = flat.train_one_round(r)["train_loss"]
+        ls = sharded.train_one_round(r)["train_loss"]
+        assert np.isclose(lr_, lf, rtol=1e-6)
+        assert lf == ls, (r, lf, ls)  # both streamed: bitwise twins
+    _params_equal(resident.net.params, flat.net.params)
+    for a, b in zip(jax.tree.leaves(flat.net.params),
+                    jax.tree.leaves(sharded.net.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hierarchical_composable_robust_across_groups():
+    """The two-stage robust path: a composable aggregator rides the
+    group rounds (within-group statistics baked into round_fn) AND the
+    global step (across group partials); non-composable aggregators are
+    refused loudly at construction."""
+    import pytest
+
+    fed, test, _ = _setup(homo=True)
+    cfg = FedConfig(**CFG, aggregator="coord_median")
+    api = HierarchicalFedAvgAPI(LogisticRegression(num_classes=4), fed,
+                                test, cfg,
+                                group_ids=np.array([0, 0, 0, 0, 1, 1, 1, 1]))
+    for r in range(3):
+        assert np.isfinite(api.train_one_round(r)["train_loss"])
+    with pytest.raises(NotImplementedError, match="compose group-wise"):
+        HierarchicalFedAvgAPI(LogisticRegression(num_classes=4), fed,
+                              test, FedConfig(**CFG, aggregator="krum"),
+                              group_ids=np.zeros(8, int))
+    with pytest.raises(NotImplementedError, match="group_reduce"):
+        HierarchicalFedAvgAPI(LogisticRegression(num_classes=4), fed,
+                              test, FedConfig(**CFG, group_reduce=True),
+                              group_ids=np.zeros(8, int))
+
+
 # ---------------- decentralized ----------------
 
 def test_dsgd_converges_to_consensus():

@@ -80,7 +80,8 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     for name in ("bench_femnist_cnn_3400", "bench_store_windowed",
                  "bench_store_windowed_fedopt", "bench_robust_agg",
                  "bench_chaos", "bench_fleet_sim",
-                 "bench_stackoverflow_342k", "bench_vit",
+                 "bench_stackoverflow_342k", "bench_synthetic_1m",
+                 "bench_vit",
                  "bench_resnet56_b128", "bench_resnet56_s2d",
                  "bench_sharded_path", "bench_flash_attention_sweep",
                  "bench_transformer_fed_mfu"):
@@ -105,7 +106,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     # Every section that RAN finished inside the budget: elapsed at its
     # start + the full section cap fit under 300s.
     assert len(ran) * 50 + 100 <= 300
-    assert len(ran) + len(skipped) == 13
+    assert len(ran) + len(skipped) == 14
 
 
 def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
@@ -117,7 +118,8 @@ def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
     for name in ("bench_femnist_cnn_3400", "bench_store_windowed",
                  "bench_store_windowed_fedopt", "bench_robust_agg",
                  "bench_chaos", "bench_fleet_sim",
-                 "bench_stackoverflow_342k", "bench_vit",
+                 "bench_stackoverflow_342k", "bench_synthetic_1m",
+                 "bench_vit",
                  "bench_resnet56_b128", "bench_resnet56_s2d",
                  "bench_sharded_path", "bench_flash_attention_sweep",
                  "bench_transformer_fed_mfu"):
@@ -133,6 +135,29 @@ def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
     assert headline["vs_baseline"] is None
     blob = json.loads((tmp_path / "blob.json").read_text())
     assert "timeout" in blob  # the hole is recorded, not silent
+
+
+@pytest.mark.slow  # LSTM rounds on the 2-core CPU box (~1-2 min)
+def test_bench_synthetic_1m_machinery_toy_scale():
+    """The million-client section's machinery (shard builder → memmap
+    spill → directory → warm → timed windows → overlap probe → scale
+    ratios) end-to-end at toy scale; the real section runs the 2^20
+    defaults."""
+    bench._scale_state["342k"] = {"rps": 5.0, "rss_peak_mb": 500.0}
+    try:
+        out = bench.bench_synthetic_1m(
+            C=2048, G=4, cpr=10,
+            model_kw=dict(embedding_dim=8, hidden_size=16),
+            min_window_s=1.0)
+    finally:
+        bench._scale_state.clear()
+    assert out["clients"] == 2048 and out["shards"] == 4
+    assert out["memmap_spill"] and out["rounds_per_sec"] > 0
+    assert out["samples_per_sec"] > 0
+    assert out["peak_rss_ratio"] is not None
+    assert out["rps_vs_342k"] is not None
+    assert out["prefetch_overlap_ratio"] >= 0
+    assert out["directory_mb"] < 1.0  # O(clients) ints, not samples
 
 
 def test_headline_tolerates_budget_skipped_submetrics():

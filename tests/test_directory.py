@@ -1,0 +1,387 @@
+"""Million-client tier: sharded client directory + hierarchical sparse
+reduction. Pins (1) the sharded store BIT-EQUAL to the flat store on
+every gather contract (power-law partitions, empty clients, duplicates,
+non-dividing shard counts, forced buckets, window superbatches, memmap
+spill), (2) directory sampling INVARIANT under re-sharding (same seed →
+same cohort for any G), (3) the group-wise sparse reduction bit-equal to
+the flat path for mean (single-chip and mesh) and matching a numpy
+two-stage reference for the composable robust path, with krum/geometric
+median refused loudly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.core import robust_agg
+from fedml_tpu.data.batching import build_federated_arrays
+from fedml_tpu.data.directory import ClientDirectory, ShardedFederatedStore
+from fedml_tpu.data.store import (
+    CohortPrefetcher,
+    FederatedStore,
+    WindowPrefetcher,
+)
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.parallel.mesh import client_mesh
+from fedml_tpu.parallel.shard import make_sharded_round
+
+
+def _power_law(seed=0, d=4, counts=(130, 17, 0, 30, 12, 25, 8, 21, 3, 0,
+                                    40, 5, 64)):
+    rng = np.random.RandomState(seed)
+    tot = sum(counts)
+    x = rng.randn(tot, d).astype(np.float32)
+    y = (rng.rand(tot) > 0.5).astype(np.int32)
+    edges = np.cumsum([0] + list(counts))
+    parts = {c: np.arange(edges[c], edges[c + 1])
+             for c in range(len(counts))}
+    return x, y, parts
+
+
+def _equal_counts(n_clients=8, per=64, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d)
+    x = rng.randn(n_clients * per, d).astype(np.float32)
+    y = (x @ w > 0).astype(np.int32)
+    parts = {c: np.arange(c * per, (c + 1) * per) for c in range(n_clients)}
+    return x, y, parts
+
+
+def _cfg(n, cpr, rounds=3, batch=16, **kw):
+    kw.setdefault("lr", 0.3)
+    return FedConfig(client_num_in_total=n, client_num_per_round=cpr,
+                     comm_round=rounds, epochs=1, batch_size=batch,
+                     frequency_of_the_test=1000, **kw)
+
+
+def _assert_tree_equal(a, b):
+    for lhs, rhs in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(lhs), np.asarray(rhs))
+
+
+# ---------------- sharded store == flat store, bitwise ----------------
+
+COHORTS = (
+    (np.array([1, 3, 5]), None),
+    (np.array([0, 2, 4]), None),   # giant + empty client
+    (np.array([7, 7, 1]), None),   # duplicates
+    (np.array([2]), None),         # only the empty one
+    (np.array([9, 12, 2, 0]), None),
+    (np.array([1, 3]), 8),         # forced larger bucket
+)
+
+
+@pytest.mark.parametrize("num_shards", [1, 3, 5, 13])
+def test_sharded_gather_cohort_bit_equal_flat(num_shards):
+    """Non-dividing shard counts included (13 clients over 3/5 shards)."""
+    x, y, parts = _power_law()
+    flat = FederatedStore(x, y, parts, batch_size=32)
+    sh = ShardedFederatedStore.from_flat(x, y, parts, 32,
+                                         num_shards=num_shards)
+    for idx, steps in COHORTS:
+        _assert_tree_equal(flat.gather_cohort(idx, steps=steps),
+                           sh.gather_cohort(idx, steps=steps))
+
+
+def test_sharded_gather_respects_group_shard_map():
+    """Arbitrary (non-contiguous, per-group) client→shard assignment."""
+    x, y, parts = _power_law()
+    flat = FederatedStore(x, y, parts, batch_size=32)
+    sh = ShardedFederatedStore.from_flat(
+        x, y, parts, 32, shard_of=np.arange(13) % 4)
+    for idx, steps in COHORTS:
+        _assert_tree_equal(flat.gather_cohort(idx, steps=steps),
+                           sh.gather_cohort(idx, steps=steps))
+
+
+def test_sharded_gather_window_bit_equal_flat():
+    x, y, parts = _power_law()
+    flat = FederatedStore(x, y, parts, batch_size=32)
+    sh = ShardedFederatedStore.from_flat(x, y, parts, 32, num_shards=4)
+    widx = np.array([[1, 3, 5], [0, 2, 4], [9, 10, 12], [7, 7, 2]])
+    steps = flat.cohort_steps(widx.reshape(-1))
+    _assert_tree_equal(flat.gather_window(widx, steps),
+                       sh.gather_window(widx, steps))
+    # Second window through the REUSED staging buffers (an unwritten
+    # stale slot would leak the previous window's bytes).
+    widx2 = np.array([[2, 9, 1], [3, 3, 0], [12, 2, 5], [4, 6, 7]])
+    _assert_tree_equal(flat.gather_window(widx2, steps),
+                       sh.gather_window(widx2, steps))
+
+
+def test_sharded_memmap_spill_bit_equal(tmp_path):
+    x, y, parts = _power_law()
+    flat = FederatedStore(x, y, parts, batch_size=32)
+    sh = ShardedFederatedStore.from_flat(x, y, parts, 32, num_shards=4,
+                                         spill_dir=str(tmp_path))
+    assert sh.memmapped
+    for idx, steps in COHORTS:
+        _assert_tree_equal(flat.gather_cohort(idx, steps=steps),
+                           sh.gather_cohort(idx, steps=steps))
+    assert sh.nbytes() == flat.nbytes()  # dataset bytes, not resident
+
+
+def test_sharded_prefetchers_serve_same_bits():
+    x, y, parts = _power_law()
+    sh = ShardedFederatedStore.from_flat(x, y, parts, 32, num_shards=3)
+    idx = np.array([2, 7, 4])
+    pf = CohortPrefetcher(sh)
+    pf.prefetch(3, idx)
+    _assert_tree_equal(pf.get(3, idx), sh.gather_cohort(idx))
+    widx = np.array([[1, 3], [5, 7]])
+    steps = sh.cohort_steps(widx.reshape(-1))
+    wf = WindowPrefetcher(sh)
+    wf.prefetch(0, widx, steps)
+    _assert_tree_equal(wf.get(0, widx, steps),
+                       sh.gather_window(widx, steps))
+
+
+def test_max_steps_truncation_matches_flat():
+    x, y, parts = _equal_counts(per=100)
+    flat = FederatedStore(x, y, parts, batch_size=16, max_steps=2)
+    sh = ShardedFederatedStore.from_flat(x, y, parts, 16, num_shards=3,
+                                         max_steps=2)
+    assert int(sh.counts.max()) == 32
+    _assert_tree_equal(flat.gather_cohort(np.array([0, 5])),
+                       sh.gather_cohort(np.array([0, 5])))
+
+
+# ---------------- directory: the sampling service ----------------
+
+def test_directory_sampling_invariant_under_resharding():
+    """Same seed → same cohort REGARDLESS of G (the directory draws from
+    counts alone, never sample arrays), and equal to the flat reference
+    stream (core/sampling)."""
+    from fedml_tpu.core.sampling import sample_clients
+
+    counts = np.array([5, 0, 9, 3, 7, 1, 4, 8, 2, 6, 11, 1, 3])
+    dirs = [ClientDirectory(counts, (np.arange(13) * g) // 13, g)
+            for g in (1, 2, 7)]
+    dirs.append(ClientDirectory(counts, np.arange(13) % 5, 5))  # grouped
+    for r in (0, 3, 11):
+        ref = sample_clients(r, 13, 6)
+        for d in dirs:
+            np.testing.assert_array_equal(d.sample_cohort(r, 6), ref)
+    # Weighted draw: same invariance (counts are global metadata).
+    for r in (1, 4):
+        ref = dirs[0].sample_cohort_weighted(r, 6)
+        for d in dirs[1:]:
+            np.testing.assert_array_equal(d.sample_cohort_weighted(r, 6),
+                                          ref)
+
+
+def test_directory_metadata_tallies():
+    counts = np.array([5, 0, 9, 3])
+    d = ClientDirectory(counts, np.array([1, 0, 1, 0]), 2)
+    np.testing.assert_array_equal(d.shard_clients, [2, 2])
+    np.testing.assert_array_equal(d.shard_rows, [3, 14])
+    # local rows: shard 1 holds clients 0 (rows 0..4) then 2 (rows 5..13)
+    np.testing.assert_array_equal(d.local_row_start, [0, 0, 5, 0])
+    np.testing.assert_array_equal(d.shard_histogram([0, 2, 2, 3]),
+                                  [1, 3])
+    assert d.nbytes() > 0
+
+
+# ---------------- sharded store through the training tiers -------------
+
+def test_sharded_store_rounds_bit_equal_flat_store():
+    """Whole FedAvg rounds: sharded-store streaming must be BIT-equal to
+    flat-store streaming (identical gathers → identical dispatches)."""
+    x, y, parts = _equal_counts()
+    a = FedAvgAPI(LogisticRegression(num_classes=2),
+                  FederatedStore(x, y, parts, batch_size=16), None,
+                  _cfg(8, 4))
+    b = FedAvgAPI(LogisticRegression(num_classes=2),
+                  ShardedFederatedStore.from_flat(x, y, parts, 16,
+                                                  num_shards=3),
+                  None, _cfg(8, 4))
+    for r in range(3):
+        la = a.train_one_round(r)["train_loss"]
+        lb = b.train_one_round(r)["train_loss"]
+        assert la == lb, (r, la, lb)
+    _assert_tree_equal(a.net.params, b.net.params)
+
+
+def test_sharded_store_windowed_tier_bit_equal():
+    """train_rounds_windowed over the sharded store == over the flat
+    store (the window superbatch gathers are bit-equal, so the scans
+    are)."""
+    x, y, parts = _equal_counts()
+
+    def mk(store):
+        return FedAvgAPI(LogisticRegression(num_classes=2), store, None,
+                         _cfg(8, 4, rounds=8))
+
+    a = mk(FederatedStore(x, y, parts, batch_size=16))
+    b = mk(ShardedFederatedStore.from_flat(x, y, parts, 16, num_shards=3))
+    la = a.train_rounds_windowed(8, window=4)
+    lb = b.train_rounds_windowed(8, window=4)
+    np.testing.assert_allclose(la, lb, rtol=0, atol=0)
+    _assert_tree_equal(a.net.params, b.net.params)
+
+
+def test_from_shard_builder_smoke():
+    """The million-client construction path at toy scale: per-shard
+    generate → memmap spill → drop; directory integrity; gathers equal a
+    from_flat twin; training runs. (ci.sh runs the same shape as its
+    sharded-store smoke.)"""
+    import tempfile
+
+    G, per_shard, d = 4, 16, 5
+
+    def builder(s):
+        rng = np.random.RandomState(100 + s)
+        counts = 1 + rng.randint(0, 6, per_shard).astype(np.int64)
+        tot = int(counts.sum())
+        return (rng.randn(tot, d).astype(np.float32),
+                (rng.rand(tot) > 0.5).astype(np.int32), counts)
+
+    with tempfile.TemporaryDirectory() as td:
+        sh = ShardedFederatedStore.from_shard_builder(
+            builder, G, batch_size=8, spill_dir=td)
+        assert sh.num_clients == G * per_shard and sh.memmapped
+        # Twin via from_flat over the concatenated data.
+        xs, ys, counts = [], [], []
+        for s in range(G):
+            sx, sy, sc = builder(s)
+            xs.append(sx)
+            ys.append(sy)
+            counts.append(sc)
+        x, y = np.concatenate(xs), np.concatenate(ys)
+        edges = np.concatenate([[0], np.cumsum(np.concatenate(counts))])
+        parts = {c: np.arange(edges[c], edges[c + 1])
+                 for c in range(G * per_shard)}
+        flat = FederatedStore(x, y, parts, batch_size=8)
+        idx = np.array([0, 17, 33, 63, 5])
+        _assert_tree_equal(flat.gather_cohort(idx), sh.gather_cohort(idx))
+        api = FedAvgAPI(LogisticRegression(num_classes=2), sh, None,
+                        _cfg(G * per_shard, 6, batch=8))
+        for r in range(2):
+            assert np.isfinite(api.train_one_round(r)["train_loss"])
+
+
+# ---------------- hierarchical sparse reduction (mesh) -----------------
+
+def _mesh_round_inputs(c, d, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(c, 1, 2, d).astype(np.float32)  # [C, S, B, d]
+    y = np.zeros((c, 1, 2), np.int32)
+    mask = np.ones((c, 1, 2), np.float32)
+    return jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask)
+
+
+def _delta_train(net, x, y, mask, rng):
+    """Deterministic 'training': client's model = global + its first
+    sample, so the aggregation inputs are known exactly."""
+    return jax.tree.map(lambda w: w + x[0, 0], net), jnp.float32(0.0)
+
+
+def test_group_reduce_mean_bit_equal_flat_mesh_and_single_chip():
+    """Mean through group_reduce IS the partial-sum psum fast path —
+    bit-equal on a 1-device mesh (single chip) and an 8-device mesh."""
+    c, d = 8, 5
+    x, y, mask = _mesh_round_inputs(c, d)
+    w = jnp.ones((c,), jnp.float32) * jnp.asarray(
+        [1, 2, 1, 3, 1, 1, 2, 1], jnp.float32)
+    net = {"w": jnp.zeros((d,), jnp.float32)}
+    key = jax.random.PRNGKey(0)
+    for n_dev in (1, 8):
+        mesh = client_mesh(n_dev)
+        flat_fn = jax.jit(make_sharded_round(_delta_train, mesh))
+        grp_fn = jax.jit(make_sharded_round(
+            _delta_train, mesh, aggregator=robust_agg.mean(),
+            group_reduce=True))
+        a, _ = flat_fn(net, x, y, mask, w, w, key)
+        b, _ = grp_fn(net, x, y, mask, w, w, key)
+        _assert_tree_equal(a, b)
+
+
+def test_group_reduce_coord_median_matches_two_stage_reference():
+    """The composable robust path against a numpy replica of the exact
+    two-stage statistic: within-shard coord_median over the shard's
+    clients, then coord_median across the surviving group partials —
+    including an ALL-EXCLUDED shard (weight 0) that must drop out of the
+    global step."""
+    c, d, n_dev = 8, 5, 4
+    x, y, mask = _mesh_round_inputs(c, d, seed=3)
+    w = jnp.asarray([1, 1, 0, 0, 2, 1, 1, 3], jnp.float32)  # shard 1 out
+    net = {"w": jnp.zeros((d,), jnp.float32)}
+    mesh = client_mesh(n_dev)
+    fn = jax.jit(make_sharded_round(
+        _delta_train, mesh, aggregator=robust_agg.coord_median(),
+        group_reduce=True))
+    avg, _ = fn(net, x, y, mask, w, w, jax.random.PRNGKey(0))
+
+    def np_median(v, valid):  # the aggregator's masked-sort math
+        m = int(valid.sum())
+        vv = np.where(valid[:, None], v, np.inf).astype(np.float32)
+        s = np.sort(vv, axis=0)
+        return ((s[max((m - 1) // 2, 0)] + s[max(m // 2, 0)])
+                * np.float32(0.5))
+
+    cw = np.asarray(w)
+    cx = np.asarray(x)[:, 0, 0]  # client updates (net starts at zero)
+    parts, pws = [], []
+    for g in range(n_dev):
+        sl = slice(g * 2, g * 2 + 2)
+        parts.append(np_median(cx[sl], cw[sl] > 0))
+        pws.append(np.maximum(cw[sl], 0).sum())
+    ref = np_median(np.stack(parts), np.asarray(pws) > 0)
+    np.testing.assert_allclose(np.asarray(avg["w"]), ref, rtol=1e-6)
+
+
+def test_group_reduce_trimmed_mean_runs_and_differs_from_flat():
+    """trim-of-trims is a DIFFERENT statistic from the flat trim (by
+    design); both run, both finite, and at this size they disagree —
+    pinning that the group path is actually taken."""
+    c, d = 8, 5
+    x, y, mask = _mesh_round_inputs(c, d, seed=5)
+    w = jnp.ones((c,), jnp.float32)
+    net = {"w": jnp.zeros((d,), jnp.float32)}
+    mesh = client_mesh(4)
+    mk = lambda gr: jax.jit(make_sharded_round(
+        _delta_train, mesh, aggregator=robust_agg.trimmed_mean(0.25),
+        group_reduce=gr))
+    a, _ = mk(False)(net, x, y, mask, w, w, jax.random.PRNGKey(0))
+    b, _ = mk(True)(net, x, y, mask, w, w, jax.random.PRNGKey(0))
+    assert np.isfinite(np.asarray(a["w"])).all()
+    assert np.isfinite(np.asarray(b["w"])).all()
+    assert not np.allclose(np.asarray(a["w"]), np.asarray(b["w"]))
+
+
+def test_group_reduce_refuses_non_composable_loudly():
+    mesh = client_mesh(4)
+    for agg in (robust_agg.krum(1), robust_agg.geometric_median(4)):
+        with pytest.raises(ValueError, match="compose group-wise"):
+            make_sharded_round(_delta_train, mesh, aggregator=agg,
+                               group_reduce=True)
+
+
+def test_cfg_group_reduce_wiring_and_guards():
+    x, y, parts = _equal_counts(n_clients=16, per=32)
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+    mesh = client_mesh(8)
+    # mean + group_reduce == plain mean, end to end, bitwise.
+    a = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                  _cfg(16, 8), mesh=mesh)
+    b = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                  _cfg(16, 8, group_reduce=True), mesh=mesh)
+    for r in range(2):
+        a.train_one_round(r)
+        b.train_one_round(r)
+    _assert_tree_equal(a.net.params, b.net.params)
+    # Composable robust + group_reduce constructs and trains.
+    c = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                  _cfg(16, 8, group_reduce=True,
+                       aggregator="coord_median"), mesh=mesh)
+    assert np.isfinite(c.train_one_round(0)["train_loss"])
+    # Non-composable refuses loudly; no mesh refuses loudly.
+    with pytest.raises(NotImplementedError, match="compose group-wise"):
+        FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                  _cfg(16, 8, group_reduce=True, aggregator="krum"),
+                  mesh=mesh)
+    with pytest.raises(NotImplementedError, match="single device"):
+        FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                  _cfg(16, 8, group_reduce=True))

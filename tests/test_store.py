@@ -35,6 +35,20 @@ def test_bucket_steps_powers_of_two():
         [1, 1, 2, 4, 4, 8, 16, 64, 128]
 
 
+def test_bucket_steps_vectorized_equals_scalar():
+    """bucket_steps_for_counts (bench warmup's vectorized form) must
+    agree with the scalar policy for every count — a drifted copy would
+    warm the wrong shapes and let recompiles land in timed windows."""
+    from fedml_tpu.data.store import bucket_steps_for_counts
+
+    for batch in (1, 5, 16, 32):
+        counts = np.arange(0, 3000)
+        ref = np.array([_bucket_steps(int(np.ceil(max(int(c), 0) / batch)))
+                        if c else 1 for c in counts])
+        np.testing.assert_array_equal(
+            bucket_steps_for_counts(counts, batch), ref)
+
+
 def test_gather_cohort_matches_resident_gather():
     """With equal counts on a power-of-two step grid, the store's host
     gather must produce byte-identical arrays to the resident device
