@@ -111,6 +111,46 @@ with tempfile.TemporaryDirectory() as td:
 print("sharded directory smoke OK")
 PYEOF
 
+echo "== fused donated round step + lane-fill compute layout =="
+python - <<'PYEOF'
+import jax, numpy as np
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.data.batching import build_federated_arrays
+from fedml_tpu.data.partition import partition_homo
+from fedml_tpu.models.cnn import CNNOriginalFedAvg
+from fedml_tpu.obs.sanitizer import donation_audit, sanitized
+
+rng = np.random.RandomState(0)
+x = rng.rand(8 * 16, 28, 28, 1).astype(np.float32)
+y = rng.randint(0, 10, len(x)).astype(np.int32)
+fed = build_federated_arrays(x, y, partition_homo(len(x), 8), 8)
+cfg = FedConfig(client_num_in_total=8, client_num_per_round=4,
+                comm_round=100, epochs=1, batch_size=8, lr=0.05,
+                compute_layout="auto")
+# Deliberately misaligned conv widths: the layout policy pads them, and
+# the logical shapes must still be what everything above the step sees.
+api = FedAvgAPI(CNNOriginalFedAvg(num_classes=10, widths=(12, 20)),
+                fed, None, cfg)
+assert api._layout is not None and not api._layout.is_identity
+assert api._fused_round_step() is not None
+logical = [tuple(l.shape) for l in jax.tree.leaves(api.net)]
+api.train_one_round(0)  # compile once
+old = api.net
+with sanitized(transfer="allow") as rep:  # strict: zero recompiles
+    with donation_audit(api.net) as audit:
+        base = audit.sample()
+        for r in range(1, 3):
+            m = api.train_one_round(r)
+            assert np.isfinite(m["train_loss"])
+            audit.sample()
+assert all(l.is_deleted() for l in jax.tree.leaves(old))  # donated
+assert audit.peak <= base + 0.25, (audit.peak, base)
+assert [tuple(l.shape) for l in jax.tree.leaves(api.net)] == logical
+print("fused+padded smoke OK: zero recompiles, donated carry, "
+      f"logical shapes held ({api._layout.describe()})")
+PYEOF
+
 echo "== async FL (no-barrier staleness-weighted) =="
 python -m fedml_tpu.exp.main_extra --algorithm FedAsync \
     --model lr --dataset synthetic_1_1 $common

@@ -98,6 +98,16 @@ class FedConfig:
     # simulator speed; the cross-silo pipeline's --compress is the real
     # wire-level version with error feedback, fedavg_distributed.py).
     compress: str = "none"
+    # Lane-fill compute layout (parallel/layout.py, docs/EXECUTION.md
+    # "MFU playbook"): "none", or "auto" — the jitted client step runs a
+    # lane-aligned PHYSICAL twin of the model (channel dims padded up to
+    # MXU lane/sublane multiples; pad-on-entry / slice-on-exit around the
+    # local trainer) while everything above the client step — aggregation,
+    # robust aggregators, carry protocol, checkpoints, the wire — keeps
+    # the LOGICAL reference shapes. Exact (fp32-bit-exact for the CIFAR
+    # ResNet family, tested); supported model families only (refuses
+    # loudly otherwise). A no-op when the policy pads nothing.
+    compute_layout: str = "none"
     # Example-level DP-SGD on clients (new capability — the reference only
     # has server-side weak DP, robust_aggregation.py:49-53): per-example
     # gradient clipping at this L2 norm (0 disables) and Gaussian noise of

@@ -189,14 +189,55 @@ class FedAvgAPI(FederatedLoop):
                 "(per-round adversary masks); use FedAvgRobustAPI — on "
                 f"{type(self).__name__} the flag would be silently inert")
         self.n_shards = 1 if mesh is None else int(mesh.shape[mesh.axis_names[0]])
+        sample_x = (train_fed.example_input() if self._streaming
+                    else np.asarray(train_fed.x[0, 0]))
+        # Lane-fill compute layout (parallel/layout.py): the jitted
+        # client step trains a lane-PADDED physical twin; everything
+        # above the step — self.net, aggregation, checkpoints, the wire
+        # — keeps the logical shapes. Resolved before the round builders
+        # so _build_local_train can wrap the trainer.
+        self._layout = None
+        layout_cfg = getattr(cfg, "compute_layout", "none") or "none"
+        if layout_cfg != "none":
+            if layout_cfg != "auto":
+                raise ValueError(
+                    f"cfg.compute_layout={layout_cfg!r}: expected "
+                    "'none' or 'auto'")
+            if type(self)._build_local_train \
+                    is not FedAvgAPI._build_local_train:
+                raise NotImplementedError(
+                    f"{type(self).__name__} builds its own local trainer; "
+                    "cfg.compute_layout wraps the shared "
+                    "_build_local_train only (the flag would otherwise "
+                    "be silently inert)")
+            if getattr(cfg, "dp_noise_multiplier", 0.0) > 0:
+                # Same failure mode layout.py refuses dropout for: the
+                # DP Gaussian draw's shapes follow the PHYSICAL layout
+                # (per-parameter noise over padded leaves), so the
+                # logical block gets different noise than a layout-off
+                # run AND nonzero noise lands in the pad channels,
+                # breaking the pad-stays-zero exactness invariant.
+                # (dp_clip alone is exact: padded per-example grads are
+                # zero, so clip norms are unchanged.)
+                raise NotImplementedError(
+                    "cfg.compute_layout cannot compose with DP noise "
+                    "(dp_noise_multiplier > 0): the per-parameter noise "
+                    "draw shapes follow the physical layout, which "
+                    "breaks the padded-vs-logical exactness contract — "
+                    "run DP-SGD at the logical layout")
+            from fedml_tpu.parallel.layout import compute_layout
+
+            layout = compute_layout(model, sample_x)
+            if not layout.is_identity:
+                self._layout = layout
+                self._phys_fns = model_fns(layout.physical_model)
         self._client_lr = None
+        self._fused_step_fn = None
         self.set_client_lr(cfg.lr)
         self.eval_fn = jax.jit(make_eval_fn(self.fns.apply, loss_fn, pad_id=pad_id))
 
         rng = jax.random.PRNGKey(cfg.seed)
         self.rng, init_rng = jax.random.split(rng)
-        sample_x = (train_fed.example_input() if self._streaming
-                    else np.asarray(train_fed.x[0, 0]))
         self.net = self.fns.init(init_rng, sample_x)
 
         if cfg.client_selection == "oort":
@@ -226,6 +267,7 @@ class FedAvgAPI(FederatedLoop):
         self._client_lr = lr
         self._rounds_scan_fn = None  # round_fn changes → cached scan stale
         self._window_scan_fn = None  # windowed scan rides round_fn too
+        self._fused_step_fn = None  # fused round step rides round_fn too
         self._on_client_lr_change()  # subclasses drop their own cached jits
         cfg, mesh = self.cfg, self.mesh
         optimizer = make_client_optimizer(
@@ -317,6 +359,17 @@ class FedAvgAPI(FederatedLoop):
         return None
 
     def _build_local_train(self, optimizer, loss_fn):
+        if self._layout is not None:
+            # Lane-fill layout: the trainer runs the PHYSICAL twin's
+            # apply; the wrapper pads the incoming logical net and
+            # slices the logical block back out, so every caller of
+            # local_train (vmap round, sharded round, window scan) keeps
+            # the logical-shape contract untouched.
+            from fedml_tpu.parallel.layout import wrap_local_train
+
+            inner = make_local_train_fn_from_cfg(
+                self._phys_fns.apply, optimizer, self.cfg, loss_fn)
+            return wrap_local_train(inner, self._layout)
         return make_local_train_fn_from_cfg(self.fns.apply, optimizer,
                                             self.cfg, loss_fn)
 
@@ -643,6 +696,12 @@ class FedAvgAPI(FederatedLoop):
             "dp_noise_multiplier": self.cfg.dp_noise_multiplier,
             "compress": (self.cfg.compress
                          if self.cfg.compress != "none" else None),
+            # The corrected-SGD algorithms build their trainers outside
+            # _build_local_train, where the lane-fill layout is wired.
+            "compute_layout": (
+                getattr(self.cfg, "compute_layout", "none")
+                if getattr(self.cfg, "compute_layout", "none") != "none"
+                else None),
         }
         bad = [k for k, v in unsupported.items() if v]
         if self._nan_guard:
@@ -663,7 +722,90 @@ class FedAvgAPI(FederatedLoop):
 
         return gather_clients(self.train_fed, jnp.asarray(idx))
 
+    # --- fused round step (one donated dispatch per host-loop round) ---
+    def _fused_round_step(self):
+        """The cached donated FUSED round step — client training +
+        aggregation + the pure server update in ONE dispatch
+        (``parallel/shard.make_fused_round_step``, the windowed scan's
+        donation discipline at W=1) — or ``None`` when this algorithm/
+        config must keep the separate ``run_round`` + ``_server_update``
+        procedure (custom rounds, oort's three-output round, no pure
+        server update). Returns ``(pre, gather)``: ``pre`` takes
+        pre-gathered cohort operands; ``gather`` (resident single-device
+        only) traces the client gather inside the same dispatch."""
+        if self.window_protocol != "round":
+            return None
+        if (type(self).train_one_round is not FedAvgAPI.train_one_round
+                or type(self).run_round is not FederatedLoop.run_round):
+            return None
+        if self.cfg.client_selection == "oort":
+            return None  # with_client_losses: 3-output round
+        try:
+            server_update = self._window_server_update()
+        except NotImplementedError:
+            return None
+        fn = self._fused_step_fn
+        if fn is None:
+            from fedml_tpu.parallel.shard import make_fused_round_step
+
+            step = make_fused_round_step(self.round_fn, server_update)
+            # Donate the (net, extra) carry: the caller always rebinds
+            # self.net and commits the carry before anything reads the
+            # donated originals — XLA reuses the old model's buffers
+            # instead of holding old net + round average + new net live
+            # (obs.sanitizer.donation_audit pins the 1-copy steady
+            # state).
+            pre = jax.jit(step, donate_argnums=(0, 1))
+            gather = None
+            if self.mesh is None and not self._streaming:
+                from fedml_tpu.data.batching import gather_clients
+
+                def gather_step(net, extra, fed, idx, wmask, key):
+                    sub = gather_clients(fed, idx)
+                    w = sub.counts.astype(jnp.float32) * wmask
+                    return step(net, extra, sub.x, sub.y, sub.mask, w, key)
+
+                gather = jax.jit(gather_step, donate_argnums=(0, 1))
+            fn = self._fused_step_fn = (pre, gather)
+        return fn
+
+    def _train_round_fused(self, round_idx: int):
+        """One host-loop round through the fused step: the same sample/
+        gather/rng prelude as ``run_round``, then ONE donated dispatch
+        with the carry committed back (``_window_carry_commit``) — so
+        checkpoints and remainder/eval host work read the new state.
+        Returns the round's (device) loss."""
+        pre, gather = self._fused_round_step()
+        self.rng, rnd_rng = jax.random.split(self.rng)
+        self._last_round_key = rnd_rng
+        idx, wmask = self.sample_round(round_idx)
+        aux = self._round_aux(round_idx, idx, wmask)
+        extra = self._window_carry_init()
+        if self._streaming:
+            sub = self._stream_cohort(round_idx, idx)
+            weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
+            (self.net, extra), loss = pre(
+                self.net, extra, sub.x, sub.y, sub.mask, weights, rnd_rng,
+                *aux)
+        elif gather is not None and not aux:
+            (self.net, extra), loss = gather(
+                self.net, extra, self.train_fed, jnp.asarray(idx),
+                jnp.asarray(wmask), rnd_rng)
+        else:
+            from fedml_tpu.data.batching import gather_clients
+
+            sub = gather_clients(self.train_fed, idx)
+            weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
+            (self.net, extra), loss = pre(
+                self.net, extra, sub.x, sub.y, sub.mask, weights, rnd_rng,
+                *aux)
+        self._window_carry_commit(extra)
+        return loss
+
     def train_one_round(self, round_idx: int) -> Dict[str, float]:
+        if self._fused_round_step() is not None:
+            loss = self._train_round_fused(round_idx)
+            return {"round": round_idx, "train_loss": float(loss)}
         avg, loss = self.run_round(round_idx)
         self.net = self._server_update(self.net, avg)
         if self.cfg.client_selection == "oort":
@@ -706,10 +848,17 @@ class FedAvgAPI(FederatedLoop):
                 "(train_one_round); the pipelined loop skips that hook — "
                 "use the per-round loop")
         losses = []
+        fused = self._fused_round_step()
         for r in range(start_round, start_round + n_rounds):
-            avg, loss = self.run_round(r)
-            self.net = self._server_update(self.net, avg)
-            losses.append(loss)
+            if fused is not None:
+                # One donated dispatch per round (train + aggregate +
+                # server update) — same async-dispatch pipelining, one
+                # fewer dispatch and no undonated intermediates.
+                losses.append(self._train_round_fused(r))
+            else:
+                avg, loss = self.run_round(r)
+                self.net = self._server_update(self.net, avg)
+                losses.append(loss)
         return [float(l) for l in losses]
 
     # --- windowed carry protocol ------------------------------------------
@@ -966,6 +1115,15 @@ class FedAvgAPI(FederatedLoop):
                 for t in range(length):
                     r = start_round + off + t
                     if self.window_protocol == "round":
+                        # The fused donated step (the scan's discipline
+                        # at W=1) — "round" protocol + random selection
+                        # guarantee it exists here; keeping the
+                        # remainder on the same fused program as the
+                        # host loop preserves host↔windowed
+                        # bit-equality by construction.
+                        if self._fused_round_step() is not None:
+                            losses.append(self._train_round_fused(r))
+                            continue
                         avg, loss = self.run_round(r)
                         self.net = self._server_update(self.net, avg)
                         losses.append(loss)

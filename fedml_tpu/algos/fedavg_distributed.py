@@ -772,6 +772,15 @@ def build_federation_setup(model, train_fed: FederatedArrays, test_global,
     shim (``chaos`` installs a fleet-wide ChaosTransport wrapper).
     Returns ``(size, net0, local_train, eval_fn, args)``."""
     size = cfg.client_num_per_round + 1
+    if getattr(cfg, "compute_layout", "none") not in ("none", ""):
+        # The message-passing tiers build their local trainer here,
+        # outside FedAvgAPI._build_local_train where the lane-fill
+        # layout is wired — refuse loudly rather than leave the flag
+        # silently inert (the PR 4 convention).
+        raise NotImplementedError(
+            f"cfg.compute_layout={cfg.compute_layout!r} is a simulator-"
+            "tier capability (FedAvgAPI family); the distributed "
+            "message-passing tiers do not wire it yet")
     fns = model_fns(model)
     sample_x = jnp.zeros((1,) + train_fed.x.shape[3:], train_fed.x.dtype)
     net0 = fns.init(jax.random.PRNGKey(cfg.seed), sample_x)

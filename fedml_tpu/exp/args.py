@@ -131,6 +131,12 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "round). Cross-silo CLI: none | topk<ratio> "
                         "(wire-level with error feedback) | q<bits> "
                         "(stochastic quantization)")
+    p.add_argument("--compute_layout", type=str, default="none",
+                   help="lane-fill compute layout for the client step: "
+                        "none | auto (pad channel dims to MXU lane/"
+                        "sublane multiples inside the jitted step; "
+                        "logical shapes everywhere else — "
+                        "docs/EXECUTION.md MFU playbook)")
     p.add_argument("--eval_on_clients", action="store_true",
                    help="per-client eval of the global model each eval "
                         "round (reference _local_test_on_all_clients "
@@ -244,6 +250,7 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         remat=args.remat,
         dp_clip=args.dp_clip,
         dp_noise_multiplier=args.dp_noise_multiplier,
+        compute_layout=args.compute_layout,
         client_selection=args.client_selection,
         pow_d_candidates=args.pow_d_candidates,
         oort_epsilon=args.oort_epsilon,

@@ -6,44 +6,69 @@
   variant with 3x3 convs, max-pool, dropout 0.25/0.5, FC-128.
 
 NHWC layout (TPU-native; the reference is NCHW torch).
+
+Lane-fill hooks (docs/ROOFLINE.md, parallel/layout.py): both nets take
+``stem="s2d"`` — a 2x2 space-to-depth input transform (1→4 channels at
+half spatial), the same MXU lane-fill lever the CIFAR ResNets carry
+first-class — and ``widths=(c1, c2)`` conv-width overrides, which is how
+the compute-layout transform builds lane-padded physical twins.
 """
 
 from __future__ import annotations
+
+from typing import Any
 
 import flax.linen as nn
 
 from fedml_tpu.models.registry import register_model
 
 
+def _stem(x, stem: str):
+    if x.ndim == 3:
+        x = x[..., None]
+    if stem == "s2d":
+        from fedml_tpu.models.resnet import space_to_depth
+
+        return space_to_depth(x, 2)
+    if stem != "conv":
+        raise ValueError(f"unknown stem {stem!r}: expected conv|s2d")
+    return x
+
+
 class CNNOriginalFedAvg(nn.Module):
     num_classes: int = 62
     only_digits: bool = False
+    stem: str = "conv"  # "conv" (reference) | "s2d" (lane-fill variant)
+    widths: Any = None  # Optional[(c1, c2)] conv-width override
+    hidden: int = 512
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        if x.ndim == 3:
-            x = x[..., None]
-        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = _stem(x, self.stem)
+        c1, c2 = self.widths or (32, 64)
+        x = nn.Conv(c1, (5, 5), padding="SAME")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.Conv(c2, (5, 5), padding="SAME")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(512)(x))
+        x = nn.relu(nn.Dense(self.hidden)(x))
         return nn.Dense(10 if self.only_digits else self.num_classes)(x)
 
 
 class CNNDropOut(nn.Module):
     num_classes: int = 62
     only_digits: bool = False
+    stem: str = "conv"
+    widths: Any = None  # Optional[(c1, c2)]
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        if x.ndim == 3:
-            x = x[..., None]
-        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
-        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = _stem(x, self.stem)
+        c1, c2 = self.widths or (32, 64)
+        x = nn.relu(nn.Conv(c1, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(c2, (3, 3), padding="VALID")(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.Dropout(0.25, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
@@ -53,6 +78,7 @@ class CNNDropOut(nn.Module):
 
 
 @register_model("cnn")
-def _cnn(num_classes: int = 62, only_digits: bool = False, dropout: bool = True, **_):
+def _cnn(num_classes: int = 62, only_digits: bool = False,
+         dropout: bool = True, stem: str = "conv", **_):
     cls = CNNDropOut if dropout else CNNOriginalFedAvg
-    return cls(num_classes=num_classes, only_digits=only_digits)
+    return cls(num_classes=num_classes, only_digits=only_digits, stem=stem)

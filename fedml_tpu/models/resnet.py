@@ -31,6 +31,19 @@ import jax.numpy as jnp
 from fedml_tpu.models.registry import register_model
 
 
+def norm_groups(c: int, groups: int = 32) -> int:
+    """The GroupNorm group-count policy: the largest divisor of the
+    channel count that is <= ``groups`` (reference group_normalization.py
+    defaults to 32 ch/group on power-of-two widths; MobileNetV3/
+    EfficientNet widths like 72/88/200 need the divisor search). Single
+    source — ``parallel/layout.py`` reads the same policy to keep a
+    lane-padded physical twin's grouping exact."""
+    g = min(groups, c)
+    while c % g:
+        g -= 1
+    return g
+
+
 class Norm(nn.Module):
     """GroupNorm (32 groups, clipped to channel count), BatchNorm,
     ``"gn_fused"`` (the pallas fused GroupNorm kernel,
@@ -38,11 +51,20 @@ class Norm(nn.Module):
     measured SLOWER than XLA's conv-fused lowering at CIFAR-ResNet
     shapes, so not the default — docs/ROOFLINE.md), or ``"none"``
     (identity — the measurement ablation docs/ROOFLINE.md uses to
-    attribute normalization cost; not a training configuration)."""
+    attribute normalization cost; not a training configuration).
+
+    ``logical_channels`` (lane-fill compute layouts,
+    ``parallel/layout.py``): when the module runs a lane-PADDED physical
+    channel count, the group size must stay what the LOGICAL model's
+    policy chose — logical channels keep their exact grouping (bit-equal
+    statistics) and the zero pad channels fill whole extra groups of the
+    same size, where they normalize to exactly zero. 0 = physical is
+    logical (the default, byte-identical to the pre-layout behavior)."""
 
     kind: str = "gn"
     groups: int = 32
     dtype: Any = None  # compute dtype (params stay float32)
+    logical_channels: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -52,13 +74,15 @@ class Norm(nn.Module):
             return nn.BatchNorm(use_running_average=not train, momentum=0.9,
                                 dtype=self.dtype)(x)
         c = x.shape[-1]
-        # num_groups must divide the channel count: largest divisor of c
-        # that is <= self.groups (reference group_normalization.py defaults
-        # to 32 ch/group on power-of-two widths; MobileNetV3/EfficientNet
-        # widths like 72/88/200 need the divisor search).
-        g = min(self.groups, c)
-        while c % g:
-            g -= 1
+        c_log = self.logical_channels or c
+        cpg = c_log // norm_groups(c_log, self.groups)
+        if c % cpg:
+            raise ValueError(
+                f"padded channel count {c} is not a multiple of the "
+                f"logical group size {cpg} (logical {c_log} channels): "
+                "pad channels in whole-group quanta or the logical "
+                "statistics change (parallel/layout.py pads accordingly)")
+        g = c // cpg
         if self.kind == "gn_fused":
             # name="GroupNorm_0" matches nn.GroupNorm's auto-name in the
             # "gn" branch → identical param trees; checkpoints are
@@ -89,17 +113,22 @@ class _GroupNormFused(nn.Module):
 
 
 class BottleneckBlock(nn.Module):
+    #: ``logical_planes`` (lane-fill layouts): the LOGICAL width this
+    #: block's ``planes`` was padded up from — forwarded to every Norm so
+    #: the padded twin keeps the logical grouping. 0 = planes is logical.
     planes: int
     strides: int = 1
     norm: str = "gn"
     expansion: int = 4
     dtype: Any = None
+    logical_planes: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        lp = self.logical_planes
         residual = x
         y = nn.Conv(self.planes, (1, 1), use_bias=False, dtype=self.dtype)(x)
-        y = Norm(self.norm, dtype=self.dtype)(y, train)
+        y = Norm(self.norm, dtype=self.dtype, logical_channels=lp)(y, train)
         y = nn.relu(y)
         # Explicit (1,1) padding == torch conv3x3(padding=1): identical to
         # "SAME" at stride 1, and at stride 2 it keeps the reference's
@@ -108,18 +137,21 @@ class BottleneckBlock(nn.Module):
         y = nn.Conv(self.planes, (3, 3), (self.strides, self.strides),
                     padding=((1, 1), (1, 1)), use_bias=False,
                     dtype=self.dtype)(y)
-        y = Norm(self.norm, dtype=self.dtype)(y, train)
+        y = Norm(self.norm, dtype=self.dtype, logical_channels=lp)(y, train)
         y = nn.relu(y)
         y = nn.Conv(self.planes * self.expansion, (1, 1), use_bias=False,
                     dtype=self.dtype)(y)
-        y = Norm(self.norm, dtype=self.dtype)(y, train)
+        y = Norm(self.norm, dtype=self.dtype,
+                 logical_channels=lp * self.expansion)(y, train)
         if residual.shape != y.shape:
             residual = nn.Conv(
                 self.planes * self.expansion, (1, 1),
                 (self.strides, self.strides), use_bias=False, name="downsample",
                 dtype=self.dtype,
             )(x)
-            residual = Norm(self.norm, dtype=self.dtype)(residual, train)
+            residual = Norm(self.norm, dtype=self.dtype,
+                            logical_channels=lp * self.expansion)(
+                residual, train)
         return nn.relu(residual + y)
 
 
@@ -129,25 +161,28 @@ class BasicBlock(nn.Module):
     norm: str = "gn"
     expansion: int = 1
     dtype: Any = None
+    logical_planes: int = 0  # see BottleneckBlock
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        lp = self.logical_planes
         residual = x
         # torch conv3x3(padding=1) grid — see BottleneckBlock.
         y = nn.Conv(self.planes, (3, 3), (self.strides, self.strides),
                     padding=((1, 1), (1, 1)), use_bias=False,
                     dtype=self.dtype)(x)
-        y = Norm(self.norm, dtype=self.dtype)(y, train)
+        y = Norm(self.norm, dtype=self.dtype, logical_channels=lp)(y, train)
         y = nn.relu(y)
         y = nn.Conv(self.planes, (3, 3), padding=((1, 1), (1, 1)),
                     use_bias=False, dtype=self.dtype)(y)
-        y = Norm(self.norm, dtype=self.dtype)(y, train)
+        y = Norm(self.norm, dtype=self.dtype, logical_channels=lp)(y, train)
         if residual.shape != y.shape:
             residual = nn.Conv(
                 self.planes, (1, 1), (self.strides, self.strides),
                 use_bias=False, name="downsample", dtype=self.dtype,
             )(x)
-            residual = Norm(self.norm, dtype=self.dtype)(residual, train)
+            residual = Norm(self.norm, dtype=self.dtype,
+                            logical_channels=lp)(residual, train)
         return nn.relu(residual + y)
 
 
@@ -180,25 +215,49 @@ class CifarResNet(nn.Module):
     norm: str = "gn"
     dtype: Any = None  # compute dtype; jnp.bfloat16 = mixed precision
     stem: str = "conv"  # "conv" (reference) | "s2d" (TPU lane-fill variant)
+    #: Stage-width / stem-channel overrides (None/0 = the stem kind's
+    #: defaults). ``parallel/layout.py`` builds lane-padded physical
+    #: twins through these; they also admit deliberately non-reference
+    #: widths for lane-fill measurement models.
+    widths: Any = None  # Optional[Tuple[int, int, int]]
+    stem_width: int = 0
+    #: Set by the layout transform on a PADDED twin: the logical widths
+    #: the physical ones were padded up from, threaded to every Norm so
+    #: grouping (and therefore the math on the logical channels) stays
+    #: bit-identical to the logical model. None/0 = widths are logical.
+    logical_widths: Any = None
+    logical_stem: int = 0
 
-    @nn.compact
-    def __call__(self, x, train: bool = False):
+    def stage_widths(self):
+        """(stem_ch, per-stage widths) after overrides — the shapes the
+        param tree will carry (layout planning reads this)."""
         if self.stem == "s2d":
-            x = space_to_depth(x, 2)
             widths, stem_ch = (32, 64, 128), 32
         elif self.stem == "conv":
             widths, stem_ch = (16, 32, 64), 16
         else:
             raise ValueError(f"unknown stem {self.stem!r}: expected conv|s2d")
+        return (self.stem_width or stem_ch,
+                tuple(self.widths) if self.widths else widths)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        stem_ch, widths = self.stage_widths()
+        log_w = tuple(self.logical_widths) if self.logical_widths \
+            else (0,) * len(widths)
+        if self.stem == "s2d":
+            x = space_to_depth(x, 2)
         x = nn.Conv(stem_ch, (3, 3), padding="SAME", use_bias=False,
                     dtype=self.dtype)(x)
-        x = Norm(self.norm, dtype=self.dtype)(x, train)
+        x = Norm(self.norm, dtype=self.dtype,
+                 logical_channels=self.logical_stem)(x, train)
         x = nn.relu(x)
         for stage, (planes, n_blocks) in enumerate(zip(widths, self.layers)):
             for i in range(n_blocks):
                 strides = 2 if (stage > 0 and i == 0) else 1
                 x = BottleneckBlock(planes, strides, self.norm,
-                                    dtype=self.dtype)(x, train)
+                                    dtype=self.dtype,
+                                    logical_planes=log_w[stage])(x, train)
         x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         return nn.Dense(self.num_classes)(x)
 
@@ -243,22 +302,36 @@ from fedml_tpu.models.registry import resolve_dtype as _dt  # noqa: E402
 
 @register_model("resnet56")
 def resnet56(num_classes: int = 10, norm: str = "gn", dtype=None,
-             stem: str = "conv", **_):
+             stem: str = "conv", widths=None, **_):
     return CifarResNet(layers=(6, 6, 6), num_classes=num_classes, norm=norm,
-                       dtype=_dt(dtype), stem=stem)
+                       dtype=_dt(dtype), stem=stem, widths=widths)
+
+
+@register_model("resnet56_s2d")
+def resnet56_s2d(num_classes: int = 10, norm: str = "gn", dtype=None, **_):
+    """The measured lane-fill variant as a first-class registry name
+    (CLI: ``--model resnet56_s2d``): 2x2 space-to-depth stem, stage
+    widths doubled — docs/ROOFLINE.md measured it at ~3.2x the reference
+    stem's samples/sec (MFU 2.9% → 8.7%) at equal per-conv FLOPs. NOT
+    weight-compatible with the reference model (4x params per conv) —
+    ``torch_convert`` refuses reference checkpoints for it loudly."""
+    return CifarResNet(layers=(6, 6, 6), num_classes=num_classes, norm=norm,
+                       dtype=_dt(dtype), stem="s2d")
 
 
 @register_model("resnet110")
-def resnet110(num_classes: int = 10, norm: str = "gn", dtype=None, **_):
+def resnet110(num_classes: int = 10, norm: str = "gn", dtype=None,
+              stem: str = "conv", **_):
     return CifarResNet(layers=(12, 12, 12), num_classes=num_classes, norm=norm,
-                       dtype=_dt(dtype))
+                       dtype=_dt(dtype), stem=stem)
 
 
 @register_model("resnet20")
-def resnet20(num_classes: int = 10, norm: str = "gn", dtype=None, **_):
+def resnet20(num_classes: int = 10, norm: str = "gn", dtype=None,
+             stem: str = "conv", widths=None, **_):
     """Small CIFAR ResNet (2-2-2 bottleneck) — test/dryrun workhorse."""
     return CifarResNet(layers=(2, 2, 2), num_classes=num_classes, norm=norm,
-                       dtype=_dt(dtype))
+                       dtype=_dt(dtype), stem=stem, widths=widths)
 
 
 @register_model("resnet18_gn")

@@ -88,6 +88,27 @@ def convert_torch_cifar_resnet(state_dict: Dict, net: NetState,
     sd = {k[len("module."):] if k.startswith("module.") else k: v
           for k, v in state_dict.items()
           if not k.endswith("num_batches_tracked")}
+    # Refuse non-reference model geometry UP FRONT with a diagnosis,
+    # not a mid-tree shape error: the s2d stem (2x2 space-to-depth, 12
+    # input channels, doubled widths) and lane-padded physical twins
+    # (parallel/layout.py) have no reference ``.pth`` mapping by
+    # construction — the reference trained the conv stem at 3 input
+    # channels and 16/32/64 stage widths. (Lane-fill layouts never need
+    # conversion anyway: checkpoints live at LOGICAL shapes and the pad
+    # happens inside the client step.)
+    stem_kernel = net.params.get("Conv_0", {}).get("kernel")
+    ref_stem = sd.get("conv1.weight")
+    if stem_kernel is not None and ref_stem is not None:
+        in_ch, out_ch = stem_kernel.shape[2], stem_kernel.shape[3]
+        ref_out, ref_in = np.asarray(ref_stem).shape[:2]
+        if (in_ch, out_ch) != (ref_in, ref_out):
+            raise ValueError(
+                f"model stem conv is {in_ch}->{out_ch} channels but the "
+                f"torch checkpoint's conv1 is {ref_in}->{ref_out}: this "
+                "net's geometry cannot map onto the reference weights "
+                "(stem='s2d' variants and lane-padded physical twins "
+                "have no reference checkpoint — use the reference stem, "
+                "or load logical-shape checkpoints via obs/checkpoint)")
     used = set()
 
     def rebuild(tree):

@@ -18,9 +18,11 @@ from fedml_tpu.obs.checkpoint import (
 )
 from fedml_tpu.obs.flops import count_params, flops_str, model_cost
 from fedml_tpu.obs.sanitizer import (
+    DonationAudit,
     SanitizerError,
     SanitizerReport,
     compile_count,
+    donation_audit,
     planned_transfer,
     sanitized,
 )
@@ -42,9 +44,11 @@ __all__ = [
     "count_params",
     "flops_str",
     "model_cost",
+    "DonationAudit",
     "SanitizerError",
     "SanitizerReport",
     "compile_count",
+    "donation_audit",
     "planned_transfer",
     "sanitized",
 ]

@@ -128,6 +128,69 @@ def sanitized(transfer: str = "disallow", max_compiles: int = 0,
         report.assert_clean()
 
 
+@dataclass
+class DonationAudit:
+    """Counts LIVE device copies of model-sized buffers — the runtime
+    complement to fedlint's static R5 (read-after-donation): a donation
+    regression (a dispatch that stops donating its carry, or a stray
+    host reference pinning the old model) shows up as a copies() > 1
+    steady state a test can assert on, instead of a profile someone has
+    to read.
+
+    Mechanism: the template net's leaf signatures (shape, dtype) are
+    matched against ``jax.live_arrays()`` — donated (deleted) buffers
+    drop out of that listing, so a fused round loop that donates its
+    ``(net, extra)`` carry holds exactly ONE live copy of the model
+    between dispatches, while an undonated round holds the old net AND
+    the round average/new net simultaneously (>= 2). ``sample()`` after
+    each round records the running peak.
+
+    Honest-accounting caveat: matching is by (shape, dtype) signature,
+    so an unrelated live array that happens to share a leaf's signature
+    counts too (optimizer state held OUTSIDE the dispatch, a user's
+    deliberate copy). Audit with the federation data's shapes disjoint
+    from the model's (true for every model here — data is [S, B, ...])
+    and treat copies() as an upper bound pinned against a known-good
+    value."""
+
+    template: object
+    peak: float = 0.0
+
+    def __post_init__(self):
+        leaves = jax.tree.leaves(self.template)
+        self._sigs = frozenset(
+            (tuple(l.shape), str(l.dtype)) for l in leaves)
+        self._bytes_one = float(sum(
+            l.size * l.dtype.itemsize for l in leaves)) or 1.0
+
+    def copies(self) -> float:
+        """Live bytes matching the template's leaf signatures, in units
+        of one whole model copy."""
+        live = 0.0
+        for a in jax.live_arrays():
+            try:
+                sig = (tuple(a.shape), str(a.dtype))
+            except RuntimeError:  # deleted between listing and probing
+                continue
+            if sig in self._sigs:
+                live += a.size * a.dtype.itemsize
+        return live / self._bytes_one
+
+    def sample(self) -> float:
+        n = self.copies()
+        self.peak = max(self.peak, n)
+        return n
+
+
+@contextmanager
+def donation_audit(template):
+    """Audit a steady-state round loop for model-buffer copies: yields a
+    :class:`DonationAudit` built from ``template`` (the model's NetState
+    or params pytree); call ``sample()`` after each round dispatch and
+    assert on ``peak`` (fused donated rounds: 1.0)."""
+    yield DonationAudit(template)
+
+
 @contextmanager
 def planned_transfer():
     """Mark a deliberate host<->device staging copy inside a

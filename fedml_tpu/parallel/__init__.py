@@ -1,5 +1,15 @@
+from fedml_tpu.parallel.layout import (
+    ComputeLayout,
+    LayoutPolicy,
+    compute_layout,
+    wrap_local_train,
+)
 from fedml_tpu.parallel.mesh import client_mesh, mesh_2d
-from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
+from fedml_tpu.parallel.shard import (
+    make_fused_round_step,
+    make_sharded_round,
+    make_vmap_round,
+)
 from fedml_tpu.parallel.ring_attention import (
     make_ring_attention,
     reference_attention,
@@ -18,8 +28,13 @@ from fedml_tpu.parallel.expert_parallel import (
 )
 
 __all__ = [
+    "ComputeLayout",
+    "LayoutPolicy",
+    "compute_layout",
+    "wrap_local_train",
     "client_mesh",
     "mesh_2d",
+    "make_fused_round_step",
     "make_sharded_round",
     "make_vmap_round",
     "make_ring_attention",

@@ -303,6 +303,39 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
     return round_fn
 
 
+def make_fused_round_step(round_fn, server_update=None):
+    """ONE dispatch per host-loop round: client training + weighted
+    aggregation (``round_fn``) + the algorithm's PURE server update,
+    fused — ``make_window_scan``'s shape at W=1, without the scan.
+
+    The host loop used to dispatch the round and the server update as
+    separate jit calls with undonated intermediates: the old global
+    model, the round average, and the new global model were all live at
+    once (3 model-sized HBM copies on the round's critical path), and
+    the server update paid its own dispatch. Callers jit this with
+    ``donate_argnums=(0, 1)`` — the incoming ``(net, extra)`` carry is
+    always replaced by the step's outputs, exactly the windowed scan's
+    donation discipline, so XLA reuses the old buffers in place
+    (``obs.sanitizer.donation_audit`` pins the single-copy steady
+    state).
+
+    Signature matches the scan body: ``step(net, extra, x, y, mask,
+    weights, key, *aux) -> ((net', extra'), loss)`` with ``weights``
+    used for both the model average and the loss weighting (the
+    streaming host loop's convention) and ``key`` the round's rng key
+    (randomized server updates fold_in from it — same protocol slot as
+    the windowed carry)."""
+
+    def step_fn(net, extra, x, y, mask, weights, key, *aux):
+        avg, loss = round_fn(net, x, y, mask, weights, weights, key, *aux)
+        if server_update is None:
+            return (avg, extra), loss
+        new_net, new_extra = server_update(net, avg, extra, key)
+        return (new_net, new_extra), loss
+
+    return step_fn
+
+
 def make_window_scan(round_fn, server_update=None):
     """``lax.scan`` over a window of PRE-GATHERED rounds: one jitted
     dispatch runs W whole federated rounds back-to-back — the windowed

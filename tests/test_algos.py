@@ -78,7 +78,10 @@ def test_fedprox_mu_shrinks_client_drift():
     fed, test = _setup()
     base = FedConfig(**{**CFG, "comm_round": 1, "epochs": 3})
     a = FedAvgAPI(LogisticRegression(num_classes=4), fed, test, base)
-    w0 = a.net.params
+    # Host copy: the fused round step DONATES the incoming net (same
+    # contract as train_rounds_on_device), so the pre-training reference
+    # would point at a deleted buffer after train().
+    w0 = jax.tree.map(np.asarray, a.net.params)
     a.train()
     drift_avg = float(tree_global_norm(tree_sub(a.net.params, w0)))
 

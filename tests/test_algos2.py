@@ -105,7 +105,8 @@ def test_robust_clipping_bounds_update():
         **{**CFG, "comm_round": 1, "lr": 1.0}, robust_norm_bound=bound
     )
     api = FedAvgRobustAPI(LogisticRegression(num_classes=4), fed, test, cfg)
-    w0 = api.net.params
+    # Host copy — the fused round step donates the incoming net.
+    w0 = jax.tree.map(np.asarray, api.net.params)
     api.train()
     drift = float(tree_global_norm(tree_sub(api.net.params, w0)))
     assert drift <= bound + 1e-5

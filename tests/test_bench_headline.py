@@ -36,6 +36,10 @@ def test_headline_carries_the_primary_number(r4_out):
     assert h["value"] == r4_out["value"] == 10484.75
     assert h["vs_baseline"] == 6.99
     assert h["mfu"] == 0.0291
+    # The r9 utilization pair: resnet56_mfu falls back to the primary's
+    # mfu on pre-r9 blobs; best_cnn_mfu is honest-null there.
+    assert h["resnet56_mfu"] == 0.0291
+    assert h["best_cnn_mfu"] is None
     assert h["tuned_best"]["samples_per_sec"] == 45633.22
     # One scalar per submetric section, numbers only (no nested blobs).
     for k, v in h["sub"].items():
@@ -82,7 +86,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
                  "bench_chaos", "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
                  "bench_vit",
-                 "bench_resnet56_b128", "bench_resnet56_s2d",
+                 "bench_layout_fused_round", "bench_resnet56_s2d",
                  "bench_sharded_path", "bench_flash_attention_sweep",
                  "bench_transformer_fed_mfu"):
         monkeypatch.setattr(bench, name, quick_section)
@@ -120,7 +124,7 @@ def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
                  "bench_chaos", "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
                  "bench_vit",
-                 "bench_resnet56_b128", "bench_resnet56_s2d",
+                 "bench_layout_fused_round", "bench_resnet56_s2d",
                  "bench_sharded_path", "bench_flash_attention_sweep",
                  "bench_transformer_fed_mfu"):
         monkeypatch.setattr(bench, name, lambda: {"ok": 1.0})
@@ -158,6 +162,26 @@ def test_bench_synthetic_1m_machinery_toy_scale():
     assert out["rps_vs_342k"] is not None
     assert out["prefetch_overlap_ratio"] >= 0
     assert out["directory_mb"] < 1.0  # O(clients) ints, not samples
+
+
+@pytest.mark.slow  # calibrated timed windows on the 2-core box (~1 min)
+def test_bench_layout_fused_round_machinery_toy_scale():
+    """The r9 section's machinery end-to-end at toy scale: fused vs
+    separate A/B, donation + recompile audit, and the compute-layout
+    pad A/B (widths (12, 20) → padded) — the real section runs the
+    (120, 120) just-under-lane defaults."""
+    out = bench.bench_layout_fused_round(
+        n_clients=8, per_client=16, batch=8, cpr=4, widths=(12, 20),
+        min_s=0.4, reps=2)
+    assert out["fused_samples_per_sec"] > 0
+    assert out["separate_samples_per_sec"] > 0
+    assert out["fused_speedup"] > 0
+    assert out["steady_state_compiles"] == 0
+    # signature matching is an upper bound, but inside one fresh section
+    # the fused steady state must not hold a second full model copy
+    assert out["live_model_copies"] < 2.0
+    assert out["layout"] and not out["layout"]["identity"]
+    assert out["layout_samples_per_sec"] > 0 and out["layout_pad_ratio"] > 0
 
 
 def test_headline_tolerates_budget_skipped_submetrics():

@@ -1,0 +1,445 @@
+"""Lane-fill compute layouts + the fused donated round step (r9).
+
+The invisibility contract under test: ``cfg.compute_layout="auto"``
+changes WHERE the client step computes (a lane-padded physical twin)
+but never WHAT anything above it sees — logical params, aggregation
+inputs, checkpoints, wire frames, robust aggregators, and the training
+trajectory itself (fp32 bit-exact for the CIFAR ResNet family; the
+flatten-boundary CNN documents a ~1-ulp reassociation tolerance: its
+Dense contraction interleaves pad channels into the reduction, so XLA
+may regroup the partial sums). Plus the fused round step's contract:
+one donated dispatch per host round, bit-equal to the separate
+``run_round`` + ``_server_update`` procedure, zero steady-state
+recompiles, and a single live model copy (donation audit).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.data.batching import build_federated_arrays
+from fedml_tpu.data.partition import partition_homo
+from fedml_tpu.models.cnn import CNNDropOut, CNNOriginalFedAvg
+from fedml_tpu.models.resnet import CifarResNet
+from fedml_tpu.parallel.layout import (
+    LayoutPolicy,
+    compute_layout,
+    pad_channels,
+    pad_width,
+    wrap_local_train,
+)
+from fedml_tpu.trainer.local import (
+    make_client_optimizer,
+    make_local_train_fn,
+    model_fns,
+)
+
+
+def tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def tree_shapes(t):
+    return [tuple(l.shape) for l in jax.tree.leaves(t)]
+
+
+# ---------------- pad policy ----------------
+
+def test_pad_width_policy():
+    pol = LayoutPolicy()
+    assert pad_width(12, pol) == 16     # sublane rounding
+    assert pad_width(16, pol) == 16     # aligned: untouched
+    assert pad_width(64, pol) == 64     # far from the lane: no snap
+    assert pad_width(96, pol) == 128    # within lane_snap: square up
+    assert pad_width(120, pol) == 128
+    assert pad_width(128, pol) == 128
+    assert pad_width(200, pol) == 200   # 256-200=56 > 32: no snap
+
+
+def test_pad_channels_respects_group_quanta():
+    pol = LayoutPolicy()
+    # quanta force whole GroupNorm groups: 96→128 would break a
+    # 3-channel group size, so the pad lands on lcm(8, 3) = 24 grid.
+    assert pad_channels(96, pol, (3,)) == 144
+    assert pad_channels(96, pol) == 128
+    assert pad_channels(20, pol, (1, 1)) == 24
+    # never below the logical width
+    assert pad_channels(8, pol) == 8
+
+
+# ---------------- padded-vs-logical client-step equivalence -----------
+
+def _step_pair(model, x_shape, opt_name="momentum", epochs=2):
+    sample = np.zeros(x_shape, np.float32)
+    layout = compute_layout(model, sample)
+    assert not layout.is_identity
+    fns_log, fns_phys = model_fns(model), model_fns(layout.physical_model)
+    net = fns_log.init(jax.random.PRNGKey(0), sample)
+    opt = make_client_optimizer(opt_name, 0.1)
+    lt_log = jax.jit(make_local_train_fn(fns_log.apply, opt, epochs))
+    lt_phys = jax.jit(wrap_local_train(
+        make_local_train_fn(fns_phys.apply, opt, epochs), layout))
+    rng = np.random.RandomState(0)
+    S, B = 3, 4
+    x = rng.randn(S, B, *x_shape[1:]).astype(np.float32)
+    y = rng.randint(0, 10, (S, B)).astype(np.int32)
+    mask = np.ones((S, B), np.float32)
+    mask[-1, 2:] = 0.0  # partially-masked tail batch
+    key = jax.random.PRNGKey(7)
+    out_log = lt_log(net, x, y, mask, key)
+    out_phys = lt_phys(net, x, y, mask, key)
+    return layout, out_log, out_phys
+
+
+def test_cifar_resnet_padded_step_bit_exact_fp32():
+    """Channel-tail pads only (mean-pool head): the padded twin's
+    training step is BIT-EXACT in fp32 — params and loss."""
+    model = CifarResNet(layers=(1, 1, 1), num_classes=10,
+                        widths=(20, 40, 80), stem_width=20)
+    layout, (n1, l1), (n2, l2) = _step_pair(model, (4, 16, 16, 3))
+    assert tree_shapes(n1) == tree_shapes(n2)  # logical shapes out
+    assert tree_equal(n1, n2)
+    assert float(l1) == float(l2)
+    # and the physical twin really is wider
+    assert layout.describe()["padded_leaves"] > 0
+
+
+def test_cifar_resnet_padded_step_bf16():
+    """bf16 compute dtype: measured bit-exact on the CPU backend; the
+    pin allows a small reassociation tolerance because MXU hardware may
+    regroup bf16 reductions over the padded contraction dims."""
+    model = CifarResNet(layers=(1, 1, 1), num_classes=10,
+                        widths=(20, 40, 80), stem_width=20,
+                        dtype=jnp.bfloat16)
+    _, (n1, l1), (n2, l2) = _step_pair(model, (4, 16, 16, 3), "sgd")
+    for a, b in zip(jax.tree.leaves(n1), jax.tree.leaves(n2)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-2, atol=1e-3)
+
+
+def test_cnn_flatten_padded_step_close():
+    """CNNOriginalFedAvg pads through a FLATTEN boundary: the Dense
+    contraction interleaves pad channels into its reduction dim, so XLA
+    may reassociate the logical partial sums — equivalence holds to
+    ~1-ulp accumulation (documented; the CIFAR family above is the
+    bit-exact one)."""
+    model = CNNOriginalFedAvg(num_classes=10, widths=(12, 20))
+    _, (n1, l1), (n2, l2) = _step_pair(model, (4, 28, 28, 1), "sgd")
+    assert tree_shapes(n1) == tree_shapes(n2)
+    for a, b in zip(jax.tree.leaves(n1), jax.tree.leaves(n2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    assert abs(float(l1) - float(l2)) < 1e-5
+
+
+def test_reference_models_are_identity():
+    """The policy pads NOTHING on the already-aligned reference models
+    — compute_layout="auto" is then an exact no-op (the API skips the
+    wrapper entirely)."""
+    for model, shape in (
+            (CifarResNet(layers=(2, 2, 2), num_classes=10), (2, 32, 32, 3)),
+            (CifarResNet(layers=(2, 2, 2), num_classes=10, stem="s2d"),
+             (2, 32, 32, 3)),
+            (CNNOriginalFedAvg(num_classes=62), (2, 28, 28, 1))):
+        assert compute_layout(model, np.zeros(shape, np.float32)).is_identity
+
+
+def test_unsupported_models_refused_loudly():
+    from fedml_tpu.models.lr import LogisticRegression
+
+    with pytest.raises(NotImplementedError, match="dropout"):
+        compute_layout(CNNDropOut(num_classes=62),
+                       np.zeros((2, 28, 28, 1), np.float32))
+    with pytest.raises(NotImplementedError, match="physical-twin"):
+        compute_layout(LogisticRegression(num_classes=2),
+                       np.zeros((2, 6), np.float32))
+
+
+# ---------------- end-to-end invisibility through FedAvgAPI -----------
+
+def _fed_cifar_small(n_clients=8, per_client=8, batch=4, hw=16):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n_clients * per_client, hw, hw, 3).astype(np.float32)
+    y = rng.randint(0, 10, len(x)).astype(np.int32)
+    return build_federated_arrays(x, y, partition_homo(len(x), n_clients),
+                                  batch)
+
+
+def _mis_model():
+    return CifarResNet(layers=(1, 1, 1), num_classes=10,
+                       widths=(20, 40, 80), stem_width=20)
+
+
+def _cfg(**kw):
+    base = dict(client_num_in_total=8, client_num_per_round=4,
+                comm_round=3, epochs=1, batch_size=4, lr=0.1,
+                frequency_of_the_test=100)
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def test_layout_invisible_above_the_client_step():
+    """cfg.compute_layout='auto' vs 'none': same training trajectory
+    and logical shapes in api.net at every round, with the physical
+    twin actually engaged. Trajectory equality is to tight tolerance,
+    not bitwise: the single-client STEP is bit-exact (pinned above),
+    but the vmapped round may group the padded contractions' partial
+    sums differently than the logical round — ~1-ulp reassociation per
+    step, same class as the windowed tier's documented loss-scalar
+    caveat."""
+    fed = _fed_cifar_small()
+    a = FedAvgAPI(_mis_model(), fed, None, _cfg(compute_layout="none"))
+    b = FedAvgAPI(_mis_model(), fed, None, _cfg(compute_layout="auto"))
+    assert b._layout is not None and not b._layout.is_identity
+    logical_shapes = tree_shapes(a.net)
+    for r in range(3):
+        la = a.train_one_round(r)["train_loss"]
+        lb = b.train_one_round(r)["train_loss"]
+        assert la == pytest.approx(lb, rel=1e-5)
+        assert tree_shapes(b.net) == logical_shapes
+    for x, y in zip(jax.tree.leaves(a.net), jax.tree.leaves(b.net)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_layout_composes_with_robust_aggregator():
+    """The aggregation input is the LOGICAL client stack: a non-mean
+    aggregator (coordinate median) must see identical operands with and
+    without the layout — pinned by trajectory equality."""
+    fed = _fed_cifar_small()
+    a = FedAvgAPI(_mis_model(), fed, None,
+                  _cfg(compute_layout="none", aggregator="coord_median"))
+    b = FedAvgAPI(_mis_model(), fed, None,
+                  _cfg(compute_layout="auto", aggregator="coord_median"))
+    for r in range(2):
+        assert a.train_one_round(r)["train_loss"] == \
+            pytest.approx(b.train_one_round(r)["train_loss"], rel=1e-5)
+    for x, y in zip(jax.tree.leaves(a.net), jax.tree.leaves(b.net)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_layout_rides_windowed_streaming():
+    """The windowed tier's bit-equality contract holds WITH the layout
+    engaged: padded windowed (scan spans + a fused remainder round) ==
+    padded host loop, bitwise, on a streaming store."""
+    from fedml_tpu.data.store import FederatedStore
+
+    rng = np.random.RandomState(1)
+    n_clients, per_client, batch = 8, 8, 4
+    x = rng.randn(n_clients * per_client, 16, 16, 3).astype(np.float32)
+    y = rng.randint(0, 10, len(x)).astype(np.int32)
+    parts = {c: np.arange(c * per_client, (c + 1) * per_client)
+             for c in range(n_clients)}
+
+    def make():
+        store = FederatedStore(x, y, parts, batch_size=batch)
+        return FedAvgAPI(_mis_model(), store, None,
+                         _cfg(compute_layout="auto", comm_round=100))
+
+    a, b = make(), make()
+    assert a._layout is not None
+    la = [a.train_one_round(r)["train_loss"] for r in range(5)]
+    lb = b.train_rounds_windowed(5, window=2)  # 2 scans + 1 remainder
+    np.testing.assert_array_equal(np.asarray(la, np.float32),
+                                  np.asarray(lb, np.float32))
+    assert tree_equal(a.net, b.net)
+
+
+def test_layout_checkpoint_and_wire_stay_logical(tmp_path):
+    """Checkpoints and wire tensor frames carry LOGICAL shapes only."""
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.wire import deserialize_message, serialize_message
+    from fedml_tpu.obs.checkpoint import (
+        CheckpointManager,
+        restore_run,
+        save_run,
+    )
+
+    fed = _fed_cifar_small()
+    api = FedAvgAPI(_mis_model(), fed, None, _cfg(compute_layout="auto"))
+    logical_shapes = tree_shapes(api.net)
+    api.train_one_round(0)
+    mgr = CheckpointManager(str(tmp_path))
+    save_run(mgr, api, round_idx=0)
+    mgr.wait()
+
+    fresh = FedAvgAPI(_mis_model(), fed, None, _cfg(compute_layout="auto"))
+    restore_run(mgr, fresh)
+    assert tree_shapes(fresh.net) == logical_shapes
+    assert tree_equal(fresh.net, api.net)
+
+    msg = Message(type=3, sender_id=0, receiver_id=1)
+    msg.add(Message.MSG_ARG_KEY_MODEL_PARAMS,
+            jax.tree.map(np.asarray, api.net.params))
+    blob = serialize_message(msg, "tensor")
+    back = deserialize_message(blob, "tensor")
+    got = back.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+    assert tree_shapes(got) == tree_shapes(api.net.params)
+
+
+def test_layout_refused_for_custom_trainers_and_bad_values():
+    from fedml_tpu.algos.fedprox import FedProxAPI
+
+    fed = _fed_cifar_small()
+    with pytest.raises(NotImplementedError, match="local trainer"):
+        FedProxAPI(_mis_model(), fed, None, _cfg(compute_layout="auto"))
+    with pytest.raises(ValueError, match="compute_layout"):
+        FedAvgAPI(_mis_model(), fed, None, _cfg(compute_layout="lanes"))
+    # DP noise draws per-parameter over PHYSICAL shapes — the same
+    # exactness break dropout models are refused for (dp_clip alone is
+    # exact and stays allowed).
+    with pytest.raises(NotImplementedError, match="DP noise"):
+        FedAvgAPI(_mis_model(), fed, None,
+                  _cfg(compute_layout="auto", dp_clip=1.0,
+                       dp_noise_multiplier=0.5))
+    FedAvgAPI(_mis_model(), fed, None,
+              _cfg(compute_layout="auto", dp_clip=1.0))  # clip-only: OK
+
+
+# ---------------- fused donated round step ----------------------------
+
+def _lr_setup(**cfg_kw):
+    from fedml_tpu.models.lr import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(160, 13).astype(np.float32)
+    y = (rng.rand(160) > 0.5).astype(np.int32)
+    fed = build_federated_arrays(x, y, partition_homo(160, 8), 16)
+    cfg = FedConfig(client_num_in_total=8, client_num_per_round=4,
+                    comm_round=100, epochs=1, batch_size=16, lr=0.3,
+                    **cfg_kw)
+    return FedAvgAPI(LogisticRegression(num_classes=2),
+                     fed, None, cfg), fed
+
+
+def test_fused_step_matches_separate_procedure():
+    """train_one_round (fused: one donated dispatch) is bit-equal to the
+    pre-r9 run_round + _server_update procedure — FedAvg and FedOpt
+    (whose server optimizer state rides the fused carry)."""
+    from fedml_tpu.algos.fedopt import FedOptAPI
+    from fedml_tpu.models.lr import LogisticRegression
+
+    def lr_fed():
+        rng = np.random.RandomState(0)
+        x = rng.randn(160, 13).astype(np.float32)
+        y = (rng.rand(160) > 0.5).astype(np.int32)
+        return build_federated_arrays(x, y, partition_homo(160, 8), 16)
+
+    for cls, kw in ((FedAvgAPI, {}),
+                    (FedOptAPI, dict(server_optimizer="adam",
+                                     server_lr=0.01))):
+        cfg = FedConfig(client_num_in_total=8, client_num_per_round=4,
+                        comm_round=100, epochs=1, batch_size=16, lr=0.3,
+                        **kw)
+        a = cls(LogisticRegression(num_classes=2),
+                lr_fed(), None, cfg)
+        b = cls(LogisticRegression(num_classes=2),
+                lr_fed(), None, cfg)
+        assert a._fused_round_step() is not None
+        la = [a.train_one_round(r)["train_loss"] for r in range(4)]
+        lb = []
+        for r in range(4):
+            avg, loss = b.run_round(r)
+            b.net = b._server_update(b.net, avg)
+            lb.append(float(loss))
+        assert la == lb
+        assert tree_equal(a.net, b.net)
+
+
+def test_fused_step_donates_and_never_retraces():
+    """The two steady-state pins the tentpole promises: (1) the incoming
+    net is DONATED — the pre-dispatch reference is deleted, and the live
+    model-buffer audit holds at one copy; (2) zero recompiles after
+    warmup."""
+    from fedml_tpu.obs.sanitizer import donation_audit, sanitized
+
+    api, _ = _lr_setup()
+    api.train_one_round(0)  # warm (compile)
+    api.train_one_round(1)
+    jax.block_until_ready(api.net.params)
+
+    old_ref = api.net
+    with sanitized(transfer="allow") as rep:  # strict: 0 compiles
+        with donation_audit(api.net) as audit:
+            baseline = audit.sample()  # this api's copy + any strays the
+            # shared pytest process holds (signature matching is an
+            # upper bound — see DonationAudit's docstring)
+            for r in range(2, 6):
+                api.train_one_round(r)
+                audit.sample()
+    # Donation happened: the pre-loop net's buffers were consumed by the
+    # dispatch, not copied.
+    assert all(l.is_deleted() for l in jax.tree.leaves(old_ref))
+    # And the steady state holds flat — an undonated loop (or a stray
+    # host reference) would accumulate extra live model copies.
+    assert audit.peak <= baseline + 0.25, (audit.peak, baseline)
+    assert rep.compiles == 0
+
+
+def test_separate_procedure_holds_two_copies():
+    """Negative control for the audit: the undonated run_round path has
+    the old net AND the round average live at the sample point."""
+    from fedml_tpu.obs.sanitizer import donation_audit
+
+    import gc
+
+    api, _ = _lr_setup()
+    avg, loss = api.run_round(0)
+    float(loss)  # force the dispatch to completion
+    with donation_audit(api.net) as audit:
+        with_avg = audit.sample()          # old net + round average live
+        api.net = api._server_update(api.net, avg)
+        del avg, loss                      # undonated intermediates freed
+        gc.collect()
+        after = audit.copies()
+    assert with_avg >= after + 0.75, (with_avg, after)
+
+
+def test_fused_step_skipped_for_custom_rounds():
+    """Algorithms outside the 'round' protocol keep the separate path
+    (no silent behavior change): SCAFFOLD's custom round, oort."""
+    from fedml_tpu.algos.scaffold import ScaffoldAPI
+    from fedml_tpu.models.lr import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(160, 13).astype(np.float32)
+    y = (rng.rand(160) > 0.5).astype(np.int32)
+    fed = build_federated_arrays(x, y, partition_homo(160, 8), 16)
+    cfg = FedConfig(client_num_in_total=8, client_num_per_round=4,
+                    comm_round=10, epochs=1, batch_size=16, lr=0.3)
+    sc = ScaffoldAPI(LogisticRegression(num_classes=2),
+                     fed, None, cfg)
+    assert sc._fused_round_step() is None
+
+    api, _ = _lr_setup(client_selection="oort")
+    assert api._fused_round_step() is None
+    assert np.isfinite(api.train_one_round(0)["train_loss"])
+
+
+# ---------------- s2d promotion ---------------------------------------
+
+def test_s2d_first_class_in_registry():
+    from fedml_tpu.models import create_model
+
+    m = create_model("resnet56_s2d", num_classes=10)
+    assert isinstance(m, CifarResNet) and m.stem == "s2d"
+    m20 = create_model("resnet20", num_classes=10, stem="s2d")
+    fns = model_fns(m20)
+    net = fns.init(jax.random.PRNGKey(0), np.zeros((2, 32, 32, 3),
+                                                   np.float32))
+    logits, _ = fns.apply(net, np.zeros((2, 32, 32, 3), np.float32))
+    assert logits.shape == (2, 10)
+    cnn = create_model("cnn", num_classes=62, dropout=False, stem="s2d")
+    fns = model_fns(cnn)
+    net = fns.init(jax.random.PRNGKey(0), np.zeros((2, 28, 28, 1),
+                                                   np.float32))
+    logits, _ = fns.apply(net, np.zeros((2, 28, 28, 1), np.float32))
+    assert logits.shape == (2, 62)

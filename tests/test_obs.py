@@ -123,6 +123,68 @@ def test_model_cost_analysis():
     assert "M params" in s
 
 
+def test_model_cost_pins_the_mfu_denominator():
+    """The r9 MFU headline scalars divide by model_cost's FLOP estimate
+    — audit that denominator two ways, on a conv model AND the
+    transformer: (1) it must equal an INDEPENDENT
+    ``jax.jit(...).lower().compile().cost_analysis()`` of the same
+    forward (same lowering path, so near-exact — 1% tolerance for
+    cost-model jitter across rebuilds); (2) it must sit within a
+    documented 35% band of the hand-derived dominant-term FLOPs (conv
+    MACs / transformer matmul MACs x 2) — XLA's count adds the
+    elementwise/norm traffic the analytic floor omits, so the estimate
+    must be >= the floor and not wildly above it."""
+    import jax
+
+    from fedml_tpu.models import create_model
+    from fedml_tpu.obs import model_cost
+    from fedml_tpu.trainer.local import model_fns
+
+    def direct_flops(model, x):
+        fns = model_fns(model)
+        net = fns.init(jax.random.PRNGKey(0), x)
+
+        def fwd(net, x):
+            return fns.apply(net, x, train=False)[0]
+
+        ca = jax.jit(fwd).lower(net, x).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca["flops"])
+
+    # Conv model: CNNOriginalFedAvg (SAME convs, two pools, two denses).
+    b = 4
+    conv = create_model("cnn", num_classes=62, dropout=False)
+    x = np.zeros((b, 28, 28, 1), np.float32)
+    got = model_cost(conv, x)["flops"]
+    assert got == pytest.approx(direct_flops(conv, x), rel=0.01)
+    def taps(n, k=5):
+        # Valid (non-padded) taps summed over a SAME stride-1 output
+        # row: n*k minus the out-of-bounds corners — XLA's cost model
+        # counts TRUE MACs, not padded ones.
+        half = k // 2
+        return n * k - 2 * sum(range(1, half + 1))
+
+    analytic = b * 2 * (taps(28) * taps(28) * 1 * 32    # conv1 (SAME)
+                        + taps(14) * taps(14) * 32 * 64  # conv2 (SAME)
+                        + 7 * 7 * 64 * 512              # fc1
+                        + 512 * 62)                     # head
+    assert analytic <= got <= analytic * 1.35, (got, analytic)
+
+    # Transformer: the bench's high-MFU proof model family (small dims).
+    t, v, d, h, layers = 64, 256, 64, 4, 2
+    lm = create_model("transformer_lm", vocab_size=v, d_model=d,
+                      n_heads=h, n_layers=layers, max_len=t)
+    xt = np.ones((b, t), np.int32)
+    got_t = model_cost(lm, xt)["flops"]
+    assert got_t == pytest.approx(direct_flops(lm, xt), rel=0.01)
+    per_layer = (4 * d * d            # qkv + out projections
+                 + 2 * 4 * d * d      # mlp (4x expansion, two matmuls)
+                 + 2 * t * d)         # attention scores + mix (per token)
+    analytic_t = b * t * 2 * (layers * per_layer + d * v)  # + lm head
+    assert analytic_t <= got_t <= analytic_t * 1.35, (got_t, analytic_t)
+
+
 def test_post_complete_message_fifo(tmp_path):
     """Reader attached → the completion line arrives; no reader →
     returns without blocking (the reference's blocking open would hang)."""

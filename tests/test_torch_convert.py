@@ -266,3 +266,22 @@ def test_architecture_mismatch_raises():
     sd = {k: v.numpy() for k, v in tm.state_dict().items()}
     with pytest.raises((KeyError, ValueError)):
         convert_torch_cifar_resnet(sd, net, layers=LAYERS)
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(stem="s2d"),                               # registry s2d variant
+    dict(widths=(24, 48, 96), stem_width=24),       # lane-padded-style widths
+])
+def test_non_reference_geometry_refused_loudly(kwargs):
+    """The r9 guard: an s2d-stem or width-overridden net has no
+    reference ``.pth`` mapping BY CONSTRUCTION — the converter must say
+    so up front (naming the stem geometry), not die on a mid-tree shape
+    mismatch."""
+    tm = _randomized(_TorchCifarResNet(LAYERS)).eval()
+    sd = {k: v.numpy() for k, v in tm.state_dict().items()}
+    fns = model_fns(CifarResNet(layers=LAYERS, num_classes=10, norm="bn",
+                                **kwargs))
+    net = fns.init(jax.random.PRNGKey(0),
+                   np.zeros((1, 32, 32, 3), np.float32))
+    with pytest.raises(ValueError, match="cannot map onto the reference"):
+        convert_torch_cifar_resnet(sd, net, layers=LAYERS)
