@@ -842,6 +842,83 @@ def bench_chaos():
     }
 
 
+def bench_wire_codec():
+    """Compressed wire codec A/B (comm/codec.py + streaming ingest):
+    bytes/upload and uploads/s for uncompressed vs bf16 vs int8 vs
+    top-k+error-feedback on the loopback drill with the TENSOR wire
+    round-trip live (bytes actually serialized, ByteLedger counted) and
+    a ChaosTransport composed in (duplication + delay), so compression
+    and fault injection are proven together — a duplicated compressed
+    upload must stay idempotent at the server's streaming accumulator.
+
+    Headline scalars: ``wire_bytes_ratio`` (uncompressed bytes/upload ÷
+    top-k+EF bytes/upload — the bytes-on-wire reduction, acceptance
+    floor 4x) and ``codec_acc_delta`` (top-k arm final accuracy −
+    uncompressed arm; ~0 = compression is accuracy-free on this drill).
+    """
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import FedML_FedAvg_distributed
+    from fedml_tpu.comm.resilience import ChaosSpec
+    from fedml_tpu.data.batching import batch_global, build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.models.lr import LogisticRegression
+
+    # 784-d LR (MNIST-shaped): big enough that frame headers don't mask
+    # the codec's ratio, small enough to jit+run 4 arms in seconds.
+    C, D, K, rounds = 8, 784, 10, 8
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, K, size=C * 64).astype(np.int32)
+    protos = rng.randn(K, D).astype(np.float32)
+    x = 0.8 * protos[y] + rng.randn(len(y), D).astype(np.float32)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), C),
+                                 batch_size=16)
+    test = batch_global(x[:256], y[:256], 64)
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=4,
+                    comm_round=rounds, epochs=1, batch_size=16, lr=0.2,
+                    frequency_of_the_test=1000)
+
+    arms = [("uncompressed", "none"), ("bf16", "bf16"), ("int8", "int8"),
+            ("topk_ef", "topk0.05+int8")]
+    out = {"rounds": rounds, "workers": cfg.client_num_per_round,
+           "model_params": D * K + K, "wire": "tensor",
+           "chaos": "dup_p=0.1 delay_p=0.1"}
+    per_upload = {}
+    for label, spec in arms:
+        _check_section_deadline()
+        t0 = time.perf_counter()
+        # idle_timeout_s bounds the drill: a DELAYED terminal done whose
+        # chaos timer dies with the server's transport close would
+        # otherwise strand that worker's receive loop forever (and with
+        # it this section, past any cap).
+        agg = FedML_FedAvg_distributed(
+            LogisticRegression(num_classes=K), fed, test, cfg,
+            wire_codec=spec, loopback_wire="tensor",
+            chaos=ChaosSpec(seed=11, dup_p=0.1, delay_p=0.1),
+            idle_timeout_s=15.0)
+        dt = time.perf_counter() - t0
+        h = agg.test_history[-1] if agg.test_history else {}
+        uploads = rounds * cfg.client_num_per_round
+        # Uplink bytes: the server's ByteLedger rx total (heartbeats are
+        # off here, so rx ≈ uploads — including chaos duplicates, which
+        # honestly cross the wire twice), from the final health snapshot
+        # the runner stamps on the aggregator.
+        rx = agg.final_health["bytes_rx"]
+        per_upload[label] = rx / max(uploads, 1)
+        out[label] = {
+            "bytes_rx_total": int(rx),
+            "bytes_per_upload": round(per_upload[label], 1),
+            "uploads_per_sec": round(uploads / dt, 2),
+            "final_accuracy": round(float(h.get("accuracy", 0.0)), 4),
+            "duplicate_drops": agg.final_health["duplicate_drops"],
+        }
+    out["wire_bytes_ratio"] = round(
+        per_upload["uncompressed"] / max(per_upload["topk_ef"], 1e-9), 2)
+    out["codec_acc_delta"] = round(
+        out["topk_ef"]["final_accuracy"]
+        - out["uncompressed"]["final_accuracy"], 4)
+    return out
+
+
 def bench_fleet_sim():
     """Serving under churn on the REAL control plane (fedml_tpu.sim):
     one fixed seeded fleet trace — staggered arrivals, diurnal
@@ -1608,6 +1685,7 @@ def main():
                 ("store_windowed_fedopt", bench_store_windowed_fedopt),
                 ("robust_agg", bench_robust_agg),
                 ("chaos", bench_chaos),
+                ("wire_codec", bench_wire_codec),
                 ("fleet_sim", bench_fleet_sim),
                 ("stackoverflow_342k", bench_stackoverflow_342k),
                 ("synthetic_1m", bench_synthetic_1m),
@@ -1765,14 +1843,17 @@ def build_headline(out, full_path="docs/bench_local.json"):
             "store_windowed_rps": _scalar("store_windowed",
                                           "windowed_rounds_per_sec"),
             "store_windowed_speedup": _scalar("store_windowed", "speedup"),
-            "fedopt_windowed_rps": _scalar("store_windowed_fedopt",
-                                           "windowed_rounds_per_sec"),
+            # fedopt_windowed_rps rotated out in r10 (the speedup carries
+            # the carry-protocol story; the rps lives in the full blob)
+            # to fund the wire_codec scalars under the <1KB tail budget.
             "fedopt_windowed_speedup": _scalar("store_windowed_fedopt",
                                                "speedup"),
             "robust_agg_overhead": _scalar("robust_agg",
                                            "robust_agg_overhead"),
             "chaos_clean_overhead": _scalar("chaos",
                                             "chaos_clean_overhead"),
+            "wire_bytes_ratio": _scalar("wire_codec", "wire_bytes_ratio"),
+            "codec_acc_delta": _scalar("wire_codec", "codec_acc_delta"),
             "fleet_buffered_vs_firstk": _scalar(
                 "fleet_sim", "buffered_vs_firstk_throughput"),
             "fleet_buffered_stale_p95_vs_async": _scalar(
@@ -1785,10 +1866,10 @@ def build_headline(out, full_path="docs/bench_local.json"):
             "synthetic_1m_peak_rss_ratio": _scalar("synthetic_1m",
                                                    "peak_rss_ratio"),
             "vit_sps": _scalar("vit_cifar_shaped", "samples_per_sec"),
-            # b128_sps / s2d_b128_sps rotated out in r9 (tuned_best and
-            # the s2d section's MFU pair carry the story) to fund the
-            # layout/fused and MFU scalars under the <1KB tail budget.
-            "s2d_sps": _scalar("resnet56_s2d_stem", "samples_per_sec"),
+            # b128_sps / s2d_b128_sps rotated out in r9, s2d_sps in r10
+            # (tuned_best and the s2d section's MFU pair carry the s2d
+            # story) to fund the layout/fused/MFU and wire_codec scalars
+            # under the <1KB tail budget.
             "fused_speedup": _scalar("layout_fused_round",
                                      "fused_speedup"),
             "layout_pad_ratio": _scalar("layout_fused_round",

@@ -98,6 +98,15 @@ class FedConfig:
     # simulator speed; the cross-silo pipeline's --compress is the real
     # wire-level version with error feedback, fedavg_distributed.py).
     compress: str = "none"
+    # Negotiated wire codec for the MESSAGE-PASSING tiers' uploads
+    # (comm/codec.py): "none", "bf16", "fp16", "int8", "topk<ratio>",
+    # "randmask<ratio>", composable as sparsifier+value (e.g.
+    # "topk0.01+int8"). Sparsifiers carry per-client error feedback;
+    # negotiation rides the init handshake and falls back loudly against
+    # a codec-ignorant peer. The simulator tiers REFUSE this flag (their
+    # on-device analogue is cfg.compress); mutually exclusive with
+    # compress on the cross-silo path.
+    wire_codec: str = "none"
     # Lane-fill compute layout (parallel/layout.py, docs/EXECUTION.md
     # "MFU playbook"): "none", or "auto" — the jitted client step runs a
     # lane-aligned PHYSICAL twin of the model (channel dims padded up to

@@ -48,10 +48,12 @@ from fedml_tpu.algos.fedavg_distributed import (
     MSG_TYPE_SRV_TICK,
     build_federation_setup,
 )
+from fedml_tpu.comm import codec as wire_codec
 from fedml_tpu.comm.loopback import run_workers
 from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.resilience import ChaosSpec, HeartbeatSender
+from fedml_tpu.core.compression import tree_spec
 from fedml_tpu.core.faults import HeartbeatMonitor
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.data.batching import FederatedArrays
@@ -89,6 +91,9 @@ class FedAsyncServerManager(ServerManager):
         self.eval_fn = eval_fn
         self.test_data = test_data
         self.version = 0
+        self.codec_refusals = 0
+        self._spec = tree_spec(net)
+        self._wire_decoders = wire_codec.CodecCache()  # spec → WireCodec
         self.staleness_history: List[int] = []
         # Accepted-upload order, (worker, base_version) per arrival — the
         # aggregation order the trace-determinism tests pin (sim/).
@@ -279,6 +284,7 @@ class FedAsyncServerManager(ServerManager):
             msg.add(MSG_ARG_KEY_CLIENT_INDEX, self._assign_client(worker))
             msg.add(MSG_ARG_KEY_MODEL_VERSION, 0)
             msg.add(MSG_ARG_KEY_TASK_SEQ, self._next_task(worker))
+            msg.add(wire_codec.OFFER_KEY, wire_codec.codec_offer())
             self._last_progress[worker] = self._clock()
             try:
                 self.send_message(msg)
@@ -297,6 +303,7 @@ class FedAsyncServerManager(ServerManager):
         out.add(MSG_ARG_KEY_CLIENT_INDEX, self._assign_client(worker))
         out.add(MSG_ARG_KEY_MODEL_VERSION, self.version)
         out.add(MSG_ARG_KEY_TASK_SEQ, self._next_task(worker))
+        out.add(wire_codec.OFFER_KEY, wire_codec.codec_offer())
         if recovery:
             # Stalled-worker recovery: tell the client which TASK we
             # last ACCEPTED from it, so a worker that is merely SLOW (its
@@ -342,6 +349,34 @@ class FedAsyncServerManager(ServerManager):
                 self.duplicate_drops += 1
                 return
             self._last_upload_task[worker] = task
+        wcodec = msg.get(wire_codec.CODEC_KEY)
+        if wcodec:
+            # Wire-codec frame (comm/codec.py): self-described, decoded
+            # pickle-free against the server's model spec. A corrupt
+            # frame is REFUSED (never mixed); the transport guarantees
+            # frame integrity, so a refusal means a mismatched/corrupt
+            # ENCODER whose every future upload would refuse too —
+            # re-assigning would spin train→refuse→reassign forever.
+            # Evict AND RELEASE the worker (done=True → clean exit);
+            # the run finishes when no members remain (sync-tier
+            # policy, fedavg_distributed.py).
+            try:
+                msg.add(MSG_ARG_KEY_MODEL_PARAMS,
+                        self._wire_decoders.decode(
+                            wcodec, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
+                            self._spec))
+            except (wire_codec.CodecError, ValueError) as err:
+                self.codec_refusals += 1
+                log.error("rank %d: codec %r frame refused (%s) — "
+                          "evicting and releasing the worker (a "
+                          "mismatched encoder can never upload a "
+                          "usable model)", worker, wcodec, err)
+                with self._lock:
+                    if worker in self._members:
+                        self._members.discard(worker)
+                        self.evictions += 1
+                self._send_done(worker)  # release; finishes when empty
+                return
         staleness = self.version - base_ver
         self.staleness_history.append(staleness)
         self.arrival_log.append((worker, base_ver))
@@ -379,8 +414,15 @@ class FedAsyncClientManager(ClientManager):
     server's bounded-termination watchdog sees it alive, and self-
     terminates after ``idle_timeout_s`` without server contact."""
 
+    #: Whether ``_upload_payload`` ships a DELTA against the pulled model
+    #: (fedbuff) or the full trained model (async). Sparsifying codecs
+    #: are only sound on deltas — top-k of full weights would zero most
+    #: of the model — so the constructor gates on this.
+    _payload_is_delta = False
+
     def __init__(self, args, rank: int, size: int, train_fed: FederatedArrays,
-                 local_train, cfg: FedConfig, backend: str = "LOOPBACK", *,
+                 local_train, cfg: FedConfig, backend: str = "LOOPBACK",
+                 wire_codec_spec: str = "none", *,
                  beat_interval_s: Optional[float] = None,
                  idle_timeout_s: float = 0.0):
         super().__init__(args, rank=rank, size=size, backend=backend)
@@ -390,6 +432,20 @@ class FedAsyncClientManager(ClientManager):
         self.steps = 0
         self.duplicate_drops = 0
         self.upload_resends = 0
+        # Wire codec (comm/codec.py), negotiated against the server's
+        # handshake offer on the first assignment. Validated eagerly.
+        probe = wire_codec.make_wire_codec(wire_codec_spec)
+        if probe.error_feedback and not self._payload_is_delta:
+            raise ValueError(
+                f"wire codec {wire_codec_spec!r}: sparsifying codecs need "
+                "delta uploads — the async tier ships full models (use "
+                "bf16/fp16/int8 here, or the FedBuff tier for top-k/"
+                "randmask with error feedback)")
+        self._codec_requested = wire_codec_spec or "none"
+        self._codec = None  # set by negotiation on the first assignment
+        # Per-worker error-feedback residual: the async tiers' EF stream
+        # is the worker's own upload sequence (one delta per assignment).
+        self._ef_residual = None
         # Assigned TASK ids strictly increase, so an assignment at or
         # below the high-water mark is a transport duplicate — dropped
         # without retraining (the sync client's round dedupe, keyed on
@@ -457,6 +513,12 @@ class FedAsyncClientManager(ClientManager):
             self.duplicate_drops += 1
             return
         self._last_task = task
+        if self._codec is None:
+            # Negotiate once per connection against the server's offer
+            # (absent offer = codec-ignorant peer → loud fallback).
+            self._codec = wire_codec.negotiated_codec(
+                self._codec_requested, msg.get(wire_codec.OFFER_KEY),
+                peer="server")
         rng = jax.random.fold_in(
             jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), self.steps),
             self.rank)
@@ -467,7 +529,16 @@ class FedAsyncClientManager(ClientManager):
             self.train_fed.x[c], self.train_fed.y[c], self.train_fed.mask[c],
             rng)
         out = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
-        out.add(MSG_ARG_KEY_MODEL_PARAMS, self._upload_payload(net, global_net))
+        payload = self._upload_payload(net, global_net)
+        if self._codec is not None and self._codec.name != "none":
+            # Frame seed keyed on (run seed, rank, task): a cached resend
+            # re-ships identical bytes; every new task gets fresh
+            # stochastic rounding / mask draws.
+            payload, self._ef_residual = self._codec.encode(
+                payload, self._ef_residual,
+                wire_codec.frame_seed(self.cfg.seed, self.rank, task))
+            out.add(wire_codec.CODEC_KEY, self._codec.name)
+        out.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
         out.add(MSG_ARG_KEY_NUM_SAMPLES, int(self.train_fed.counts[c]))
         out.add(MSG_ARG_KEY_MODEL_VERSION, version)
         out.add(MSG_ARG_KEY_TASK_SEQ, task)
@@ -493,6 +564,8 @@ def FedML_FedAsync_distributed(
     alpha: float = 0.6,
     staleness_exp: float = 0.5,
     *,
+    wire_codec: str = "none",
+    loopback_wire: str = "none",
     chaos: Optional[ChaosSpec] = None,
     done_timeout_s: Optional[float] = None,
     idle_timeout_s: float = 0.0,
@@ -502,16 +575,20 @@ def FedML_FedAsync_distributed(
     workers. Returns the server manager (net, staleness/test history).
     ``done_timeout_s`` (default ``cfg.round_timeout_s``) bounds the
     terminal handshake against crash-stop workers; ``chaos`` installs the
-    fleet-wide fault-injecting transport."""
+    fleet-wide fault-injecting transport; ``wire_codec`` compresses the
+    uploads (full models here, so casts/quantization only — comm/codec.py)
+    and ``loopback_wire`` makes loopback serialize for real."""
     size, net0, local_train, eval_fn, args = build_federation_setup(
-        model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos)
+        model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos,
+        loopback_wire=loopback_wire)
     server = FedAsyncServerManager(args, net0, cfg, size, backend=backend,
                                    alpha=alpha, staleness_exp=staleness_exp,
                                    eval_fn=eval_fn, test_data=test_global,
                                    done_timeout_s=done_timeout_s)
     clients = [
         FedAsyncClientManager(args, rank, size, train_fed, local_train, cfg,
-                              backend=backend, idle_timeout_s=idle_timeout_s)
+                              backend=backend, wire_codec_spec=wire_codec,
+                              idle_timeout_s=idle_timeout_s)
         for rank in range(1, size)
     ]
     run_workers([server.run] + [c.run for c in clients])

@@ -125,6 +125,15 @@ class FedAvgAPI(FederatedLoop):
                 "batch_size as the config"
             )
 
+        if getattr(cfg, "wire_codec", "none") not in ("", "none"):
+            # PR 4 convention: refuse a flag nothing here reads. The
+            # simulator's on-device analogue is cfg.compress; the wire
+            # codec belongs to the message-passing tiers.
+            raise NotImplementedError(
+                f"cfg.wire_codec={cfg.wire_codec!r} is a message-passing-"
+                "tier capability (cross-silo / FedAsync / FedBuff, "
+                "comm/codec.py); the simulator tiers compress on device "
+                "via cfg.compress")
         self._loss_fn = loss_fn
         self._nan_guard = nan_guard
         # Byzantine-robust server aggregation (core/robust_agg): resolved

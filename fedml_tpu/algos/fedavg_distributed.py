@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.comm import codec as wire_codec
 from fedml_tpu.comm.loopback import LoopbackNetwork, run_workers
 from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message
@@ -60,7 +61,7 @@ from fedml_tpu.comm.resilience import ChaosSpec, HeartbeatSender
 from fedml_tpu.core.compression import make_compressor, tree_spec
 from fedml_tpu.core.faults import HeartbeatMonitor
 from fedml_tpu.core.sampling import sample_clients
-from fedml_tpu.core.tree import tree_scale, tree_add, tree_sub
+from fedml_tpu.core.tree import tree_add, tree_sub
 from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.trainer.local import (
     make_client_optimizer,
@@ -87,47 +88,130 @@ log = logging.getLogger(__name__)
 
 
 class FedAVGAggregator:
-    """Server state: buffer per-worker results, weighted-average when the
-    round completes (FedAVGAggregator.py:44-88; arrival counting lives in
-    the server manager's ``_arrived`` set, which also covers the first-k
-    straggler-tolerant mode)."""
+    """Server state with STREAMING ingest: every accepted upload is folded
+    into an O(model) weighted accumulator ON ARRIVAL (the generalization
+    of fedbuff's accumulate-on-arrival fast path), so mean aggregation
+    holds one model-sized buffer regardless of the fleet size — the
+    server ingest path is the engineering bottleneck at scale
+    (arXiv:2307.06561). The reference instead buffers every worker's full
+    model and reduces at the round barrier (FedAVGAggregator.py:44-88),
+    O(clients x model) server memory.
+
+    A non-mean ``aggregator`` spec (:func:`core.robust_agg.make_aggregator`
+    — coord_median, trimmed mean, Krum, geometric median) needs the
+    cohort side by side, so that path alone retains the stack-then-reduce
+    buffer (O(cohort x model)); arrival counting lives in the server
+    manager's ``_arrived`` set, which also covers the first-k
+    straggler-tolerant mode. ``live_model_buffers`` is the O(model) pin's
+    observable, audited by tests/test_wire_codec.py."""
 
     def __init__(self, net, worker_num: int, cfg: FedConfig, eval_fn=None,
-                 test_data=None):
+                 test_data=None, aggregator: str = "mean"):
+        from fedml_tpu.core.robust_agg import make_aggregator
+
         self.net = net
         self.worker_num = worker_num
         self.cfg = cfg
         self.eval_fn = eval_fn
         self.test_data = test_data
-        self.model_dict: Dict[int, object] = {}
+        self.aggregator = make_aggregator(aggregator)
+        self.model_dict: Dict[int, object] = {}  # non-mean stack path ONLY
         self.sample_num_dict: Dict[int, float] = {}
         self.test_history: List[dict] = []
+        # Stamped by FedML_FedAvg_distributed after the run: the server's
+        # final health() snapshot (control-plane counters + byte ledger).
+        self.final_health: Dict[str, int] = {}
+        # Mean fast path: running sample-weighted sum + weight, O(model).
+        self._acc = None
+        self._wsum = 0.0
+        self._acc_indices: Set[int] = set()
+        self._accum = jax.jit(
+            lambda acc, p, w: jax.tree.map(
+                lambda a_, p_: a_ + w * jnp.asarray(p_, jnp.float32),
+                acc, p))
+        self._lift = jax.jit(
+            lambda p, w: jax.tree.map(
+                lambda p_: w * jnp.asarray(p_, jnp.float32), p))
+        self._finalize = jax.jit(
+            lambda ref, acc, inv: jax.tree.map(
+                lambda r_, a_: (inv * a_).astype(jnp.asarray(r_).dtype),
+                ref, acc))
+
+    @property
+    def live_model_buffers(self) -> int:
+        """Model-sized trees the ingest path holds RIGHT NOW: the running
+        accumulator counts one; only the non-mean stack path ever counts
+        more. The streaming-memory tests pin this at <= 1 on the mean
+        path with any number of arrivals."""
+        return (1 if self._acc is not None else 0) + len(self.model_dict)
 
     def add_local_trained_result(self, index: int, model_params, sample_num) -> None:
-        self.model_dict[index] = model_params
-        self.sample_num_dict[index] = float(sample_num)
+        w = float(sample_num)
+        if self.aggregator.is_mean:
+            if index in self._acc_indices:
+                # Idempotent ingest: the manager's round high-water mark
+                # already dedupes wire duplicates; this guards direct
+                # callers — a streamed accumulator cannot "overwrite" the
+                # way the old per-slot dict silently did.
+                return
+            self._acc_indices.add(index)
+            self.sample_num_dict[index] = w
+            self._acc = (self._lift(model_params, jnp.float32(w))
+                         if self._acc is None
+                         else self._accum(self._acc, model_params,
+                                          jnp.float32(w)))
+            self._wsum += w
+        else:
+            self.model_dict[index] = model_params
+            self.sample_num_dict[index] = w
 
     def aggregate(self):
         return self.aggregate_from(range(self.worker_num))
 
     def aggregate_from(self, indices):
-        """Weighted average over a subset of worker slots — the first-k
+        """Aggregate over a subset of worker slots — the first-k
         straggler-tolerant mode aggregates only the workers that uploaded
         fresh results this round. An EMPTY index set (every sampled
         worker evicted/excluded) keeps the previous global net, mirroring
         ``_robust_avg``'s all-excluded behavior — ``self.net = None``
-        here would poison every later round."""
+        here would poison every later round.
+
+        On the streaming mean path the set must equal the accumulated
+        arrivals (the protocol guarantees it: uploads are accepted and
+        accumulated exactly for the ``_arrived`` set) — an O(model)
+        accumulator cannot subset post-hoc, so a mismatch is a protocol
+        bug and raises instead of silently mis-weighting."""
         indices = list(indices)
         if not indices:
             return self.net
-        total = sum(self.sample_num_dict[i] for i in indices)
-        avg = None
+        if self.aggregator.is_mean:
+            if set(indices) != self._acc_indices:
+                raise ValueError(
+                    f"streaming ingest accumulated workers "
+                    f"{sorted(self._acc_indices)} but was asked to "
+                    f"aggregate {sorted(indices)}: the O(model) mean path "
+                    "cannot subset after arrival")
+            self.net = self._finalize(self.net, self._acc,
+                                      jnp.float32(1.0 / max(self._wsum,
+                                                            1e-12)))
+            self._acc = None
+            self._wsum = 0.0
+            self._acc_indices = set()
+            return self.net
+        # Robust path: the cohort side by side (weights gate participation
+        # in the order statistics, value-weight the mean-like reducers).
+        weights = jnp.asarray([self.sample_num_dict[i] for i in indices],
+                              jnp.float32)
+        stacked = jax.tree.map(
+            lambda *ls: jnp.stack([jnp.asarray(l, jnp.float32) for l in ls]),
+            *[self.model_dict[i] for i in indices])
+        agg = self.aggregator(stacked, weights)
+        self.net = jax.tree.map(
+            lambda r_, a_: jnp.asarray(a_).astype(jnp.asarray(r_).dtype),
+            self.net, agg)
         for i in indices:
-            w = self.sample_num_dict[i] / max(total, 1e-12)
-            scaled = tree_scale(self.model_dict[i], w)
-            avg = scaled if avg is None else tree_add(avg, scaled)
-        self.net = avg
-        return avg
+            self.model_dict.pop(i, None)
+        return self.net
 
     def client_sampling(self, round_idx: int) -> np.ndarray:
         return sample_clients(
@@ -186,6 +270,7 @@ class FedAVGServerManager(ServerManager):
         self.straggler_drops = 0
         self.duplicate_drops = 0
         self.epoch_drops = 0
+        self.codec_refusals = 0
         self.evictions = 0
         self.readmissions = 0
         self.aborted = False
@@ -205,7 +290,8 @@ class FedAVGServerManager(ServerManager):
             timeout_s=(heartbeat_timeout_s if heartbeat_timeout_s is not None
                        else (self.round_timeout_s or 30.0)),
             clock=clock)
-        self._decoders = {}  # codec name → compressor (built lazily)
+        self._decoders = {}  # legacy compressor name → compressor
+        self._wire_decoders = wire_codec.CodecCache()  # spec → WireCodec
         self._spec = tree_spec(aggregator.net)
         # Crash-resume: restore the latest checkpoint (if any) and run
         # under a BUMPED epoch — every message carries it, so pre-crash
@@ -279,6 +365,7 @@ class FedAVGServerManager(ServerManager):
             msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[worker - 1]))
             msg.add("round", self.round_idx)
             msg.add("epoch", self.epoch)
+            msg.add(wire_codec.OFFER_KEY, wire_codec.codec_offer())
             self._safe_send(msg, worker)
 
     def register_message_receive_handlers(self) -> None:
@@ -309,7 +396,11 @@ class FedAVGServerManager(ServerManager):
 
     def health(self) -> Dict[str, int]:
         """Control-plane counters, surfaced per round through the metrics
-        logger and asserted on by the fault drills."""
+        logger and asserted on by the fault drills. ``bytes_tx``/
+        ``bytes_rx`` are the transport's ByteLedger totals (comm/wire.py)
+        — bytes-on-wire observability for the codec A/B; 0 on backends
+        without wire serialization (plain in-memory loopback)."""
+        ledger = getattr(self.com_manager, "bytes_ledger", None)
         with self._lock:
             return {
                 "members": len(self._members),
@@ -318,8 +409,11 @@ class FedAVGServerManager(ServerManager):
                 "straggler_drops": self.straggler_drops,
                 "duplicate_drops": self.duplicate_drops,
                 "epoch_drops": self.epoch_drops,
+                "codec_refusals": self.codec_refusals,
                 "epoch": self.epoch,
                 "send_retries": getattr(self.com_manager, "retry_count", 0),
+                "bytes_tx": ledger.total_tx if ledger is not None else 0,
+                "bytes_rx": ledger.total_rx if ledger is not None else 0,
             }
 
     # -- fault-aware sends --------------------------------------------------
@@ -371,6 +465,9 @@ class FedAVGServerManager(ServerManager):
         out.add("round", self.round_idx)
         out.add("done", False)
         out.add("epoch", self.epoch)
+        # Negotiation rides every assignment (not just init): a worker
+        # re-admitted after the init was lost still learns the offer.
+        out.add(wire_codec.OFFER_KEY, wire_codec.codec_offer())
         if resend:
             # Re-admission: the worker's upload (or our assignment) was
             # lost — a client that already trained this round should
@@ -542,6 +639,7 @@ class FedAVGServerManager(ServerManager):
             return
         payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         codec = msg.get("compression")
+        wcodec = msg.get(wire_codec.CODEC_KEY)
         if codec:
             # Dispatch on the frame's self-described codec, not a server
             # flag: per-rank launches may configure compression on the
@@ -549,6 +647,43 @@ class FedAVGServerManager(ServerManager):
             if codec not in self._decoders:
                 self._decoders[codec] = make_compressor(codec)
             delta = self._decoders[codec].decode(payload, self._spec)
+            payload = tree_add(self._broadcast_net, delta)
+        elif wcodec:
+            # Wire-codec frame (comm/codec.py): same self-description
+            # discipline, pickle-free numpy decode, and a REFUSAL (not a
+            # crash, not a silent zero) on a corrupt/truncated frame.
+            try:
+                delta = self._wire_decoders.decode(wcodec, payload,
+                                                   self._spec)
+            except (wire_codec.CodecError, ValueError) as err:
+                # The transport already guarantees frame integrity, so a
+                # refusal means a mismatched/corrupt ENCODER — every
+                # upload from that rank would refuse forever (resends
+                # are bit-identical by frame_seed), so neither waiting
+                # nor re-assigning can ever recover it. Evict AND
+                # RELEASE the worker (done=True → it exits instead of
+                # blocking on its receive loop under the default
+                # round_timeout_s=0, or churning through heartbeat
+                # re-admission), then complete the round over the
+                # survivors — or abort when nobody remains.
+                self.codec_refusals += 1
+                log.error("rank %d: codec %r frame refused (%s) — "
+                          "evicting and releasing the worker (a "
+                          "mismatched encoder can never upload a usable "
+                          "model)", sender, wcodec, err)
+                self._evict([sender])
+                with self._lock:
+                    empty = not self._members
+                    ready = bool(self._arrived) and (
+                        len(self._arrived) >= self._k_effective())
+                if empty:
+                    log.error("all workers refused/evicted at round %d:"
+                              " abandoning the run", self.round_idx)
+                    self.aborted = True
+                self._send_done(sender)  # release; finishes when empty
+                if not empty and ready:
+                    self._complete_round()
+                return
             payload = tree_add(self._broadcast_net, delta)
         self.aggregator.add_local_trained_result(
             sender - 1, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES)
@@ -603,7 +738,7 @@ class FedAVGClientManager(ClientManager):
 
     def __init__(self, args, rank: int, size: int, train_fed: FederatedArrays,
                  local_train, cfg: FedConfig, backend: str = "LOOPBACK",
-                 compress: str = "none", *,
+                 compress: str = "none", wire_codec_spec: str = "none", *,
                  beat_interval_s: Optional[float] = None,
                  idle_timeout_s: float = 0.0):
         super().__init__(args, rank=rank, size=size, backend=backend)
@@ -615,6 +750,19 @@ class FedAVGClientManager(ClientManager):
         self.duplicate_drops = 0
         self.upload_resends = 0
         self._last_handled = -1
+        # Wire codec (comm/codec.py): the REQUESTED spec, resolved against
+        # the server's handshake offer on the first assignment (negotiated
+        # per connection; a codec-ignorant server drops us to the plain
+        # tensor wire, loudly). Validated eagerly — a typo must fail at
+        # construction, not at the first upload.
+        if wire_codec_spec not in ("", "none") and compress not in ("",
+                                                                    "none"):
+            raise ValueError(
+                "compress and wire_codec are mutually exclusive (both "
+                "would compress the same upload)")
+        wire_codec.make_wire_codec(wire_codec_spec)
+        self._codec_requested = wire_codec_spec or "none"
+        self._codec = None  # set by negotiation on the first assignment
         # The last upload message, kept until the NEXT round's assignment
         # arrives: a RESEND-flagged re-assignment of the round we already
         # trained means our upload was lost in transit (the server flags
@@ -723,6 +871,14 @@ class FedAVGClientManager(ClientManager):
             self.round_idx = t
         else:
             self.round_idx += 1
+        if self._codec is None:
+            # Negotiate once per connection, on the first live assignment:
+            # the server's offer (or its absence — a codec-ignorant peer)
+            # decides whether the requested codec runs or we fall back to
+            # the uncompressed tensor wire, loudly (comm/codec.py).
+            self._codec = wire_codec.negotiated_codec(
+                self._codec_requested, msg.get(wire_codec.OFFER_KEY),
+                peer="server")
         self._train(msg.get(MSG_ARG_KEY_MODEL_PARAMS), msg.get(MSG_ARG_KEY_CLIENT_INDEX))
 
     def _train(self, global_net, client_index: int) -> None:
@@ -737,18 +893,32 @@ class FedAVGClientManager(ClientManager):
             rng,
         )
         out = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
-        if self._compressor.name != "none":
+        codec = (self._codec if self._codec is not None
+                 and self._codec.name != "none" else None)
+        if self._compressor.name != "none" or codec is not None:
             delta = tree_sub(net, global_net)
-            rng_c = jax.random.fold_in(rng, 0xC0)
             prev = self._ef_state
             carry = (prev[2] if prev and prev[0] == self.round_idx - 1
                      and prev[1] == c else None)
             if prev is not None and carry is None and prev[2] is not None:
                 self.ef_carry_drops += 1
-            payload, residual = self._compressor.encode(delta, carry, rng_c)
+            if codec is not None:
+                # Frame seed keyed on (run seed, epoch, round, client):
+                # deterministic — a cached RESEND re-ships identical
+                # bytes — and fresh per round for the stochastic
+                # rounding / mask expansion.
+                payload, residual = codec.encode(
+                    jax.device_get(delta), carry,
+                    wire_codec.frame_seed(self.cfg.seed, self.epoch,
+                                          self.round_idx, c))
+                out.add(wire_codec.CODEC_KEY, codec.name)
+            else:
+                rng_c = jax.random.fold_in(rng, 0xC0)
+                payload, residual = self._compressor.encode(delta, carry,
+                                                            rng_c)
+                out.add("compression", self._compressor.name)
             self._ef_state = (self.round_idx, c, residual)
             out.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
-            out.add("compression", self._compressor.name)
         else:
             out.add(MSG_ARG_KEY_MODEL_PARAMS, jax.device_get(net))
         out.add(MSG_ARG_KEY_NUM_SAMPLES, int(self.train_fed.counts[c]))
@@ -765,11 +935,16 @@ class FedAVGClientManager(ClientManager):
 
 def build_federation_setup(model, train_fed: FederatedArrays, test_global,
                            cfg: FedConfig, backend: str, loss_fn,
-                           chaos: Optional[ChaosSpec] = None):
+                           chaos: Optional[ChaosSpec] = None,
+                           loopback_wire: str = "none"):
     """Shared worker-process scaffolding for the message-passing
     federations (sync FedAvg here, async in fedasync.py): model fns +
     initial net, jitted local trainer / eval, and the backend ``args``
-    shim (``chaos`` installs a fleet-wide ChaosTransport wrapper).
+    shim (``chaos`` installs a fleet-wide ChaosTransport wrapper;
+    ``loopback_wire`` makes the LOOPBACK backend round-trip every message
+    through that real wire format — bytes in the inboxes, ByteLedger
+    counters live — so single-host drills measure bytes-on-wire and
+    exercise the full serialize path).
     Returns ``(size, net0, local_train, eval_fn, args)``."""
     size = cfg.client_num_per_round + 1
     if getattr(cfg, "compute_layout", "none") not in ("none", ""):
@@ -796,7 +971,7 @@ def build_federation_setup(model, train_fed: FederatedArrays, test_global,
     args = Args()
     args.chaos = chaos
     if backend == "LOOPBACK":
-        args.network = LoopbackNetwork(size)
+        args.network = LoopbackNetwork(size, wire=loopback_wire)
     elif backend == "SIM":
         # Virtual-clock fleet simulation: the FleetSimulator installs
         # args.network (a sim.transport.SimNetwork) and args.chaos_after
@@ -821,6 +996,9 @@ def FedML_FedAvg_distributed(
     compress: str = "none",
     aggregate_k: int = 0,
     *,
+    wire_codec: str = "none",
+    loopback_wire: str = "none",
+    aggregator: str = "mean",
     chaos: Optional[ChaosSpec] = None,
     checkpoint_dir: Optional[str] = None,
     metrics=None,
@@ -830,9 +1008,20 @@ def FedML_FedAvg_distributed(
     and run the full federation (FedAvgAPI.py:20 analogue). Returns the
     aggregator (global model + test history).
 
-    ``compress``: update compression for the client→server uploads —
-    ``none`` | ``topk<ratio>`` (error feedback) | ``q<bits>`` (stochastic
-    quantization); see fedml_tpu.core.compression.
+    ``compress``: legacy on-device update compression for the
+    client→server uploads — ``none`` | ``topk<ratio>`` (error feedback) |
+    ``q<bits>`` (stochastic quantization); see fedml_tpu.core.compression.
+
+    ``wire_codec``: the NEGOTIATED wire codec (comm/codec.py) — ``none``
+    | ``bf16`` | ``fp16`` | ``int8`` | ``topk<ratio>`` |
+    ``randmask<ratio>``, composable as ``sparsifier+value`` (e.g.
+    ``topk0.01+int8``); sparsifiers carry per-client error feedback.
+    Mutually exclusive with ``compress``. ``loopback_wire`` round-trips
+    loopback messages through a real wire format (bytes + ByteLedger).
+
+    ``aggregator``: server reduction (core/robust_agg spec). ``mean``
+    keeps the O(model) accumulate-on-arrival streaming ingest; non-mean
+    robust aggregators retain the stack-then-reduce cohort buffer.
 
     ``aggregate_k``: straggler-tolerant first-k rounds (0 = wait for all
     workers; see FedAVGServerManager).
@@ -844,17 +1033,25 @@ def FedML_FedAvg_distributed(
     MetricsLogger for per-round health counters, ``idle_timeout_s`` the
     workers' no-server-contact self-termination bound."""
     size, net0, local_train, eval_fn, args = build_federation_setup(
-        model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos)
-    aggregator = FedAVGAggregator(net0, size - 1, cfg, eval_fn, test_global)
-    server = FedAVGServerManager(args, aggregator, cfg, size, backend=backend,
+        model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos,
+        loopback_wire=loopback_wire)
+    agg = FedAVGAggregator(net0, size - 1, cfg, eval_fn, test_global,
+                           aggregator=aggregator)
+    server = FedAVGServerManager(args, agg, cfg, size, backend=backend,
                                  compress=compress, aggregate_k=aggregate_k,
                                  checkpoint_dir=checkpoint_dir,
                                  metrics=metrics)
     clients = [
         FedAVGClientManager(args, rank, size, train_fed, local_train, cfg,
                             backend=backend, compress=compress,
+                            wire_codec_spec=wire_codec,
                             idle_timeout_s=idle_timeout_s)
         for rank in range(1, size)
     ]
     run_workers([server.run] + [c.run for c in clients])
-    return aggregator
+    # Post-run observability: the managers are finished but callers (the
+    # wire_codec bench A/B, drill tests) still need the control-plane
+    # counters and ByteLedger totals — stamp the final health snapshot
+    # onto the returned aggregator.
+    agg.final_health = server.health()
+    return agg

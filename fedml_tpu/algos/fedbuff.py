@@ -199,10 +199,15 @@ class FedBuffServerManager(FedAsyncServerManager):
 class FedBuffClientManager(FedAsyncClientManager):
     """The async client with a delta wire format: uploads
     ``net - global_received`` (the update against the exact model it
-    trained from). ``corruptor`` (a :class:`core.faults.UpdateCorruptor`)
-    marks this rank Byzantine for attack-vs-defense drills: the trained
-    model is corrupted BEFORE the delta is formed — the same threat
-    order as the windowed tier's device-side drill."""
+    trained from). Because the payload IS a delta, the full wire-codec
+    menu applies — including top-k/randmask with per-worker error
+    feedback (the async base refuses sparsifiers on full-model uploads).
+    ``corruptor`` (a :class:`core.faults.UpdateCorruptor`) marks this
+    rank Byzantine for attack-vs-defense drills: the trained model is
+    corrupted BEFORE the delta is formed — the same threat order as the
+    windowed tier's device-side drill."""
+
+    _payload_is_delta = True
 
     def __init__(self, *args_, corruptor=None, **kw):
         super().__init__(*args_, **kw)
@@ -226,6 +231,8 @@ def FedML_FedBuff_distributed(
     buffer_k: int = 2,
     aggregator="mean",
     *,
+    wire_codec: str = "none",
+    loopback_wire: str = "none",
     chaos: Optional[ChaosSpec] = None,
     done_timeout_s: Optional[float] = None,
     idle_timeout_s: float = 0.0,
@@ -239,7 +246,8 @@ def FedML_FedBuff_distributed(
     ``corruptor`` flag Byzantine workers for drills; ``aggregator`` is
     the server-side defense (core/robust_agg spec)."""
     size, net0, local_train, eval_fn, args = build_federation_setup(
-        model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos)
+        model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos,
+        loopback_wire=loopback_wire)
     server = FedBuffServerManager(
         args, net0, cfg, size, backend=backend, alpha=alpha,
         staleness_exp=staleness_exp, buffer_k=buffer_k,
@@ -247,7 +255,8 @@ def FedML_FedBuff_distributed(
         done_timeout_s=done_timeout_s)
     clients = [
         FedBuffClientManager(args, rank, size, train_fed, local_train, cfg,
-                             backend=backend, idle_timeout_s=idle_timeout_s,
+                             backend=backend, wire_codec_spec=wire_codec,
+                             idle_timeout_s=idle_timeout_s,
                              corruptor=(corruptor if rank in set(corrupt_ranks)
                                         else None))
         for rank in range(1, size)

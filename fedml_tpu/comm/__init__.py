@@ -28,6 +28,13 @@ partition) over any backend, enabled fleet-wide via ``args.chaos``.
 
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.codec import (
+    CodecError,
+    WireCodec,
+    codec_offer,
+    make_wire_codec,
+    negotiate,
+)
 from fedml_tpu.comm.loopback import LoopbackNetwork, LoopbackCommManager
 from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.resilience import (
@@ -37,11 +44,18 @@ from fedml_tpu.comm.resilience import (
     RetryGiveUp,
     RetryPolicy,
 )
+from fedml_tpu.comm.wire import ByteLedger
 
 __all__ = [
     "Message",
     "BaseCommunicationManager",
     "Observer",
+    "ByteLedger",
+    "CodecError",
+    "WireCodec",
+    "codec_offer",
+    "make_wire_codec",
+    "negotiate",
     "LoopbackNetwork",
     "LoopbackCommManager",
     "ClientManager",

@@ -32,7 +32,8 @@ from typing import Dict, List, Optional, Tuple
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.resilience import RetryPolicy
-from fedml_tpu.comm.wire import WIRE_FORMATS, deserialize_message, serialize_message
+from fedml_tpu.comm.wire import (ByteLedger, WIRE_FORMATS,
+                                 deserialize_message, serialize_message)
 
 SERVICE_NAME = "fedml.tpu.CommService"
 METHOD_NAME = "SendMessage"
@@ -158,6 +159,7 @@ class GrpcCommManager(BaseCommunicationManager):
             seed=rank, attempt_timeout_s=120.0)
         self.rank = rank
         self.ip_config = ip_config
+        self.bytes_ledger = ByteLedger()
         self._queue: "queue.Queue[bytes]" = queue.Queue()
         self._observers: List[Observer] = []
         self._running = False
@@ -251,6 +253,9 @@ class GrpcCommManager(BaseCommunicationManager):
                                     policy.attempt_timeout_s or 120.0),
             retriable=lambda e: getattr(e, "retriable", False),
             describe=f"grpc send rank {self.rank} -> {receiver}")
+        # Whole CommRequest frame (payload + proto envelope): what gRPC
+        # actually puts on the wire, modulo HTTP/2 framing.
+        self.bytes_ledger.count_tx(receiver, len(frame))
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -280,7 +285,8 @@ class GrpcCommManager(BaseCommunicationManager):
             except queue.Empty:
                 continue
             try:
-                _, payload, wire = decode_comm_request(frame)
+                sender, payload, wire = decode_comm_request(frame)
+                self.bytes_ledger.count_rx(sender, len(frame))
                 if wire != self._serializer:
                     log.warning(
                         "rank %d: dropping frame with wire format %r "

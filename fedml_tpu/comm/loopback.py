@@ -19,18 +19,31 @@ from typing import Dict, List
 
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
 from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.wire import (ByteLedger, WIRE_FORMATS,
+                                 deserialize_message, serialize_message)
 
 _STOP = object()
 
 
 class LoopbackNetwork:
-    """Shared router: one inbox per rank. Thread-safe."""
+    """Shared router: one inbox per rank. Thread-safe.
 
-    def __init__(self, size: int):
+    ``wire`` (default ``"none"``): with a real wire format name
+    (``tensor`` | ``json`` | ``pickle``) every message is serialized by
+    the sender and deserialized by the receiver — BYTES sit in the
+    inboxes, each manager's :class:`ByteLedger` counts them, and the
+    single-host drill exercises the exact frame code the socket backends
+    ship. The default keeps delivery by reference (the fastest possible
+    transport, zero serialization)."""
+
+    def __init__(self, size: int, wire: str = "none"):
+        if wire not in ("none",) + WIRE_FORMATS:
+            raise ValueError(f"unknown loopback wire format {wire!r}")
         self.size = size
+        self.wire = wire
         self._inboxes: List[queue.Queue] = [queue.Queue() for _ in range(size)]
 
-    def post(self, receiver_id: int, msg: Message) -> None:
+    def post(self, receiver_id: int, msg) -> None:
         self._inboxes[receiver_id].put(msg)
 
     def inbox(self, rank: int) -> queue.Queue:
@@ -42,12 +55,19 @@ class LoopbackCommManager(BaseCommunicationManager):
         self.network = network
         self.rank = rank
         self.size = network.size
+        self.bytes_ledger = ByteLedger()
         self._observers: List[Observer] = []
         self._running = False
         self._stop_requested = False
 
     def send_message(self, msg: Message) -> None:
-        self.network.post(int(msg.get_receiver_id()), msg)
+        receiver = int(msg.get_receiver_id())
+        if self.network.wire != "none":
+            blob = serialize_message(msg, self.network.wire)
+            self.bytes_ledger.count_tx(receiver, len(blob))
+            self.network.post(receiver, blob)
+            return
+        self.network.post(receiver, msg)
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -64,6 +84,10 @@ class LoopbackCommManager(BaseCommunicationManager):
             msg = inbox.get()
             if msg is _STOP:
                 break
+            if isinstance(msg, (bytes, bytearray)):  # wire round-trip mode
+                nbytes = len(msg)
+                msg = deserialize_message(msg, self.network.wire)
+                self.bytes_ledger.count_rx(int(msg.get_sender_id()), nbytes)
             for obs in list(self._observers):
                 obs.receive_message(msg.get_type(), msg)
 

@@ -117,10 +117,25 @@ def _jsonify(obj):
     return obj
 
 
+def _np_dtype(name: str):
+    """np.dtype with the ml_dtypes fallback (bfloat16 etc., registered
+    by jax's dependency set): the wire-codec bf16 frames put bfloat16
+    arrays into Message payloads, and a bare ``np.dtype('bfloat16')``
+    raises in a process that never imported ml_dtypes."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def _unjsonify(obj):
     if isinstance(obj, dict):
         if "__nd__" in obj:
-            return np.asarray(obj["data"], dtype=obj["dtype"]).reshape(obj["__nd__"])
+            return np.asarray(
+                obj["data"], dtype=_np_dtype(obj["dtype"])
+            ).reshape(obj["__nd__"])
         return {k: _unjsonify(v) for k, v in obj.items()}
     if isinstance(obj, list):
         return [_unjsonify(v) for v in obj]

@@ -20,6 +20,7 @@ from typing import List
 
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
 from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.wire import ByteLedger
 
 
 def _topic(receiver_id: int) -> str:
@@ -51,6 +52,7 @@ class MqttCommManager(BaseCommunicationManager):
         self.rank = rank
         self.size = size
         self.topic_prefix = topic_prefix
+        self.bytes_ledger = ByteLedger()
         self._observers: List[Observer] = []
         self._client = client
         self._client.on_connect = self._on_connect
@@ -63,13 +65,18 @@ class MqttCommManager(BaseCommunicationManager):
 
     def _on_message(self, client, userdata, mqtt_msg):
         msg = Message.from_json(mqtt_msg.payload.decode())
+        self.bytes_ledger.count_rx(int(msg.get_sender_id()),
+                                   len(mqtt_msg.payload))
         for obs in list(self._observers):
             obs.receive_message(msg.get_type(), msg)
 
     # -- BaseCommunicationManager -------------------------------------------
     def send_message(self, msg: Message) -> None:
-        topic = f"{self.topic_prefix}_{int(msg.get_receiver_id())}"
-        self._client.publish(topic, payload=msg.to_json(), qos=1)
+        receiver = int(msg.get_receiver_id())
+        payload = msg.to_json()
+        self.bytes_ledger.count_tx(receiver, len(payload))
+        self._client.publish(f"{self.topic_prefix}_{receiver}",
+                             payload=payload, qos=1)
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

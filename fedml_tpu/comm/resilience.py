@@ -228,7 +228,7 @@ class ChaosTransport(BaseCommunicationManager):
         self._timers: list = []
         self._closed = False
 
-    # Expose the wrapped backend's resolved port / retry counters.
+    # Expose the wrapped backend's resolved port / retry / byte counters.
     @property
     def port(self) -> int:
         return self.inner.port
@@ -236,6 +236,14 @@ class ChaosTransport(BaseCommunicationManager):
     @property
     def retry_count(self) -> int:
         return getattr(self.inner, "retry_count", 0)
+
+    @property
+    def bytes_ledger(self):
+        """The wrapped backend's ByteLedger (None on backends without
+        wire serialization): a chaos drill's byte accounting must read
+        what actually crossed the wire — dropped sends never serialize,
+        duplicates serialize twice."""
+        return getattr(self.inner, "bytes_ledger", None)
 
     def _key(self, msg: Message) -> Tuple[int, int, int, int]:
         tag = msg.get("round")

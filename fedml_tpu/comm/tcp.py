@@ -25,7 +25,8 @@ from typing import Dict, List, Optional, Tuple
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.resilience import RetryPolicy
-from fedml_tpu.comm.wire import WIRE_FORMATS, deserialize_message, serialize_message
+from fedml_tpu.comm.wire import (ByteLedger, WIRE_FORMATS,
+                                 deserialize_message, serialize_message)
 
 DEFAULT_BASE_PORT = 50000
 
@@ -85,6 +86,7 @@ class TcpCommManager(BaseCommunicationManager):
         real_port = self._lib.mn_server_port(self._server)
         self.ip_config[rank] = (self.ip_config[rank][0], real_port)
         self._sender = self._lib.mn_sender_create()
+        self.bytes_ledger = ByteLedger()
         self._observers: List[Observer] = []
         self._running = False
         self._stop_requested = False
@@ -131,6 +133,7 @@ class TcpCommManager(BaseCommunicationManager):
                                     blob),
             retriable=lambda e: isinstance(e, (ConnectionError, OSError)),
             describe=f"msgnet send rank {self.rank} -> {receiver}")
+        self.bytes_ledger.count_tx(receiver, len(blob))
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -155,6 +158,7 @@ class TcpCommManager(BaseCommunicationManager):
             finally:
                 self._lib.mn_free(ptr)
             msg = deserialize_message(blob, self._serializer)
+            self.bytes_ledger.count_rx(int(msg.get_sender_id()), len(blob))
             for obs in list(self._observers):
                 obs.receive_message(msg.get_type(), msg)
 

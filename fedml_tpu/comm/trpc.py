@@ -32,7 +32,8 @@ from typing import Dict, List, Optional, Tuple
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.resilience import RetryPolicy
-from fedml_tpu.comm.wire import deserialize_message, serialize_message
+from fedml_tpu.comm.wire import (ByteLedger, deserialize_message,
+                                 serialize_message)
 
 _ACK = b"\x06"  # the servicer's "message received" response, one byte
 
@@ -92,6 +93,7 @@ class TRPCCommManager(BaseCommunicationManager):
         self._retry = retry or RetryPolicy.established(
             seed=rank, attempt_timeout_s=30.0)
         self._queue: Queue = Queue()
+        self.bytes_ledger = ByteLedger()
         self._observers: List[Observer] = []
         self._running = False
         self._stop_requested = False
@@ -145,6 +147,9 @@ class TRPCCommManager(BaseCommunicationManager):
                     return
                 msg = deserialize_message(payload, "tensor")
                 sender = int(msg.get_sender_id())
+                # Counted per DELIVERY (a retry after a lost ACK crossed
+                # the wire again even though the dedupe drops it).
+                self.bytes_ledger.count_rx(sender, n + 24)
                 # Idempotent enqueue: a sender retry after a lost ACK
                 # re-delivers the same (sender, epoch, seq) — ack it
                 # again but never enqueue twice (a duplicate model upload
@@ -221,6 +226,7 @@ class TRPCCommManager(BaseCommunicationManager):
                 lambda: self._send_once(receiver, head, blob, timeout),
                 retriable=lambda e: isinstance(e, OSError),
                 describe=f"trpc send rank {self.rank} -> {receiver}")
+            self.bytes_ledger.count_tx(receiver, len(blob) + len(head))
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

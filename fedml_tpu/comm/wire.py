@@ -22,21 +22,50 @@ from __future__ import annotations
 
 import json
 import struct
+import threading
+from typing import Dict
 
 import numpy as np
 
-from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.message import Message, _np_dtype
 
 WIRE_FORMATS = ("pickle", "json", "tensor")
 
 
-def _np_dtype(name: str):
-    try:
-        return np.dtype(name)
-    except TypeError:
-        import ml_dtypes  # bfloat16 etc. (registered by jax's dep)
+class ByteLedger:
+    """Per-peer bytes-on-wire counters — the ONE shared hook every
+    backend taps where it calls ``serialize_message`` /
+    ``deserialize_message`` (tcp / grpc / trpc / mqtt, plus the loopback
+    wire round-trip mode). No bytes-on-wire observability existed before;
+    the wire-codec A/B and the server's per-round ``health()`` metrics
+    read these. Thread-safe: send paths and receive loops run on
+    different threads."""
 
-        return np.dtype(getattr(ml_dtypes, name))
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.tx: Dict[int, int] = {}  # peer rank -> bytes sent to it
+        self.rx: Dict[int, int] = {}  # peer rank -> bytes received from it
+
+    def count_tx(self, peer: int, nbytes: int) -> None:
+        with self._lock:
+            self.tx[peer] = self.tx.get(peer, 0) + int(nbytes)
+
+    def count_rx(self, peer: int, nbytes: int) -> None:
+        with self._lock:
+            self.rx[peer] = self.rx.get(peer, 0) + int(nbytes)
+
+    @property
+    def total_tx(self) -> int:
+        with self._lock:
+            return sum(self.tx.values())
+
+    @property
+    def total_rx(self) -> int:
+        with self._lock:
+            return sum(self.rx.values())
+
+    def totals(self) -> Dict[str, int]:
+        return {"bytes_tx": self.total_tx, "bytes_rx": self.total_rx}
 
 
 def _encode_obj(obj, bufs):

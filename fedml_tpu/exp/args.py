@@ -131,6 +131,14 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "round). Cross-silo CLI: none | topk<ratio> "
                         "(wire-level with error feedback) | q<bits> "
                         "(stochastic quantization)")
+    p.add_argument("--wire_codec", type=str, default="none",
+                   help="negotiated wire codec for message-passing "
+                        "uploads (cross-silo / FedAsync / FedBuff): none "
+                        "| bf16 | fp16 | int8 | topk<ratio> | "
+                        "randmask<ratio>, composable as sparsifier+value "
+                        "(e.g. topk0.01+int8). Sparsifiers carry "
+                        "per-client error feedback; falls back loudly "
+                        "against a codec-ignorant peer (comm/codec.py)")
     p.add_argument("--compute_layout", type=str, default="none",
                    help="lane-fill compute layout for the client step: "
                         "none | auto (pad channel dims to MXU lane/"
@@ -256,6 +264,7 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         oort_epsilon=args.oort_epsilon,
         oort_staleness_coef=args.oort_staleness_coef,
         compress=args.compress,
+        wire_codec=args.wire_codec,
         checkpoint_every=args.checkpoint_frequency,
         round_timeout_s=args.round_timeout_s,
         heartbeat_interval_s=args.heartbeat_interval_s,

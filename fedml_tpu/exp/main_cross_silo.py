@@ -69,8 +69,11 @@ def main(argv=None):
                              "(acknowledged RPC sends, pickle-free tensor "
                              "wire)")
     # --compress comes from the shared add_args flag set: here it is the
-    # WIRE-LEVEL codec (none | topk<ratio> with error feedback | q<bits>
-    # stochastic quantization), decoded by the server per frame.
+    # legacy on-device codec (none | topk<ratio> with error feedback |
+    # q<bits> stochastic quantization), decoded by the server per frame.
+    # --wire_codec (also shared) is the NEGOTIATED wire codec
+    # (comm/codec.py: bf16/fp16/int8/topk/randmask, composable, error
+    # feedback on sparsifiers) — mutually exclusive with --compress.
     parser.add_argument("--aggregate_k", type=int, default=0,
                         help="straggler-tolerant first-k rounds: aggregate "
                              "as soon as k fresh uploads arrive (0 = wait "
@@ -164,6 +167,7 @@ def main(argv=None):
                                      local_train, cfg,
                                      backend=args.comm_backend,
                                      compress=args.compress,
+                                     wire_codec_spec=args.wire_codec,
                                      idle_timeout_s=args.idle_timeout_s)
         client.run()
         print(json.dumps({"rank": args.rank, "status": "done"}))

@@ -140,7 +140,7 @@ def run_fedasync(args):
     srv = FedML_FedAsync_distributed(
         model, arrays, test, cfg,
         alpha=(0.6 if args.fedasync_alpha < 0 else args.fedasync_alpha),
-        staleness_exp=args.staleness_exp)
+        staleness_exp=args.staleness_exp, wire_codec=args.wire_codec)
     logging.info("fedasync staleness history: %s", srv.staleness_history)
     return srv.test_history or [{"version": srv.version}]
 
@@ -167,8 +167,8 @@ def run_fedbuff(args):
         model, arrays, test, cfg,
         alpha=(1.0 if args.fedasync_alpha < 0 else args.fedasync_alpha),
         staleness_exp=args.staleness_exp, buffer_k=args.buffer_k,
-        aggregator=args.aggregator, corrupt_ranks=corrupt_ranks,
-        corruptor=corruptor)
+        aggregator=args.aggregator, wire_codec=args.wire_codec,
+        corrupt_ranks=corrupt_ranks, corruptor=corruptor)
     logging.info("fedbuff staleness history: %s (guard_drops=%d)",
                  srv.staleness_history, srv.guard_drops)
     return srv.test_history or [{"version": srv.version}]
@@ -230,6 +230,13 @@ def main(argv=None):
         reject_fedavg_family_flags(args, args.algorithm)
         reject_async_tier_flags(args, args.algorithm,
                                 allow_mixing=args.algorithm == "FedAsync")
+    if (args.algorithm not in ("FedAsync", "FedBuff")
+            and getattr(args, "wire_codec", "none") != "none"):
+        raise SystemExit(
+            f"{args.algorithm} does not support --wire_codec "
+            f"{args.wire_codec}: the negotiated wire codec rides the "
+            "message-passing upload path (FedAsync/FedBuff here, or the "
+            "cross-silo CLI) — the flag would be silently inert")
     logging.basicConfig(level=logging.INFO,
                         format=f"[{args.algorithm} %(asctime)s] %(message)s")
     history = RUNNERS[args.algorithm](args)

@@ -334,6 +334,17 @@ def resnet20(num_classes: int = 10, norm: str = "gn", dtype=None,
                        dtype=_dt(dtype), stem=stem, widths=widths)
 
 
+@register_model("resnet10_gn")
+def resnet10_gn(num_classes: int = 100, **_):
+    """Reduced-depth ResNet-GN (one basic block per stage): the
+    ``CI_LITE_DEPTH`` compile proxy for the fed_cifar100 row — same
+    4-stage GroupNorm architecture, loader path, and flag wiring as
+    resnet18_gn at a CPU-compilable depth, so ``reproduce_baselines.sh
+    fed_cifar100_resnet18`` is exercised in CI instead of documented as
+    too slow (REPRO.md CI-lite table)."""
+    return ResNetGN(stage_sizes=(1, 1, 1, 1), block="basic", num_classes=num_classes)
+
+
 @register_model("resnet18_gn")
 def resnet18_gn(num_classes: int = 100, **_):
     return ResNetGN(stage_sizes=(2, 2, 2, 2), block="basic", num_classes=num_classes)

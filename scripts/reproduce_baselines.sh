@@ -33,6 +33,18 @@ epochs() { # epochs <published> → CI-lite shrink (20-epoch silo rounds
   if [ "$CI_LITE" = "1" ]; then echo 1; else echo "$1"; fi  # choke CPU CI)
 }
 
+gn_model() { # gn_model → fed_cifar100's ResNet-GN, depth-reduced in CI
+  # CI_LITE_DEPTH (e.g. 10) swaps resnet18_gn for resnet<depth>_gn — the
+  # same 4-stage GN architecture, loader path, and flags at a depth the
+  # CPU mesh compiles in minutes, so this row is actually EXERCISED in
+  # CI instead of documented as too slow (VERDICT r5 #7; REPRO.md).
+  if [ "$CI_LITE" = "1" ] && [ -n "${CI_LITE_DEPTH:-}" ]; then
+    echo "resnet${CI_LITE_DEPTH}_gn"
+  else
+    echo resnet18_gn
+  fi
+}
+
 run_cfg() { # run_cfg <name> <main> [args...]
   local name=$1 main=$2; shift 2
   echo "=== $name ==="
@@ -75,7 +87,7 @@ match femnist_cnn && run_cfg femnist_cnn main_fedavg \
   --comm_round "$(rounds 1500)"         # published: 84.9%
 
 match fed_cifar100_resnet18 && run_cfg fed_cifar100_resnet18 main_fedavg \
-  --dataset fed_cifar100 --model resnet18_gn $(data_arg fed_cifar100/datasets) \
+  --dataset fed_cifar100 --model "$(gn_model)" $(data_arg fed_cifar100/datasets) \
   --client_num_in_total 500 --client_num_per_round 10 --batch_size 20 \
   --client_optimizer sgd --lr 0.1 --wd 0 --epochs 1 \
   --comm_round "$(rounds 4000)"         # published: 44.7%

@@ -203,6 +203,78 @@ def test_fedopt_server_adam_beats_fedavg_at_reference_scale():
 
 
 @pytest.mark.slow
+def test_cross_silo_table3_regime_iid_beats_noniid():
+    """The cross-silo DNN table-3 SHAPE pin (r5 VERDICT #4): 20 local
+    epochs x batch 64 x 10 silos, full participation, wd 1e-3, SGD,
+    ResNet-20-GN on a synthetic CIFAR-shaped task (24x24x3
+    class-conditional Gaussians, separation 1.0 — fed_cifar100's own
+    crop size) — the deep-local-drift optimizer regime no other pin
+    exercises (reference benchmark/README.md:103-111).
+
+    Both partitions are SIZE-EQUAL (64 samples/silo = exactly one
+    batch-64 step per epoch) so the two arms share compiled shapes:
+    IID draws labels uniformly; non-IID gives silo c only classes
+    {c, c+1 mod 10} — harsher than LDA(0.5) and deterministic. lr: the
+    published 0.001 was measured too small to train at this round count
+    (3 rounds: acc 0.12 IID vs 0.13 HET — no learning, no gap; recorded
+    2026-08-04), so the pin runs lr 0.03 where the SAME 20-epoch regime
+    learns visibly and the drift cost becomes assertable. Calibrated
+    (v-cpu 8-device mesh, 2026-08-04, ~13 min/arm):
+
+        IID  losses 1.867 -> 1.689 -> 1.555   held-out acc 0.286
+        HET  losses 1.511 -> 1.283 -> 1.147   held-out acc 0.140
+
+    Asserted: monotone per-round train-loss descent in BOTH arms (the
+    20-epoch rounds optimize stably, no divergence), and the gap
+    DIRECTION on held-out accuracy of the global model — IID clearly
+    beats label-skew non-IID, whose 20-epoch client runs drift toward
+    2-class local optima. (Per-arm train losses are NOT comparable
+    across partitions: a 2-class silo's CE floor is ~ln 2, which is why
+    the gap is pinned on held-out accuracy.)"""
+    from fedml_tpu.data.batching import batch_global, build_federated_arrays
+    from fedml_tpu.models.registry import create_model
+
+    C, K, per, rounds = 10, 10, 64, 3
+    rng = np.random.RandomState(0)
+    protos = rng.randn(K, 24, 24, 3).astype(np.float32)
+
+    def images(y):
+        return (1.0 * protos[y]
+                + rng.randn(len(y), 24, 24, 3).astype(np.float32))
+
+    y_iid = rng.randint(0, K, size=C * per).astype(np.int32)
+    y_het = np.concatenate([
+        np.where(rng.rand(per) < 0.5, c, (c + 1) % K)
+        for c in range(C)]).astype(np.int32)
+    y_test = rng.randint(0, K, size=500).astype(np.int32)
+    test = batch_global(images(y_test), y_test, 100)
+    parts = {c: np.arange(c * per, (c + 1) * per) for c in range(C)}
+
+    def arm(y):
+        fed = build_federated_arrays(images(y), y, parts, batch_size=64)
+        cfg = FedConfig(client_num_in_total=C, client_num_per_round=C,
+                        comm_round=rounds, epochs=20, batch_size=64,
+                        lr=0.03, wd=0.001, frequency_of_the_test=1000)
+        api = FedAvgAPI(create_model("resnet20", num_classes=K), fed,
+                        test, cfg)
+        losses = [api.train_one_round(r)["train_loss"]
+                  for r in range(rounds)]
+        return losses, api.evaluate()["accuracy"]
+
+    loss_iid, acc_iid = arm(y_iid)
+    loss_het, acc_het = arm(y_het)
+    assert np.isfinite(loss_iid).all() and np.isfinite(loss_het).all()
+    # Monotone descent: every 20-epoch round improves its own objective.
+    assert all(b < a for a, b in zip(loss_iid, loss_iid[1:])), loss_iid
+    assert all(b < a for a, b in zip(loss_het, loss_het[1:])), loss_het
+    # Gap direction on the GLOBAL model's held-out accuracy (calibrated
+    # 0.286 vs 0.140; chance 0.10) — asserted with margin.
+    assert acc_iid > 0.22, acc_iid
+    assert acc_het > 0.08, acc_het  # above-chance sanity
+    assert acc_iid > acc_het + 0.05, (acc_iid, acc_het)
+
+
+@pytest.mark.slow
 def test_charlm_shaped_descent_60_rounds():
     """The Shakespeare row's optimizer regime: 2-layer LSTM char-LM, 715
     clients, 10/round, batch 4, SGD **lr 1.0** — the high-lr recurrent

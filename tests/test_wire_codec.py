@@ -1,0 +1,696 @@
+"""Wire codec subsystem (comm/codec.py) + streaming server ingest:
+round-trip properties over NetState pytrees (bfloat16 leaves included),
+seeded determinism, error-feedback telescoping vs a numpy reference,
+negotiation fallback (loud, never silent), corrupt-frame refusal, the
+O(model) streaming-ingest memory pin, and the chaos-composed duplicate
+drill proving idempotent accumulate-on-arrival."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.comm.codec import (
+    CODEC_KEY,
+    OFFER_KEY,
+    CodecError,
+    codec_offer,
+    frame_seed,
+    make_wire_codec,
+    negotiate,
+    tree_spec,
+    tree_to_vector_np,
+)
+
+ALL_SPECS = ["bf16", "fp16", "int8", "topk0.1", "randmask0.2",
+             "topk0.1+int8", "topk0.25+bf16", "randmask0.2+int8"]
+
+
+def _netstate_tree(seed=0):
+    """A NetState-shaped update with mixed dtypes incl. bfloat16 — the
+    exact payload shape the cross-silo wire carries."""
+    from fedml_tpu.trainer.local import NetState
+
+    rng = np.random.RandomState(seed)
+    params = {"dense": {"kernel": rng.randn(13, 5).astype(np.float32),
+                        "bias": rng.randn(5).astype(np.float32)},
+              "half": jnp.asarray(rng.randn(21), jnp.bfloat16)}
+    state = {"ema": rng.randn(4).astype(np.float32)}
+    return NetState(params, state)
+
+
+# --------------------------------------------------------------------------
+# Round-trip properties
+
+
+@pytest.mark.parametrize("spec_str", ALL_SPECS)
+def test_roundtrip_structure_and_dtypes(spec_str):
+    tree = _netstate_tree()
+    spec = tree_spec(tree)
+    codec = make_wire_codec(spec_str)
+    payload, residual = codec.encode(tree, None, seed=7)
+    back = codec.decode(payload, spec)
+    # Structure + dtypes are exactly the spec's.
+    assert jax.tree.structure(back) == jax.tree.structure(tree)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+        assert np.asarray(b).dtype == np.asarray(a).dtype
+        assert np.asarray(b).shape == np.asarray(a).shape
+    if codec.error_feedback:
+        # EF identity: input == decoded + residual. Exact at fp32 leaves;
+        # the bf16 leaf re-quantizes decoded values on the cast back (its
+        # resolution, ~2^-8 relative), which the tolerance covers — the
+        # exact-identity pin on an all-fp32 tree is below.
+        vec = tree_to_vector_np(tree)
+        np.testing.assert_allclose(tree_to_vector_np(back) + residual, vec,
+                                   atol=3e-2)
+        fp32_tree = {"w": np.random.RandomState(5).randn(80)
+                     .astype(np.float32)}
+        fspec = tree_spec(fp32_tree)
+        p, r = codec.encode(fp32_tree, None, seed=9)
+        np.testing.assert_allclose(
+            tree_to_vector_np(codec.decode(p, fspec)) + r,
+            tree_to_vector_np(fp32_tree), atol=1e-6)
+    else:
+        assert residual is None
+        # Unbiased/cast codecs are close pointwise (bf16 ~3 decimal bits,
+        # int8 within one level of a per-tensor scale).
+        err = np.abs(tree_to_vector_np(back) - tree_to_vector_np(tree))
+        assert float(err.max()) < 0.1
+
+
+def test_bf16_codec_is_lossless_on_bf16_leaves():
+    """Casting bf16 leaves to bf16 loses nothing: the codec must hand
+    back bit-identical values for leaves already at the wire precision."""
+    tree = {"w": jnp.asarray(np.random.RandomState(3).randn(64),
+                             jnp.bfloat16)}
+    spec = tree_spec(tree)
+    codec = make_wire_codec("bf16")
+    back = codec.decode(codec.encode(tree, None, 0)[0], spec)
+    np.testing.assert_array_equal(np.asarray(back["w"], np.float32),
+                                  np.asarray(tree["w"], np.float32))
+
+
+def test_topk_payload_is_sparse_and_randmask_ships_no_indices():
+    tree = {"w": np.random.RandomState(0).randn(1000).astype(np.float32)}
+    p_topk, _ = make_wire_codec("topk0.05").encode(tree, None, 1)
+    assert p_topk["idx"].dtype == np.int32 and p_topk["idx"].size == 50
+    assert p_topk["q"].size == 50
+    p_mask, _ = make_wire_codec("randmask0.05").encode(tree, None, 1)
+    assert "idx" not in p_mask  # seed-expanded: only seed + k cross
+    assert p_mask["k"] == 50 and p_mask["q"].size == 50
+    # The server-side expansion reconstructs the exact index set.
+    spec = tree_spec(tree)
+    back = make_wire_codec("randmask0.05").decode(p_mask, spec)
+    assert np.count_nonzero(back["w"]) <= 50
+
+
+def test_int8_dense_uses_per_tensor_scales():
+    """One scale per tensor: a tiny-magnitude leaf must survive next to a
+    huge one (a single global scale would flush it to zero)."""
+    tree = {"big": np.full(32, 1000.0, np.float32),
+            "small": np.full(16, 1e-3, np.float32)}
+    spec = tree_spec(tree)
+    codec = make_wire_codec("int8")
+    payload, _ = codec.encode(tree, None, seed=3)
+    assert payload["scale"].shape == (2,)
+    back = codec.decode(payload, spec)
+    np.testing.assert_allclose(back["small"], tree["small"], rtol=0.02)
+    np.testing.assert_allclose(back["big"], tree["big"], rtol=0.02)
+
+
+def test_seeded_determinism_and_resend_identity():
+    """Same (update, carry, seed) → bit-identical frames (a cached resend
+    re-ships the same bytes, so the server's dedupe sees a true
+    duplicate); a different seed redraws the stochastic rounding."""
+    tree = _netstate_tree(1)
+    for spec_str in ("int8", "randmask0.2+int8"):
+        codec = make_wire_codec(spec_str)
+        p1, _ = codec.encode(tree, None, seed=42)
+        p2, _ = codec.encode(tree, None, seed=42)
+        for k in p1:
+            if isinstance(p1[k], np.ndarray):
+                np.testing.assert_array_equal(p1[k], p2[k])
+            else:
+                assert p1[k] == p2[k], k
+        p3, _ = codec.encode(tree, None, seed=43)
+        assert any(isinstance(p1[k], np.ndarray)
+                   and not np.array_equal(p1[k], p3[k]) for k in p1)
+    assert frame_seed(0, 1, 2, 3) == frame_seed(0, 1, 2, 3)
+    assert frame_seed(0, 1, 2, 3) != frame_seed(0, 1, 2, 4)
+
+
+def test_error_feedback_telescopes_vs_numpy_reference():
+    """The EF pin: with residual carried round-to-round, the SUM of
+    decoded transmissions equals the sum of true updates minus only the
+    FINAL residual (numpy reference: recon_t = (u_t + r_{t-1}) - r_t, so
+    sum telescopes) — compression error never accumulates. Without EF
+    the small coordinate would be lost every round."""
+    rng = np.random.RandomState(0)
+    spec_tree = {"w": np.zeros(64, np.float32)}
+    spec = tree_spec(spec_tree)
+    codec = make_wire_codec("topk0.05+int8")  # k=3 of 64, quantized
+    residual = None
+    sum_true = np.zeros(64, np.float64)
+    sum_recv = np.zeros(64, np.float64)
+    norm_true = 0.0
+    for t in range(30):
+        u = rng.randn(64).astype(np.float32) * 0.1
+        u[7] = 0.05  # persistent small signal, never top-3 on its own
+        payload, residual = codec.encode({"w": u}, residual,
+                                         seed=frame_seed(0, t))
+        sum_true += u
+        norm_true += float(np.linalg.norm(u))
+        sum_recv += codec.decode(payload, spec)["w"]
+    # Telescoping identity: received total = true total - final residual
+    # (recon_t = (u_t + r_{t-1}) - r_t; interior residuals cancel).
+    np.testing.assert_allclose(sum_recv + residual, sum_true, atol=1e-4)
+    # The carry holds a FRACTION of the total input mass, not 30 rounds'
+    # worth: compression error corrected later, not accumulated.
+    assert np.linalg.norm(residual) < 0.25 * norm_true
+    # The persistent small coordinate accumulates in the carry until it
+    # wins a top-k slot: most of its 30x0.05 mass was transmitted.
+    assert sum_recv[7] > 0.5 * sum_true[7]
+
+
+def test_ef_residual_shape_mismatch_refused():
+    codec = make_wire_codec("topk0.5")
+    with pytest.raises(ValueError, match="residual"):
+        codec.encode({"w": np.ones(8, np.float32)},
+                     np.zeros(9, np.float32), 0)
+
+
+# --------------------------------------------------------------------------
+# Spec parsing + negotiation
+
+
+def test_make_wire_codec_parsing_and_composition_rules():
+    assert make_wire_codec("none").name == "none"
+    assert make_wire_codec(None).name == "none"
+    assert make_wire_codec("topk0.01+int8").stage_names() == ["topk", "int8"]
+    with pytest.raises(ValueError, match="unknown wire-codec stage"):
+        make_wire_codec("gzip")
+    with pytest.raises(ValueError, match="ratio"):
+        make_wire_codec("topk")
+    with pytest.raises(ValueError, match="sparsifier must come first"):
+        make_wire_codec("int8+topk0.1")
+    with pytest.raises(ValueError, match="more than one sparsifier"):
+        make_wire_codec("topk0.1+randmask0.1")
+    with pytest.raises(ValueError, match="more than one value stage"):
+        make_wire_codec("bf16+int8")
+    with pytest.raises(ValueError, match="ratio must be in"):
+        make_wire_codec("topk1.5")
+
+
+def test_negotiate_accepts_covers_and_falls_back_loudly(caplog):
+    offer = codec_offer()
+    assert negotiate("topk0.01+int8", offer) == "topk0.01+int8"
+    assert negotiate("none", None) == "none"
+    with caplog.at_level(logging.WARNING, logger="fedml_tpu.comm.codec"):
+        assert negotiate("int8", None, peer="server") == "none"
+    assert "codec-ignorant" in caplog.text
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="fedml_tpu.comm.codec"):
+        assert negotiate("topk0.1+int8", ["bf16", "int8"]) == "none"
+    assert "does not support stage" in caplog.text
+
+
+def test_client_falls_back_uncompressed_against_codec_ignorant_server(caplog):
+    """End-to-end negotiation fallback: a worker configured for int8
+    receives an assignment WITHOUT a codec offer (a codec-ignorant
+    server). Its upload must be plain (no codec key, raw pytree), and
+    the fallback must be logged loudly — never silent."""
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import (
+        MSG_ARG_KEY_CLIENT_INDEX, MSG_ARG_KEY_MODEL_PARAMS,
+        MSG_TYPE_S2C_INIT_CONFIG, FedAVGClientManager,
+        build_federation_setup)
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.trainer.local import softmax_ce
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2 * 32, 6).astype(np.float32)
+    y = (x @ rng.randn(6) > 0).astype(np.int32)
+    fed = build_federated_arrays(
+        x, y, {c: np.arange(c * 32, (c + 1) * 32) for c in range(2)}, 16)
+    cfg = FedConfig(client_num_in_total=2, client_num_per_round=1,
+                    comm_round=2, epochs=1, batch_size=16, lr=0.3)
+    size, net0, local_train, _, args = build_federation_setup(
+        LogisticRegression(num_classes=2), fed, None, cfg, "LOOPBACK",
+        softmax_ce)
+    client = FedAVGClientManager(args, 1, size, fed, local_train, cfg,
+                                 wire_codec_spec="int8")
+    msg = Message(MSG_TYPE_S2C_INIT_CONFIG, 0, 1)
+    msg.add(MSG_ARG_KEY_MODEL_PARAMS, net0)
+    msg.add(MSG_ARG_KEY_CLIENT_INDEX, 0)
+    msg.add("round", 0)  # deliberately NO OFFER_KEY
+    with caplog.at_level(logging.WARNING, logger="fedml_tpu.comm.codec"):
+        client._handle_assignment(msg)
+    assert "codec-ignorant" in caplog.text
+    upload = args.network.inbox(0).get_nowait()
+    assert upload.get(CODEC_KEY) is None
+    # Raw pytree on the wire, not a codec frame.
+    assert not isinstance(upload.get(MSG_ARG_KEY_MODEL_PARAMS), dict) or \
+        "codec" not in upload.get(MSG_ARG_KEY_MODEL_PARAMS)
+
+
+def test_wire_codec_and_legacy_compress_are_mutually_exclusive():
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import (FedAVGClientManager,
+                                                    build_federation_setup)
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.trainer.local import softmax_ce
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    fed = build_federated_arrays(x, y, {0: np.arange(32)}, 16)
+    cfg = FedConfig(client_num_in_total=1, client_num_per_round=1,
+                    comm_round=1, epochs=1, batch_size=16)
+    size, _, local_train, _, args = build_federation_setup(
+        LogisticRegression(num_classes=2), fed, None, cfg, "LOOPBACK",
+        softmax_ce)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        FedAVGClientManager(args, 1, size, fed, local_train, cfg,
+                            compress="topk0.1", wire_codec_spec="int8")
+
+
+def test_simulator_tier_refuses_wire_codec():
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.models.lr import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    fed = build_federated_arrays(x, y, {0: np.arange(32)}, 16)
+    cfg = FedConfig(client_num_in_total=1, client_num_per_round=1,
+                    comm_round=1, epochs=1, batch_size=16,
+                    wire_codec="int8")
+    with pytest.raises(NotImplementedError, match="wire_codec"):
+        FedAvgAPI(LogisticRegression(num_classes=2), fed, None, cfg)
+
+
+def test_async_tier_refuses_sparsifiers_on_full_model_uploads():
+    """Top-k of full weights would zero most of the model: the async
+    client (full-model payloads) must refuse sparsifying codecs; the
+    FedBuff client (delta payloads) accepts them."""
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedasync import FedAsyncClientManager
+    from fedml_tpu.algos.fedavg_distributed import build_federation_setup
+    from fedml_tpu.algos.fedbuff import FedBuffClientManager
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.trainer.local import softmax_ce
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    fed = build_federated_arrays(x, y, {0: np.arange(32)}, 16)
+    cfg = FedConfig(client_num_in_total=1, client_num_per_round=1,
+                    comm_round=1, epochs=1, batch_size=16)
+    size, _, local_train, _, args = build_federation_setup(
+        LogisticRegression(num_classes=2), fed, None, cfg, "LOOPBACK",
+        softmax_ce)
+    with pytest.raises(ValueError, match="delta"):
+        FedAsyncClientManager(args, 1, size, fed, local_train, cfg,
+                              wire_codec_spec="topk0.1")
+    # bf16 on full models is fine; top-k on deltas (FedBuff) is fine.
+    FedAsyncClientManager(args, 1, size, fed, local_train, cfg,
+                          wire_codec_spec="bf16")
+    FedBuffClientManager(args, 1, size, fed, local_train, cfg,
+                         wire_codec_spec="topk0.1+int8")
+
+
+# --------------------------------------------------------------------------
+# Corrupt-frame refusal
+
+
+def test_corrupt_frames_are_refused_not_parsed():
+    tree = {"w": np.random.RandomState(0).randn(100).astype(np.float32)}
+    spec = tree_spec(tree)
+    codec = make_wire_codec("topk0.1+int8")
+    good, _ = codec.encode(tree, None, 5)
+
+    bad = dict(good)
+    bad["idx"] = np.array([5, 999], np.int32)  # out of range
+    with pytest.raises(CodecError, match="out of range"):
+        codec.decode(bad, spec)
+
+    bad = dict(good)
+    del bad["scale"]  # truncated: value stage field missing
+    with pytest.raises(CodecError, match="missing field"):
+        codec.decode(bad, spec)
+
+    bad = dict(good)
+    bad["n"] = 7  # frame for a different model
+    with pytest.raises(CodecError, match="7-element model"):
+        codec.decode(bad, spec)
+
+    with pytest.raises(CodecError, match="frame dict"):
+        codec.decode(b"junk", spec)
+
+    bad = dict(good)
+    bad["q"] = bad["q"].astype(np.float32)  # wrong dtype for int8 stage
+    with pytest.raises(CodecError, match="bad quantized values"):
+        codec.decode(bad, spec)
+
+    mask = make_wire_codec("randmask0.1")
+    mp, _ = mask.encode(tree, None, 5)
+    bad = dict(mp)
+    bad["k"] = 1000  # mask count beyond the model
+    with pytest.raises(CodecError, match="mask count"):
+        mask.decode(bad, spec)
+
+
+def test_server_refuses_corrupt_frame_evicts_and_round_completes():
+    """A corrupt codec frame must be REFUSED with a counter bump — never
+    aggregated, never a control-plane crash — and the sender EVICTED so
+    the round completes over the survivors even with the watchdog off
+    (round_timeout_s=0): a mismatched encoder refuses every upload, and
+    silently dropping it would deadlock the default configuration."""
+    import time
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import (
+        MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, FedAVGAggregator,
+        FedAVGServerManager)
+    from fedml_tpu.comm.loopback import LoopbackNetwork
+    from fedml_tpu.comm.message import Message
+
+    class A:
+        pass
+
+    args = A()
+    args.chaos = None
+    args.network = LoopbackNetwork(3)
+    cfg = FedConfig(client_num_in_total=2, client_num_per_round=2,
+                    comm_round=3, frequency_of_the_test=1000)
+    net0 = {"w": np.zeros(10, np.float32)}
+    agg = FedAVGAggregator(net0, 2, cfg)
+    # round_timeout_s stays 0 (the default): refusal alone must unblock.
+    srv = FedAVGServerManager(args, agg, cfg, 3, clock=time.monotonic)
+    good, _ = make_wire_codec("int8").encode({"w": np.ones(10, np.float32)},
+                                             None, 1)
+    corrupt = dict(good)
+    corrupt["q"] = corrupt["q"][:3]  # truncated values
+
+    def upload(worker, payload, round_idx=0):
+        m = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, worker, 0)
+        m.add(Message.MSG_ARG_KEY_MODEL_PARAMS, payload)
+        m.add(Message.MSG_ARG_KEY_NUM_SAMPLES, 10)
+        m.add("round", round_idx)
+        m.add(CODEC_KEY, "int8")
+        srv.handle_message_receive_model_from_client(m)
+
+    upload(2, good)  # survivor arrives first
+    assert agg.live_model_buffers == 1
+    upload(1, corrupt)
+    h = srv.health()
+    assert h["codec_refusals"] == 1 and h["evictions"] == 1
+    assert h["members"] == 1
+    # The refused worker was RELEASED (done=True) so it exits instead of
+    # blocking on its receive loop or churning via re-admission.
+    released = [m for m in args.network.inbox(1).queue
+                if getattr(m, "get", None) and m.get("done")]
+    assert released
+    # The round COMPLETED over the survivor — no deadlock, accumulator
+    # released, survivor's model became the global net.
+    assert srv.round_idx == 1 and agg.live_model_buffers == 0
+    np.testing.assert_allclose(np.asarray(agg.net["w"]),
+                               np.ones(10), atol=0.02)
+
+
+def test_all_workers_refused_aborts_instead_of_deadlocking():
+    """Single-worker federation, mismatched encoder, DEFAULT config (no
+    watchdog, no heartbeats): the refusal must release the worker and
+    finish the run — the regression was a permanent deadlock (server
+    waiting for an upload, worker waiting for a reply)."""
+    import time
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import (
+        MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, FedAVGAggregator,
+        FedAVGServerManager)
+    from fedml_tpu.comm.loopback import LoopbackNetwork
+    from fedml_tpu.comm.message import Message
+
+    class A:
+        pass
+
+    args = A()
+    args.chaos = None
+    args.network = LoopbackNetwork(2)
+    cfg = FedConfig(client_num_in_total=1, client_num_per_round=1,
+                    comm_round=3, frequency_of_the_test=1000)
+    agg = FedAVGAggregator({"w": np.zeros(4, np.float32)}, 1, cfg)
+    srv = FedAVGServerManager(args, agg, cfg, 2, clock=time.monotonic)
+    good, _ = make_wire_codec("int8").encode({"w": np.ones(4, np.float32)},
+                                             None, 1)
+    corrupt = dict(good)
+    del corrupt["scale"]
+    m = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, 1, 0)
+    m.add(Message.MSG_ARG_KEY_MODEL_PARAMS, corrupt)
+    m.add(Message.MSG_ARG_KEY_NUM_SAMPLES, 10)
+    m.add("round", 0)
+    m.add(CODEC_KEY, "int8")
+    srv.handle_message_receive_model_from_client(m)
+    assert srv.aborted and srv._stopped  # run ended, not deadlocked
+    assert srv.health()["codec_refusals"] == 1
+    released = [x for x in args.network.inbox(1).queue
+                if getattr(x, "get", None) and x.get("done")]
+    assert released  # the worker was told to exit
+
+
+# --------------------------------------------------------------------------
+# Streaming ingest: O(model) memory + idempotency
+
+
+def test_streaming_mean_ingest_holds_one_model_buffer():
+    """The O(model) pin (live-buffer audit): 32 arriving uploads on the
+    mean path never stack — the aggregator holds at most ONE model-sized
+    accumulator, and the stack dict stays empty. The aggregate equals
+    the numpy weighted mean."""
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import FedAVGAggregator
+
+    W = 32
+    net0 = {"w": np.zeros(64, np.float32)}
+    agg = FedAVGAggregator(net0, W, FedConfig())
+    rng = np.random.RandomState(0)
+    models = [rng.randn(64).astype(np.float32) for _ in range(W)]
+    weights = rng.randint(1, 50, W).astype(np.float64)
+    for i in range(W):
+        agg.add_local_trained_result(i, {"w": models[i]}, weights[i])
+        assert agg.live_model_buffers <= 1  # O(model), not O(i x model)
+        assert not agg.model_dict
+    out = agg.aggregate_from(range(W))
+    expect = np.average(np.stack(models), axis=0, weights=weights)
+    np.testing.assert_allclose(np.asarray(out["w"]), expect, atol=1e-4)
+    assert agg.live_model_buffers == 0  # accumulator released
+
+
+def test_streaming_mean_ingest_is_idempotent_and_subset_safe():
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import FedAVGAggregator
+
+    net0 = {"w": np.zeros(4, np.float32)}
+    agg = FedAVGAggregator(net0, 3, FedConfig())
+    agg.add_local_trained_result(0, {"w": np.ones(4, np.float32)}, 10)
+    agg.add_local_trained_result(0, {"w": np.full(4, 99.0, np.float32)}, 10)
+    agg.add_local_trained_result(1, {"w": np.full(4, 3.0, np.float32)}, 10)
+    # Duplicate add was ignored; a post-hoc subset is a protocol bug.
+    with pytest.raises(ValueError, match="cannot subset"):
+        agg.aggregate_from([0])
+    out = agg.aggregate_from([0, 1])
+    np.testing.assert_allclose(np.asarray(out["w"]), np.full(4, 2.0))
+
+
+def test_non_mean_aggregator_keeps_stack_path():
+    """Robust aggregators need the cohort side by side: the stack path
+    remains, O(cohort x model) — and coordinate-median actually resists
+    an outlier the mean would absorb."""
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import FedAVGAggregator
+
+    net0 = {"w": np.zeros(8, np.float32)}
+    agg = FedAVGAggregator(net0, 3, FedConfig(), aggregator="coord_median")
+    agg.add_local_trained_result(0, {"w": np.ones(8, np.float32)}, 10)
+    agg.add_local_trained_result(1, {"w": np.ones(8, np.float32)}, 10)
+    agg.add_local_trained_result(2, {"w": np.full(8, 1e6, np.float32)}, 10)
+    assert agg.live_model_buffers == 3  # the stack path, by design
+    out = agg.aggregate_from([0, 1, 2])
+    np.testing.assert_allclose(np.asarray(out["w"]), np.ones(8), atol=1e-5)
+
+
+def test_aggregate_from_empty_still_keeps_previous_net():
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import FedAVGAggregator
+
+    net0 = {"w": np.ones(3, np.float32)}
+    agg = FedAVGAggregator(net0, 3, FedConfig())
+    out = agg.aggregate_from([])
+    np.testing.assert_array_equal(out["w"], net0["w"])
+
+
+# --------------------------------------------------------------------------
+# Chaos-composed drill: compression + faults together
+
+
+def _drill_task():
+    """64-feature task so model frames dominate the fixed per-message
+    overhead and byte comparisons measure the codec, not headers."""
+    from fedml_tpu.data.batching import batch_global, build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+
+    x, y = make_classification(360, n_features=64, n_classes=4, seed=1)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 6),
+                                 batch_size=16)
+    return fed, batch_global(x[:96], y[:96], 16)
+
+
+def _drill_cfg():
+    from fedml_tpu.algos.config import FedConfig
+
+    return FedConfig(client_num_in_total=6, client_num_per_round=3,
+                     comm_round=5, epochs=2, batch_size=16, lr=0.3,
+                     frequency_of_the_test=1,
+                     round_timeout_s=2.0, heartbeat_interval_s=0.15)
+
+
+def _drill_chaos():
+    from fedml_tpu.comm.resilience import ChaosSpec
+
+    return ChaosSpec(seed=9, drop_p=0.03, dup_p=0.15, delay_p=0.15,
+                     max_delay_s=0.02)
+
+
+def _run_drill(fed, test, wire_codec_spec, chaos):
+    from fedml_tpu.algos.fedavg_distributed import FedML_FedAvg_distributed
+    from fedml_tpu.models.lr import LogisticRegression
+
+    return FedML_FedAvg_distributed(
+        LogisticRegression(num_classes=4), fed, test, _drill_cfg(),
+        wire_codec=wire_codec_spec, loopback_wire="tensor", chaos=chaos,
+        idle_timeout_s=6.0)
+
+
+@pytest.fixture(scope="module")
+def drill_twins():
+    """Shared anchors for both codec arms: the clean uncompressed run
+    (accuracy ballpark) and the CHAOTIC uncompressed run (byte anchor —
+    same fault pattern, so any rx delta is the codec's, not the control
+    plane's). Chaos is seeded-deterministic, so sharing is sound."""
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import FedML_FedAvg_distributed
+    from fedml_tpu.models.lr import LogisticRegression
+
+    fed, test = _drill_task()
+    clean = FedML_FedAvg_distributed(
+        LogisticRegression(num_classes=4), fed, test,
+        FedConfig(client_num_in_total=6, client_num_per_round=3,
+                  comm_round=5, epochs=2, batch_size=16, lr=0.3,
+                  frequency_of_the_test=1),
+        loopback_wire="tensor")
+    chaotic_plain = _run_drill(fed, test, "none", _drill_chaos())
+    return (fed, test, clean.test_history[-1]["accuracy"],
+            chaotic_plain.final_health["bytes_rx"])
+
+
+@pytest.mark.parametrize("spec_str", ["int8", "topk0.1+int8"])
+def test_chaos_composed_codec_drill_reaches_clean_accuracy(spec_str,
+                                                           drill_twins):
+    """Drop/dup/delay chaos + compressed uploads over the REAL tensor
+    wire on loopback: the federation still reaches the clean-run
+    accuracy ballpark, duplicated compressed uploads are dropped by the
+    server's idempotent streaming ingest (never double-accumulated), and
+    the byte ledger shows the codec actually shrank the wire."""
+    fed, test, clean_acc, plain_chaotic_rx = drill_twins
+    spec = _drill_chaos()
+    agg = _run_drill(fed, test, spec_str, spec)
+    accs = [h["accuracy"] for h in agg.test_history]
+    assert accs and accs[-1] > 0.5  # clean-run ballpark (~0.8+ clean)
+    assert accs[-1] > clean_acc - 0.25
+    assert spec.counts["duplicated"] + spec.counts["dropped"] > 0
+    h = agg.final_health
+    assert h["bytes_rx"] > 0 and h["bytes_tx"] > 0
+    assert h["bytes_rx"] < 0.9 * plain_chaotic_rx
+
+
+def test_bf16_codec_frame_survives_the_json_wire():
+    """The json/MQTT wire rebuilds arrays from (dtype-name, nested list):
+    bfloat16 payloads (the bf16 codec's 'q' array) must round-trip —
+    Message._np_dtype carries the ml_dtypes fallback the tensor wire
+    already had."""
+    from fedml_tpu.comm.message import Message
+
+    tree = {"w": np.random.RandomState(0).randn(32).astype(np.float32)}
+    payload, _ = make_wire_codec("bf16").encode(tree, None, seed=1)
+    msg = Message(type=3, sender_id=1, receiver_id=0)
+    msg.add(Message.MSG_ARG_KEY_MODEL_PARAMS, payload)
+    msg.add(CODEC_KEY, "bf16")
+    back = Message.from_json(msg.to_json())
+    decoded = make_wire_codec("bf16").decode(
+        back.get(Message.MSG_ARG_KEY_MODEL_PARAMS), tree_spec(tree))
+    np.testing.assert_allclose(decoded["w"], tree["w"], atol=1e-2)
+
+
+def test_loopback_wire_mode_counts_bytes_both_ways():
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackNetwork
+    from fedml_tpu.comm.message import Message
+
+    net = LoopbackNetwork(2, wire="tensor")
+    a, b = LoopbackCommManager(net, 0), LoopbackCommManager(net, 1)
+    got = []
+
+    class Obs:
+        def receive_message(self, t, msg):
+            got.append(msg)
+            b.stop_receive_message()
+
+    b.add_observer(Obs())
+    msg = Message(type=3, sender_id=0, receiver_id=1)
+    msg.add("model_params", {"w": np.arange(100, dtype=np.float32)})
+    a.send_message(msg)
+    b.handle_receive_message()
+    assert got and np.array_equal(got[0].get("model_params")["w"],
+                                  np.arange(100, dtype=np.float32))
+    assert a.bytes_ledger.tx[1] > 400  # the array really serialized
+    assert b.bytes_ledger.rx[0] == a.bytes_ledger.tx[1]
+    with pytest.raises(ValueError, match="wire format"):
+        LoopbackNetwork(2, wire="zip")
+
+
+def test_fedbuff_topk_ef_delta_codec_trains():
+    """The buffered tier with a sparsifying delta codec end-to-end: the
+    full wire-codec menu on FedBuff's delta uploads, decoded per frame
+    by the async server, still trains under a dup/delay chaos spec."""
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedbuff import FedML_FedBuff_distributed
+    from fedml_tpu.comm.resilience import ChaosSpec
+    from fedml_tpu.data.batching import batch_global, build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+    from fedml_tpu.models.lr import LogisticRegression
+
+    x, y = make_classification(240, n_features=8, n_classes=4, seed=2)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 4),
+                                 batch_size=16)
+    test = batch_global(x[:64], y[:64], 16)
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=8, epochs=1, batch_size=16, lr=0.3,
+                    frequency_of_the_test=2, heartbeat_interval_s=0.2)
+    srv = FedML_FedBuff_distributed(
+        LogisticRegression(num_classes=4), fed, test, cfg, buffer_k=2,
+        wire_codec="topk0.2+int8", loopback_wire="tensor",
+        chaos=ChaosSpec(seed=4, dup_p=0.1, delay_p=0.1, max_delay_s=0.02),
+        done_timeout_s=5.0, idle_timeout_s=10.0)
+    assert srv.version >= cfg.comm_round
+    accs = [h["accuracy"] for h in srv.test_history]
+    assert accs and accs[-1] > 0.5
