@@ -919,6 +919,66 @@ def bench_wire_codec():
     return out
 
 
+def bench_ingest_profile(C=8, D=4096, K=10, rounds=6):
+    """The measured baseline for the server-ingest wall (ROADMAP item 1;
+    arXiv:2307.06561 frames server ingest as *the* FL bottleneck): every
+    upload funnels through ONE single-threaded dispatch loop doing
+    decode + fold. This section runs the loopback ``topk+int8`` chaos
+    drill with the ingest registry live (obs/registry.py; always on —
+    the span tracer stays off, so this is the production-cost path) and
+    reports WHERE an upload's server time goes:
+
+    - ``ingest_occupancy`` (headline): dispatch-thread busy seconds over
+      the first→last-message span — the number a parallel-ingest pool
+      must drive DOWN at constant uploads/s (or hold at 1.0 while
+      uploads/s scales with workers);
+    - decode/fold p50/p95 milliseconds + bytes/upload from the
+      per-upload histograms (log-bucketed, ≤~9% quantile error).
+
+    The model is deliberately bigger than the wire_codec section's
+    (D=4096: ~41k params) so decode/fold cost is measurable above
+    header noise while the section stays seconds-scale."""
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import FedML_FedAvg_distributed
+    from fedml_tpu.comm.resilience import ChaosSpec
+    from fedml_tpu.data.batching import batch_global, build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.models.lr import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, K, size=C * 32).astype(np.int32)
+    protos = rng.randn(K, D).astype(np.float32)
+    x = 0.8 * protos[y] + rng.randn(len(y), D).astype(np.float32)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), C),
+                                 batch_size=16)
+    test = batch_global(x[:128], y[:128], 64)
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=4,
+                    comm_round=rounds, epochs=1, batch_size=16, lr=0.2,
+                    frequency_of_the_test=1000)
+    _check_section_deadline()
+    t0 = time.perf_counter()
+    # Same drill shape as wire_codec: tensor wire round-trip + chaos
+    # (dup+delay), idle_timeout_s bounding chaos-stranded workers.
+    agg = FedML_FedAvg_distributed(
+        LogisticRegression(num_classes=K), fed, test, cfg,
+        wire_codec="topk0.05+int8", loopback_wire="tensor",
+        chaos=ChaosSpec(seed=11, dup_p=0.1, delay_p=0.1),
+        idle_timeout_s=15.0)
+    dt = time.perf_counter() - t0
+    prof = dict(agg.ingest_profile)
+    uploads = int(prof.get("uploads") or 0)
+    return {
+        "rounds": rounds, "workers": cfg.client_num_per_round,
+        "model_params": D * K + K, "wire": "tensor",
+        "codec": "topk0.05+int8", "chaos": "dup_p=0.1 delay_p=0.1",
+        "uploads_per_sec": round(uploads / dt, 2) if dt > 0 else None,
+        "final_accuracy": round(float(
+            (agg.test_history[-1] if agg.test_history else {}).get(
+                "accuracy", 0.0)), 4),
+        **prof,
+    }
+
+
 def bench_fleet_sim():
     """Serving under churn on the REAL control plane (fedml_tpu.sim):
     one fixed seeded fleet trace — staggered arrivals, diurnal
@@ -1686,6 +1746,7 @@ def main():
                 ("robust_agg", bench_robust_agg),
                 ("chaos", bench_chaos),
                 ("wire_codec", bench_wire_codec),
+                ("ingest_profile", bench_ingest_profile),
                 ("fleet_sim", bench_fleet_sim),
                 ("stackoverflow_342k", bench_stackoverflow_342k),
                 ("synthetic_1m", bench_synthetic_1m),
@@ -1850,10 +1911,18 @@ def build_headline(out, full_path="docs/bench_local.json"):
                                                "speedup"),
             "robust_agg_overhead": _scalar("robust_agg",
                                            "robust_agg_overhead"),
-            "chaos_clean_overhead": _scalar("chaos",
-                                            "chaos_clean_overhead"),
+            # chaos_clean_overhead rotated out in r11 (stable ~1.08
+            # since r5, and the wire_codec + ingest_profile arms both
+            # run UNDER chaos now; the full blob keeps it) to fund
+            # ingest_occupancy under the <1KB tail budget.
             "wire_bytes_ratio": _scalar("wire_codec", "wire_bytes_ratio"),
             "codec_acc_delta": _scalar("wire_codec", "codec_acc_delta"),
+            # The server-ingest-wall baseline (r11): dispatch-thread
+            # occupancy on the loopback topk+int8 chaos drill — the
+            # before/after ruler for ROADMAP item 1's parallel-ingest
+            # attack (decode/fold p50/p95 live in the full blob).
+            "ingest_occupancy": _scalar("ingest_profile",
+                                        "ingest_occupancy"),
             "fleet_buffered_vs_firstk": _scalar(
                 "fleet_sim", "buffered_vs_firstk_throughput"),
             "fleet_buffered_stale_p95_vs_async": _scalar(

@@ -136,3 +136,14 @@ class FedConfig:
     checkpoint_every: int = 0
     round_timeout_s: float = 0.0
     heartbeat_interval_s: float = 0.0
+    # Federation flight recorder (obs/trace.py, --trace at the CLI;
+    # docs/OBSERVABILITY.md): record upload-lifecycle spans (client
+    # serialize → wire → codec decode → accumulator fold → round commit,
+    # correlated by (epoch, round, sender, task_seq)) and dump a
+    # Perfetto-loadable Chrome trace + JSONL into the run directory,
+    # plus the server's bounded flight-recorder ring on eviction/abort/
+    # codec refusal. Off (the default) is a strict no-op path — the
+    # instrumented call sites hit the null tracer, pinned within 2% of
+    # uninstrumented in tests/test_trace.py. The CLI layers resolve this
+    # flag + --run_dir into the runners' trace_dir parameter.
+    trace: bool = False

@@ -29,6 +29,7 @@ from the done-wait, and the run always ends.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Set
@@ -57,6 +58,8 @@ from fedml_tpu.core.compression import tree_spec
 from fedml_tpu.core.faults import HeartbeatMonitor
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.data.batching import FederatedArrays
+from fedml_tpu.obs import trace as obs_trace
+from fedml_tpu.obs.registry import MetricsRegistry, payload_nbytes
 from fedml_tpu.trainer.local import softmax_ce
 
 MSG_ARG_KEY_MODEL_VERSION = "model_version"
@@ -82,6 +85,7 @@ class FedAsyncServerManager(ServerManager):
                  backend: str = "LOOPBACK", alpha: float = 0.6,
                  staleness_exp: float = 0.5, eval_fn=None, test_data=None,
                  *, done_timeout_s: Optional[float] = None,
+                 metrics=None, flight_dir: Optional[str] = None,
                  clock=time.monotonic):
         super().__init__(args, rank=0, size=size, backend=backend)
         self.net = net
@@ -102,6 +106,9 @@ class FedAsyncServerManager(ServerManager):
         self.evictions = 0
         self.duplicate_drops = 0
         self.reassignments = 0
+        # Stamped by the runners after the run (the sync tier's
+        # convention): the final health() snapshot.
+        self.final_health: Dict[str, int] = {}
         self._members: Set[int] = set(range(1, size))
         self._done_set: Set[int] = set()
         # Per-worker high-water mark of the ASSIGNMENT SEQUENCE its
@@ -129,6 +136,24 @@ class FedAsyncServerManager(ServerManager):
         self._clock = clock
         self._lock = threading.Lock()
         self._stopped = False
+        # Ingest observability — the SAME ctrl/ stream and latency
+        # histograms as the sync tier (docs/OBSERVABILITY.md; the sync
+        # server logged health per round but the async tiers used to
+        # stamp only a final snapshot): ``metrics`` gets one ctrl/ row
+        # per model-version bump, the flight recorder dumps the recent
+        # control-plane ring to ``flight_dir`` on eviction/refusal, and
+        # the occupancy clock lives in comm.managers.ServerManager.
+        self.metrics = metrics
+        self.registry = MetricsRegistry()
+        self._h_decode = self.registry.histogram("decode_ms")
+        self._h_fold = self.registry.histogram("fold_ms")
+        self._h_bytes = self.registry.histogram("bytes_per_upload", lo=1.0)
+        self._h_stale = self.registry.histogram("staleness", lo=1.0)
+        self._g_queue = self.registry.gauge("ingest_queue_depth")
+        self.flight = obs_trace.FlightRecorder(
+            clock=clock,
+            path=(os.path.join(flight_dir, "flight_recorder.jsonl")
+                  if flight_dir else None))
         self.done_timeout_s = (cfg.round_timeout_s if done_timeout_s is None
                                else done_timeout_s)
         self.heartbeat = HeartbeatMonitor(
@@ -143,6 +168,36 @@ class FedAsyncServerManager(ServerManager):
     @property
     def done_workers(self) -> int:
         return len(self._done_set)
+
+    def health(self) -> Dict[str, int]:
+        """Control-plane counters + byte ledger — the async twin of the
+        sync server's ``health()`` (same stable key names where the
+        concept is shared; ``version`` is the async round index,
+        ``reassignments`` the async analogue of re-admissions)."""
+        ledger = getattr(self.com_manager, "bytes_ledger", None)
+        with self._lock:
+            return {
+                "members": len(self._members),
+                "evictions": self.evictions,
+                "reassignments": self.reassignments,
+                "duplicate_drops": self.duplicate_drops,
+                "codec_refusals": self.codec_refusals,
+                "version": self.version,
+                "done_workers": len(self._done_set),
+                "send_retries": getattr(self.com_manager, "retry_count", 0),
+                "bytes_tx": ledger.total_tx if ledger is not None else 0,
+                "bytes_rx": ledger.total_rx if ledger is not None else 0,
+            }
+
+    def _log_round_health(self, staleness: int) -> None:
+        """One ctrl/ row per model-version bump — the async "round". The
+        sync tier logs the same stream per barrier round; emitting it
+        here too means a dashboard reads one schema across tiers."""
+        if self.metrics is None:
+            return
+        self.metrics.log({**self.health(), **self.registry.snapshot(),
+                          "staleness": staleness},
+                         step=self.version, prefix="ctrl")
 
     def run(self) -> None:
         self.register_message_receive_handlers()
@@ -210,11 +265,15 @@ class FedAsyncServerManager(ServerManager):
                 self.evictions += 1
         if evict:
             log.warning("async server: evicting silent ranks %s", evict)
+            self.flight.record("eviction", ranks=evict,
+                               version=self.version)
+            self.flight.dump()
         self._maybe_finish()
 
     def _handle_heartbeat(self, msg: Message) -> None:
         worker = msg.get_sender_id()
         self.heartbeat.beat(worker)
+        self.flight.record("beat", sender=worker)
         if not (self.done_timeout_s and self.done_timeout_s > 0):
             return
         if self.version >= self.cfg.comm_round:
@@ -235,6 +294,8 @@ class FedAsyncServerManager(ServerManager):
                         "done_timeout_s — re-assigning at version %d",
                         worker, self.version)
             self.reassignments += 1
+            self.flight.record("reassignment", sender=worker,
+                               version=self.version)
             self._send_assignment(worker, recovery=True)
 
     def _evict_dead(self, worker: int, err: BaseException, what: str) -> None:
@@ -242,10 +303,16 @@ class FedAsyncServerManager(ServerManager):
         repeated failures to an already-evicted rank don't inflate the
         eviction counter the fault drills assert on."""
         log.warning("%s to worker %d failed (%s): evicting", what, worker, err)
+        evicted = False
         with self._lock:
             if worker in self._members:
                 self._members.discard(worker)
                 self.evictions += 1
+                evicted = True
+        if evicted:
+            self.flight.record("eviction", ranks=[worker],
+                               version=self.version, what=what)
+            self.flight.dump()
 
     def _send_done(self, worker: int) -> None:
         out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
@@ -347,8 +414,20 @@ class FedAsyncServerManager(ServerManager):
         with self._lock:
             if task <= self._last_upload_task.get(worker, -1):
                 self.duplicate_drops += 1
+                self.flight.record("duplicate_drop", sender=worker,
+                                   task_seq=task)
                 return
             self._last_upload_task[worker] = task
+        tr = obs_trace.active()
+        ck = obs_trace.corr(round=self.version, sender=worker,
+                            task_seq=task)
+        self._h_bytes.record(
+            payload_nbytes(msg.get(MSG_ARG_KEY_MODEL_PARAMS)))
+        depth = getattr(self.com_manager, "inbox_depth", None)
+        if depth is not None:
+            depth = depth()
+            if depth is not None:
+                self._g_queue.set(depth)
         wcodec = msg.get(wire_codec.CODEC_KEY)
         if wcodec:
             # Wire-codec frame (comm/codec.py): self-described, decoded
@@ -361,27 +440,44 @@ class FedAsyncServerManager(ServerManager):
             # the run finishes when no members remain (sync-tier
             # policy, fedavg_distributed.py).
             try:
-                msg.add(MSG_ARG_KEY_MODEL_PARAMS,
-                        self._wire_decoders.decode(
-                            wcodec, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
-                            self._spec))
+                t0 = time.perf_counter()
+                with tr.span("ingest.decode", cat="ingest", corr=ck,
+                             codec=wcodec):
+                    msg.add(MSG_ARG_KEY_MODEL_PARAMS,
+                            self._wire_decoders.decode(
+                                wcodec, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
+                                self._spec))
+                self._h_decode.record((time.perf_counter() - t0) * 1e3)
             except (wire_codec.CodecError, ValueError) as err:
                 self.codec_refusals += 1
                 log.error("rank %d: codec %r frame refused (%s) — "
                           "evicting and releasing the worker (a "
                           "mismatched encoder can never upload a "
                           "usable model)", worker, wcodec, err)
+                self.flight.record("codec_refusal", sender=worker,
+                                   task_seq=task, codec=str(wcodec),
+                                   error=str(err)[:200])
                 with self._lock:
                     if worker in self._members:
                         self._members.discard(worker)
                         self.evictions += 1
+                self.flight.dump()
                 self._send_done(worker)  # release; finishes when empty
                 return
         staleness = self.version - base_ver
         self.staleness_history.append(staleness)
+        self._h_stale.record(staleness)
         self.arrival_log.append((worker, base_ver))
         v0 = self.version
-        self._ingest(msg, staleness)
+        t0 = time.perf_counter()
+        with tr.span("ingest.fold", cat="ingest", corr=ck,
+                     staleness=staleness):
+            self._ingest(msg, staleness)
+        self._h_fold.record((time.perf_counter() - t0) * 1e3)
+        if self.version != v0:
+            self.flight.record("version_commit", version=self.version,
+                               sender=worker)
+            self._log_round_health(staleness)
         if (self.version != v0 and self.eval_fn is not None
                 and self.test_data is not None and
                 (self.version % self.cfg.frequency_of_the_test == 0
@@ -569,6 +665,8 @@ def FedML_FedAsync_distributed(
     chaos: Optional[ChaosSpec] = None,
     done_timeout_s: Optional[float] = None,
     idle_timeout_s: float = 0.0,
+    metrics=None,
+    trace_dir: Optional[str] = None,
 ):
     """Run the async federation: ``cfg.comm_round`` server model updates
     (arrivals, not barrier rounds) across ``cfg.client_num_per_round``
@@ -577,19 +675,25 @@ def FedML_FedAsync_distributed(
     terminal handshake against crash-stop workers; ``chaos`` installs the
     fleet-wide fault-injecting transport; ``wire_codec`` compresses the
     uploads (full models here, so casts/quantization only — comm/codec.py)
-    and ``loopback_wire`` makes loopback serialize for real."""
+    and ``loopback_wire`` makes loopback serialize for real. ``metrics``
+    (a MetricsLogger) gets one ctrl/ health row per model version;
+    ``trace_dir`` arms the flight recorder + span tracer exactly as on
+    the sync tier (obs/trace.py)."""
     size, net0, local_train, eval_fn, args = build_federation_setup(
         model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos,
         loopback_wire=loopback_wire)
     server = FedAsyncServerManager(args, net0, cfg, size, backend=backend,
                                    alpha=alpha, staleness_exp=staleness_exp,
                                    eval_fn=eval_fn, test_data=test_global,
-                                   done_timeout_s=done_timeout_s)
+                                   done_timeout_s=done_timeout_s,
+                                   metrics=metrics, flight_dir=trace_dir)
     clients = [
         FedAsyncClientManager(args, rank, size, train_fed, local_train, cfg,
                               backend=backend, wire_codec_spec=wire_codec,
                               idle_timeout_s=idle_timeout_s)
         for rank in range(1, size)
     ]
-    run_workers([server.run] + [c.run for c in clients])
+    with obs_trace.tracing_to(trace_dir):
+        run_workers([server.run] + [c.run for c in clients])
+    server.final_health = server.health()
     return server

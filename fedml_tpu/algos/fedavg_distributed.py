@@ -44,6 +44,7 @@ dead worker hangs its server forever):
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Set
@@ -63,6 +64,8 @@ from fedml_tpu.core.faults import HeartbeatMonitor
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.core.tree import tree_add, tree_sub
 from fedml_tpu.data.batching import FederatedArrays
+from fedml_tpu.obs import trace as obs_trace
+from fedml_tpu.obs.registry import MetricsRegistry, payload_nbytes
 from fedml_tpu.trainer.local import (
     make_client_optimizer,
     make_eval_fn,
@@ -119,8 +122,12 @@ class FedAVGAggregator:
         self.sample_num_dict: Dict[int, float] = {}
         self.test_history: List[dict] = []
         # Stamped by FedML_FedAvg_distributed after the run: the server's
-        # final health() snapshot (control-plane counters + byte ledger).
+        # final health() snapshot (control-plane counters + byte ledger)
+        # and its ingest profile (dispatch-thread occupancy, decode/fold
+        # latency percentiles — the measured baseline for ROADMAP item
+        # 1's parallel-ingest attack).
         self.final_health: Dict[str, int] = {}
+        self.ingest_profile: Dict[str, object] = {}
         # Mean fast path: running sample-weighted sum + weight, O(model).
         self._acc = None
         self._wsum = 0.0
@@ -257,7 +264,8 @@ class FedAVGServerManager(ServerManager):
                  heartbeat_timeout_s: Optional[float] = None,
                  done_timeout_s: Optional[float] = None,
                  checkpoint_dir: Optional[str] = None,
-                 metrics=None, clock=time.monotonic):
+                 metrics=None, clock=time.monotonic,
+                 flight_dir: Optional[str] = None):
         super().__init__(args, rank=0, size=size, backend=backend)
         if aggregate_k and not 1 <= aggregate_k <= size - 1:
             raise ValueError(
@@ -293,6 +301,24 @@ class FedAVGServerManager(ServerManager):
         self._decoders = {}  # legacy compressor name → compressor
         self._wire_decoders = wire_codec.CodecCache()  # spec → WireCodec
         self._spec = tree_spec(aggregator.net)
+        # Ingest observability (docs/OBSERVABILITY.md): per-upload
+        # decode/fold latency + payload-size histograms and the
+        # dispatch-thread busy clock feed ``ingest_profile()`` and the
+        # per-round ctrl/ metrics stream; the flight recorder keeps the
+        # last control-plane events and dumps them to ``flight_dir`` on
+        # eviction / abort / codec refusal. All of it is registry math on
+        # the dispatch thread — spans additionally land in the installed
+        # tracer (obs.trace) when one is active, no-op otherwise; the
+        # dispatch-occupancy clock lives in comm.managers.ServerManager.
+        self.registry = MetricsRegistry()
+        self._h_decode = self.registry.histogram("decode_ms")
+        self._h_fold = self.registry.histogram("fold_ms")
+        self._h_bytes = self.registry.histogram("bytes_per_upload", lo=1.0)
+        self._g_queue = self.registry.gauge("ingest_queue_depth")
+        self.flight = obs_trace.FlightRecorder(
+            clock=clock,
+            path=(os.path.join(flight_dir, "flight_recorder.jsonl")
+                  if flight_dir else None))
         # Crash-resume: restore the latest checkpoint (if any) and run
         # under a BUMPED epoch — every message carries it, so pre-crash
         # uploads are deterministically rejected.
@@ -433,11 +459,20 @@ class FedAVGServerManager(ServerManager):
         # rank (e.g. still jit-compiling its first round) keeps beating
         # and is re-admitted by _handle_heartbeat; only ranks whose beats
         # also stop are truly gone.
+        evicted = []
         with self._lock:
             for w in ranks:
                 if w in self._members:
                     self._members.discard(w)
                     self.evictions += 1
+                    evicted.append(w)
+        if evicted:
+            # An eviction is a postmortem trigger: persist the recent
+            # control-plane history NOW, while the context that led here
+            # is still in the ring.
+            self.flight.record("eviction", ranks=evicted,
+                               round=self.round_idx)
+            self.flight.dump()
 
     def _send_done(self, worker: int) -> None:
         out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
@@ -570,6 +605,8 @@ class FedAVGServerManager(ServerManager):
             log.error("all workers evicted at round %d: abandoning the run",
                       self.round_idx)
             self.aborted = True
+            self.flight.record("abort", round=self.round_idx)
+            self.flight.dump()
             self.finish()
             return
         if ready:
@@ -578,6 +615,7 @@ class FedAVGServerManager(ServerManager):
     def _handle_heartbeat(self, msg: Message) -> None:
         sender = msg.get_sender_id()
         self.heartbeat.beat(sender)
+        self.flight.record("beat", sender=sender)
         if self.round_idx >= self.cfg.comm_round:
             # Any beat at the terminal round gets a done (idempotent: the
             # worker finishes on first receipt). Members and done-set
@@ -600,6 +638,8 @@ class FedAVGServerManager(ServerManager):
                 self._members.add(sender)
                 self.readmissions += 1
             log.info("re-admitting rank %d on heartbeat", sender)
+            self.flight.record("readmission", sender=sender,
+                               round=self.round_idx, via="beat")
             self._send_assignment(sender, resend=True)
 
     # -- the round ----------------------------------------------------------
@@ -611,6 +651,7 @@ class FedAVGServerManager(ServerManager):
             # assignments under the new epoch, so this worker has live
             # work — reject deterministically, never reply.
             self.epoch_drops += 1
+            self.flight.record("epoch_drop", sender=sender, epoch=int(ep))
             return
         self.heartbeat.beat(sender)
         tag = msg.get("round")
@@ -621,11 +662,14 @@ class FedAVGServerManager(ServerManager):
                 # retry after a lost ACK): the first copy was answered —
                 # replying again would hand the worker two assignments.
                 self.duplicate_drops += 1
+                self.flight.record("duplicate_drop", sender=sender, round=t)
                 return
             self._last_upload_round[sender] = t
             if sender not in self._members:
                 self._members.add(sender)
                 self.readmissions += 1
+                self.flight.record("readmission", sender=sender, round=t,
+                                   via="upload")
         if self.round_idx >= self.cfg.comm_round:
             # Terminal: a straggler's in-flight upload after the final
             # aggregation — release it.
@@ -635,26 +679,45 @@ class FedAVGServerManager(ServerManager):
             # Stale upload from an older round: discard the model, catch
             # the worker up on the current round.
             self.straggler_drops += 1
+            self.flight.record("straggler_drop", sender=sender, round=t)
             self._send_assignment(sender)
             return
         payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         codec = msg.get("compression")
         wcodec = msg.get(wire_codec.CODEC_KEY)
+        tr = obs_trace.active()
+        ck = obs_trace.corr(epoch=self.epoch, round=t, sender=sender)
+        self._h_bytes.record(payload_nbytes(payload))
+        depth = getattr(self.com_manager, "inbox_depth", None)
+        if depth is not None:
+            depth = depth()
+            if depth is not None:
+                self._g_queue.set(depth)
         if codec:
             # Dispatch on the frame's self-described codec, not a server
             # flag: per-rank launches may configure compression on the
             # clients only, and ranks could even mix schemes.
-            if codec not in self._decoders:
-                self._decoders[codec] = make_compressor(codec)
-            delta = self._decoders[codec].decode(payload, self._spec)
-            payload = tree_add(self._broadcast_net, delta)
+            t0 = time.perf_counter()
+            with tr.span("ingest.decode", cat="ingest", corr=ck,
+                         codec=codec):
+                if codec not in self._decoders:
+                    self._decoders[codec] = make_compressor(codec)
+                delta = self._decoders[codec].decode(payload, self._spec)
+                payload = tree_add(self._broadcast_net, delta)
+            self._h_decode.record((time.perf_counter() - t0) * 1e3)
         elif wcodec:
             # Wire-codec frame (comm/codec.py): same self-description
             # discipline, pickle-free numpy decode, and a REFUSAL (not a
             # crash, not a silent zero) on a corrupt/truncated frame.
+            # Decode + delta reconstruction are one timed unit — both are
+            # O(model) work the dispatch thread pays per upload.
+            t0 = time.perf_counter()
             try:
-                delta = self._wire_decoders.decode(wcodec, payload,
-                                                   self._spec)
+                with tr.span("ingest.decode", cat="ingest", corr=ck,
+                             codec=wcodec):
+                    delta = self._wire_decoders.decode(wcodec, payload,
+                                                       self._spec)
+                    payload = tree_add(self._broadcast_net, delta)
             except (wire_codec.CodecError, ValueError) as err:
                 # The transport already guarantees frame integrity, so a
                 # refusal means a mismatched/corrupt ENCODER — every
@@ -671,7 +734,11 @@ class FedAVGServerManager(ServerManager):
                           "evicting and releasing the worker (a "
                           "mismatched encoder can never upload a usable "
                           "model)", sender, wcodec, err)
+                self.flight.record("codec_refusal", sender=sender,
+                                   round=t, codec=str(wcodec),
+                                   error=str(err)[:200])
                 self._evict([sender])
+                self.flight.dump()
                 with self._lock:
                     empty = not self._members
                     ready = bool(self._arrived) and (
@@ -684,10 +751,13 @@ class FedAVGServerManager(ServerManager):
                 if not empty and ready:
                     self._complete_round()
                 return
-            payload = tree_add(self._broadcast_net, delta)
-        self.aggregator.add_local_trained_result(
-            sender - 1, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES)
-        )
+            self._h_decode.record((time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter()
+        with tr.span("ingest.fold", cat="ingest", corr=ck):
+            self.aggregator.add_local_trained_result(
+                sender - 1, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES)
+            )
+        self._h_fold.record((time.perf_counter() - t0) * 1e3)
         with self._lock:
             self._arrived.add(sender)
             ready = len(self._arrived) >= self._k_effective()
@@ -698,7 +768,14 @@ class FedAVGServerManager(ServerManager):
         with self._lock:
             arrived = sorted(self._arrived)
             self._arrived = set()
-        global_net = self.aggregator.aggregate_from([w - 1 for w in arrived])
+        with obs_trace.active().span(
+                "round.commit", cat="round",
+                corr=obs_trace.corr(epoch=self.epoch, round=self.round_idx),
+                arrived=len(arrived)):
+            global_net = self.aggregator.aggregate_from(
+                [w - 1 for w in arrived])
+        self.flight.record("round_commit", round=self.round_idx,
+                           arrived=len(arrived))
         self._broadcast_net = global_net
         if (
             self.round_idx % self.cfg.frequency_of_the_test == 0
@@ -723,7 +800,12 @@ class FedAVGServerManager(ServerManager):
     def _log_round_health(self, round_idx: int, arrived) -> None:
         if self.metrics is None:
             return
-        self.metrics.log({"arrived": len(arrived), **self.health()},
+        # Counters + the ingest registry snapshot (decode_ms_p50/p95,
+        # fold_ms_*, bytes_per_upload_*, ingest_queue_depth — a STABLE
+        # metric-name surface, docs/OBSERVABILITY.md) in one ctrl/ row
+        # per round.
+        self.metrics.log({"arrived": len(arrived), **self.health(),
+                          **self.registry.snapshot()},
                          step=round_idx, prefix="ctrl")
 
 
@@ -883,15 +965,25 @@ class FedAVGClientManager(ClientManager):
 
     def _train(self, global_net, client_index: int) -> None:
         c = int(client_index)
+        tr = obs_trace.active()
+        ck = obs_trace.corr(epoch=self.epoch, round=self.round_idx,
+                            sender=self.rank)
         rng = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), self.round_idx)
         rng = jax.random.fold_in(rng, c)
-        net, loss = self.local_train(
-            global_net,
-            self.train_fed.x[c],
-            self.train_fed.y[c],
-            self.train_fed.mask[c],
-            rng,
-        )
+        with tr.span("client.train", cat="client", corr=ck, client=c):
+            net, loss = self.local_train(
+                global_net,
+                self.train_fed.x[c],
+                self.train_fed.y[c],
+                self.train_fed.mask[c],
+                rng,
+            )
+            if tr.enabled:
+                # Fence so the span measures the device work, not just
+                # the async dispatch (RoundTimer's discipline). Traced
+                # off this is skipped — device_get below syncs anyway.
+                jax.block_until_ready(net)
+        t_ser = tr.now()
         out = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         codec = (self._codec if self._codec is not None
                  and self._codec.name != "none" else None)
@@ -921,6 +1013,12 @@ class FedAVGClientManager(ClientManager):
             out.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
         else:
             out.add(MSG_ARG_KEY_MODEL_PARAMS, jax.device_get(net))
+        if tr.enabled:
+            # delta + encode (or the plain device_get) — the client half
+            # of the upload lifecycle, correlated with the server's
+            # ingest.decode/ingest.fold spans by (epoch, round, sender).
+            tr.complete("client.serialize", t_ser, cat="client", corr=ck,
+                        client=c)
         out.add(MSG_ARG_KEY_NUM_SAMPLES, int(self.train_fed.counts[c]))
         out.add("round", self.round_idx)
         out.add("epoch", self.epoch)
@@ -1003,6 +1101,7 @@ def FedML_FedAvg_distributed(
     checkpoint_dir: Optional[str] = None,
     metrics=None,
     idle_timeout_s: float = 0.0,
+    trace_dir: Optional[str] = None,
 ):
     """Build server + ``client_num_per_round`` workers on the chosen backend
     and run the full federation (FedAvgAPI.py:20 analogue). Returns the
@@ -1031,7 +1130,14 @@ def FedML_FedAvg_distributed(
     ``cfg.checkpoint_every`` + ``checkpoint_dir`` crash-resume, ``chaos``
     a fleet-wide fault-injecting transport wrapper, ``metrics`` a
     MetricsLogger for per-round health counters, ``idle_timeout_s`` the
-    workers' no-server-contact self-termination bound."""
+    workers' no-server-contact self-termination bound.
+
+    ``trace_dir`` arms the federation flight recorder (obs/trace.py; the
+    ``cfg.trace``/``--trace`` CLI flag resolves to it): a span tracer is
+    installed for the run and ``trace.chrome.json`` (Perfetto /
+    ``chrome://tracing`` loadable) + ``trace.jsonl`` are dumped there,
+    and the server's flight-recorder ring lands there on eviction /
+    abort / codec refusal. ``None`` (the default) is the no-op path."""
     size, net0, local_train, eval_fn, args = build_federation_setup(
         model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos,
         loopback_wire=loopback_wire)
@@ -1040,7 +1146,7 @@ def FedML_FedAvg_distributed(
     server = FedAVGServerManager(args, agg, cfg, size, backend=backend,
                                  compress=compress, aggregate_k=aggregate_k,
                                  checkpoint_dir=checkpoint_dir,
-                                 metrics=metrics)
+                                 metrics=metrics, flight_dir=trace_dir)
     clients = [
         FedAVGClientManager(args, rank, size, train_fed, local_train, cfg,
                             backend=backend, compress=compress,
@@ -1048,10 +1154,12 @@ def FedML_FedAvg_distributed(
                             idle_timeout_s=idle_timeout_s)
         for rank in range(1, size)
     ]
-    run_workers([server.run] + [c.run for c in clients])
+    with obs_trace.tracing_to(trace_dir):
+        run_workers([server.run] + [c.run for c in clients])
     # Post-run observability: the managers are finished but callers (the
     # wire_codec bench A/B, drill tests) still need the control-plane
-    # counters and ByteLedger totals — stamp the final health snapshot
-    # onto the returned aggregator.
+    # counters, ByteLedger totals and the ingest latency profile — stamp
+    # the final snapshots onto the returned aggregator.
     agg.final_health = server.health()
+    agg.ingest_profile = server.ingest_profile()
     return agg

@@ -71,6 +71,7 @@ from fedml_tpu.comm.resilience import ChaosSpec
 from fedml_tpu.core.robust_agg import make_aggregator
 from fedml_tpu.core.tree import tree_sub
 from fedml_tpu.data.batching import FederatedArrays
+from fedml_tpu.obs import trace as obs_trace
 from fedml_tpu.trainer.local import softmax_ce
 
 log = logging.getLogger(__name__)
@@ -101,10 +102,12 @@ class FedBuffServerManager(FedAsyncServerManager):
                  aggregator="mean", eval_fn=None, test_data=None, *,
                  nan_guard: bool = True,
                  done_timeout_s: Optional[float] = None,
+                 metrics=None, flight_dir=None,
                  clock=time.monotonic):
         super().__init__(args, net, cfg, size, backend=backend, alpha=alpha,
                          staleness_exp=staleness_exp, eval_fn=eval_fn,
                          test_data=test_data, done_timeout_s=done_timeout_s,
+                         metrics=metrics, flight_dir=flight_dir,
                          clock=clock)
         if buffer_k < 1:
             raise ValueError(f"buffer_k must be >= 1, got {buffer_k}")
@@ -133,6 +136,14 @@ class FedBuffServerManager(FedAsyncServerManager):
     @property
     def aggregations(self) -> int:
         return self.version
+
+    def health(self):
+        """The async tier's health row plus the buffered tier's own
+        observables: current buffer fill and nan-guard drops."""
+        h = super().health()
+        h["buffer_depth"] = self._count
+        h["guard_drops"] = self.guard_drops
+        return h
 
     def _ingest(self, msg: Message, staleness: int) -> None:
         disc = staleness_weight(1.0, staleness, self.staleness_exp)
@@ -173,6 +184,20 @@ class FedBuffServerManager(FedAsyncServerManager):
         previous net, mirroring the round builders' all-excluded
         contract — the version still advances (the k arrivals were
         consumed)."""
+        flushed = self._count
+        with obs_trace.active().span(
+                "round.commit", cat="round",
+                corr=obs_trace.corr(round=self.version),
+                buffered=flushed):
+            self._flush_buffer()
+        # The ctrl/ row is emitted at the version bump, i.e. right AFTER
+        # this flush reset the fill to 0 — report the depth the flush
+        # CONSUMED (normally buffer_k), which is the meaningful
+        # per-version observable; ``health()``'s buffer_depth stays the
+        # live fill.
+        self.registry.gauge("buffer_depth").set(flushed)
+
+    def _flush_buffer(self) -> None:
         if self.aggregator.is_mean:
             if self._wsum > 0.0:
                 delta = self._lift(self._acc, jnp.float32(1.0 / self._wsum))
@@ -238,13 +263,17 @@ def FedML_FedBuff_distributed(
     idle_timeout_s: float = 0.0,
     corrupt_ranks=(),
     corruptor=None,
+    metrics=None,
+    trace_dir=None,
 ):
     """Run the buffered federation: ``cfg.comm_round`` server
     AGGREGATIONS (each consuming ``buffer_k`` arrivals) across
     ``cfg.client_num_per_round`` workers. Returns the server manager
     (net, staleness/arrival history, test history). ``corrupt_ranks`` +
     ``corruptor`` flag Byzantine workers for drills; ``aggregator`` is
-    the server-side defense (core/robust_agg spec)."""
+    the server-side defense (core/robust_agg spec). ``metrics`` gets one
+    ctrl/ health row (incl. buffer depth + staleness) per aggregation;
+    ``trace_dir`` arms the flight recorder + span tracer (obs/trace.py)."""
     size, net0, local_train, eval_fn, args = build_federation_setup(
         model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos,
         loopback_wire=loopback_wire)
@@ -252,7 +281,8 @@ def FedML_FedBuff_distributed(
         args, net0, cfg, size, backend=backend, alpha=alpha,
         staleness_exp=staleness_exp, buffer_k=buffer_k,
         aggregator=aggregator, eval_fn=eval_fn, test_data=test_global,
-        done_timeout_s=done_timeout_s)
+        done_timeout_s=done_timeout_s, metrics=metrics,
+        flight_dir=trace_dir)
     clients = [
         FedBuffClientManager(args, rank, size, train_fed, local_train, cfg,
                              backend=backend, wire_codec_spec=wire_codec,
@@ -261,5 +291,7 @@ def FedML_FedBuff_distributed(
                                         else None))
         for rank in range(1, size)
     ]
-    run_workers([server.run] + [c.run for c in clients])
+    with obs_trace.tracing_to(trace_dir):
+        run_workers([server.run] + [c.run for c in clients])
+    server.final_health = server.health()
     return server

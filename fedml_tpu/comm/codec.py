@@ -480,7 +480,17 @@ class CodecCache:
         codec = self._by_spec.get(spec_str)
         if codec is None:
             codec = self._by_spec[spec_str] = make_wire_codec(spec_str)
-        return codec.decode(payload, spec)
+        # Span the pure-numpy frame decode separately from the servers'
+        # enclosing ingest.decode (which also covers the O(model) delta
+        # reconstruction) — the flight-recorder trace then attributes
+        # codec cost vs tree_add cost per upload. Lazy import keeps the
+        # comm package jax-free until a codec is actually used; the
+        # tracer is the no-op NULL when tracing is off.
+        from fedml_tpu.obs import trace as obs_trace
+
+        with obs_trace.active().span("codec.decode", cat="codec",
+                                     codec=spec_str):
+            return codec.decode(payload, spec)
 
 
 def negotiated_codec(requested: Optional[str], offer, *,

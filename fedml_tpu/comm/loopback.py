@@ -69,6 +69,13 @@ class LoopbackCommManager(BaseCommunicationManager):
             return
         self.network.post(receiver, msg)
 
+    def inbox_depth(self) -> int:
+        """Messages waiting in this rank's inbox — the ingest-queue-depth
+        gauge the server's metrics registry samples per upload
+        (docs/OBSERVABILITY.md). Approximate by nature (qsize races the
+        receive loop), which is fine for a gauge."""
+        return self.network.inbox(self.rank).qsize()
+
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
 

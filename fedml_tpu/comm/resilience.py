@@ -245,6 +245,12 @@ class ChaosTransport(BaseCommunicationManager):
         duplicates serialize twice."""
         return getattr(self.inner, "bytes_ledger", None)
 
+    def inbox_depth(self):
+        """Delegate the ingest-queue-depth gauge to the wrapped backend
+        (None where it has no observable inbox)."""
+        inner = getattr(self.inner, "inbox_depth", None)
+        return inner() if inner is not None else None
+
     def _key(self, msg: Message) -> Tuple[int, int, int, int]:
         tag = msg.get("round")
         if tag is None:

@@ -113,6 +113,13 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--heartbeat_interval_s", type=float, default=0.0,
                    help="distributed workers: liveness beat cadence while "
                         "training long rounds (0 = uploads only)")
+    p.add_argument("--trace", action="store_true",
+                   help="federation flight recorder (obs/trace.py): dump "
+                        "upload-lifecycle spans as Perfetto-loadable "
+                        "Chrome trace JSON + JSONL into --run_dir "
+                        "(required), plus the control-plane flight-"
+                        "recorder ring on eviction/abort/codec refusal; "
+                        "off = strict no-op (docs/OBSERVABILITY.md)")
     p.add_argument("--wandb_project", type=str, default=None)
     p.add_argument("--client_selection", type=str, default="random",
                    choices=["random", "pow_d", "oort"],
@@ -222,6 +229,20 @@ def reject_async_tier_flags(args, algorithm: str, *,
             "main_extra) — the flag would be silently inert here")
 
 
+def trace_dir_from(args) -> "str | None":
+    """Resolve ``--trace`` into the runners' ``trace_dir``: the run
+    directory when tracing is on (refusing loudly without one — trace
+    artifacts need somewhere to land), else ``None`` (the strict no-op
+    path)."""
+    if not getattr(args, "trace", False):
+        return None
+    if not getattr(args, "run_dir", None):
+        raise SystemExit(
+            "--trace needs --run_dir: the Chrome trace JSON, span JSONL "
+            "and flight-recorder dump land there (docs/OBSERVABILITY.md)")
+    return args.run_dir
+
+
 def parse_args(argv=None) -> argparse.Namespace:
     parser = argparse.ArgumentParser(description="fedml_tpu experiment")
     add_args(parser)
@@ -268,4 +289,5 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         checkpoint_every=args.checkpoint_frequency,
         round_timeout_s=args.round_timeout_s,
         heartbeat_interval_s=args.heartbeat_interval_s,
+        trace=args.trace,
     )

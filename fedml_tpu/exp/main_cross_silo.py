@@ -131,6 +131,14 @@ def main(argv=None):
     net_args = NetArgs()
     net_args.host_table = build_host_table(args)
 
+    # --trace: each rank traces its own half of the upload lifecycle and
+    # dumps rank-suffixed artifacts into the shared run_dir (the server's
+    # ingest spans and the silos' train/serialize spans correlate by
+    # (epoch, round, sender) — docs/OBSERVABILITY.md).
+    from fedml_tpu.exp.args import trace_dir_from
+    from fedml_tpu.obs import trace as obs_trace
+
+    trace_dir = trace_dir_from(args)
     if args.rank == 0:
         import os
 
@@ -152,12 +160,14 @@ def main(argv=None):
                                      compress=args.compress,
                                      aggregate_k=args.aggregate_k,
                                      checkpoint_dir=checkpoint_dir,
-                                     metrics=metrics)
-        server.run()
+                                     metrics=metrics, flight_dir=trace_dir)
+        with obs_trace.tracing_to(trace_dir, suffix=".rank0"):
+            server.run()
         if metrics is not None:
             metrics.close()
         final = aggregator.test_history[-1] if aggregator.test_history else {}
-        print(json.dumps({"rank": 0, **final, **server.health()}))
+        print(json.dumps({"rank": 0, **final, **server.health(),
+                          "ingest": server.ingest_profile()}))
     else:
         optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd,
                                           cfg.grad_clip)
@@ -169,7 +179,8 @@ def main(argv=None):
                                      compress=args.compress,
                                      wire_codec_spec=args.wire_codec,
                                      idle_timeout_s=args.idle_timeout_s)
-        client.run()
+        with obs_trace.tracing_to(trace_dir, suffix=f".rank{args.rank}"):
+            client.run()
         print(json.dumps({"rank": args.rank, "status": "done"}))
 
 

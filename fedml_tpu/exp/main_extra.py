@@ -129,6 +129,22 @@ def run_decentralized(args):
     return _loop(api, cfg)
 
 
+def _async_obs_kwargs(args):
+    """Shared --run_dir/--trace wiring for the async-tier runners: a
+    metrics.jsonl ctrl/ stream per model version (the same schema the
+    sync server logs per round) and the flight recorder / span tracer.
+    Returns ``(kwargs, metrics_logger_or_None)`` — the caller closes the
+    logger after the run."""
+    from fedml_tpu.exp.args import trace_dir_from
+
+    metrics = None
+    if getattr(args, "run_dir", None):
+        from fedml_tpu.obs import MetricsLogger
+
+        metrics = MetricsLogger.for_run(run_dir=args.run_dir, stdout=False)
+    return {"metrics": metrics, "trace_dir": trace_dir_from(args)}, metrics
+
+
 def run_fedasync(args):
     """Asynchronous FL (no barrier; staleness-weighted mixing) over the
     loopback message-passing backend — new capability, fedasync.py."""
@@ -137,10 +153,16 @@ def run_fedasync(args):
 
     fed, arrays, test, cfg = _setup(args)
     model = create_model_for(args, fed)
-    srv = FedML_FedAsync_distributed(
-        model, arrays, test, cfg,
-        alpha=(0.6 if args.fedasync_alpha < 0 else args.fedasync_alpha),
-        staleness_exp=args.staleness_exp, wire_codec=args.wire_codec)
+    obs_kw, metrics = _async_obs_kwargs(args)
+    try:
+        srv = FedML_FedAsync_distributed(
+            model, arrays, test, cfg,
+            alpha=(0.6 if args.fedasync_alpha < 0 else args.fedasync_alpha),
+            staleness_exp=args.staleness_exp, wire_codec=args.wire_codec,
+            **obs_kw)
+    finally:
+        if metrics is not None:
+            metrics.close()
     logging.info("fedasync staleness history: %s", srv.staleness_history)
     return srv.test_history or [{"version": srv.version}]
 
@@ -163,12 +185,17 @@ def run_fedbuff(args):
         corruptor = UpdateCorruptor(args.corrupt_mode, args.corrupt_scale,
                                     seed=cfg.seed)
         corrupt_ranks = tuple(range(1, 1 + args.attack_num_adversaries))
-    srv = FedML_FedBuff_distributed(
-        model, arrays, test, cfg,
-        alpha=(1.0 if args.fedasync_alpha < 0 else args.fedasync_alpha),
-        staleness_exp=args.staleness_exp, buffer_k=args.buffer_k,
-        aggregator=args.aggregator, wire_codec=args.wire_codec,
-        corrupt_ranks=corrupt_ranks, corruptor=corruptor)
+    obs_kw, metrics = _async_obs_kwargs(args)
+    try:
+        srv = FedML_FedBuff_distributed(
+            model, arrays, test, cfg,
+            alpha=(1.0 if args.fedasync_alpha < 0 else args.fedasync_alpha),
+            staleness_exp=args.staleness_exp, buffer_k=args.buffer_k,
+            aggregator=args.aggregator, wire_codec=args.wire_codec,
+            corrupt_ranks=corrupt_ranks, corruptor=corruptor, **obs_kw)
+    finally:
+        if metrics is not None:
+            metrics.close()
     logging.info("fedbuff staleness history: %s (guard_drops=%d)",
                  srv.staleness_history, srv.guard_drops)
     return srv.test_history or [{"version": srv.version}]

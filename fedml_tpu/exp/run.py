@@ -7,6 +7,7 @@ Each per-algorithm ``main_<algo>.py`` is a thin wrapper over ``run(args)``.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import math
@@ -125,7 +126,9 @@ def run(args, algorithm: str = "FedAvg"):
 
         test_fed_arrays = _tfa(fed, args.batch_size, split="test")
 
+    from fedml_tpu.exp.args import trace_dir_from
     from fedml_tpu.obs import MetricsLogger, RoundTimer
+    from fedml_tpu.obs import trace as obs_trace
 
     logger = MetricsLogger.for_run(
         run_dir=args.run_dir, stdout=True,
@@ -136,6 +139,11 @@ def run(args, algorithm: str = "FedAvg"):
     ckpt_mgr = None
     start_round = 0
     history = []
+    # --trace on the simulator tier: per-round train/eval spans (the
+    # message-passing tiers trace the full upload lifecycle; here the
+    # round IS the unit of work) dumped to run_dir as Chrome trace JSON.
+    tracing = contextlib.ExitStack()
+    tracer = tracing.enter_context(obs_trace.tracing_to(trace_dir_from(args)))
     try:
         if args.run_dir and (args.checkpoint_frequency or args.resume):
             import os
@@ -155,7 +163,8 @@ def run(args, algorithm: str = "FedAvg"):
                              cfg.lr_decay_rate)
                 )
             timer.mark()
-            with timer.phase("round"):
+            with timer.phase("round"), tracer.span(
+                    "round", cat="round", corr=obs_trace.corr(round=r)):
                 metrics = api.train_one_round(r)
                 timer.fence(api.net)
             # Reference cadence: every frequency_of_the_test rounds + final
@@ -188,8 +197,10 @@ def run(args, algorithm: str = "FedAvg"):
             ):
                 save_run(ckpt_mgr, api, r)
     finally:
-        # Flush/close sinks and the checkpoint manager even on mid-run
-        # failure (OOM, NaN guard, KeyboardInterrupt).
+        # Flush/close sinks, the checkpoint manager and the tracer (its
+        # dump runs on close) even on mid-run failure (OOM, NaN guard,
+        # KeyboardInterrupt).
+        tracing.close()
         if ckpt_mgr is not None:
             ckpt_mgr.close()
         logger.close()

@@ -6,7 +6,24 @@ The reference's equivalents: wandb calls hard-wired into aggregators
 """
 
 from fedml_tpu.obs.logger import JsonlSink, MetricsLogger, StdoutSink, WandbSink
-from fedml_tpu.obs.timing import RoundTimer, trace
+# NOTE: ``fedml_tpu.obs.trace`` is the span-tracer MODULE (the federation
+# flight recorder); the XLA profiler context manager formerly re-exported
+# here under the same name stays importable as ``obs.timing.trace``.
+from fedml_tpu.obs import trace
+from fedml_tpu.obs.timing import RoundTimer
+from fedml_tpu.obs.trace import (
+    FlightRecorder,
+    NullTracer,
+    SpanTracer,
+    tracing_to,
+)
+from fedml_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    registry,
+)
 from fedml_tpu.obs.checkpoint import (
     CheckpointManager,
     RunState,
@@ -34,6 +51,15 @@ __all__ = [
     "WandbSink",
     "RoundTimer",
     "trace",
+    "FlightRecorder",
+    "NullTracer",
+    "SpanTracer",
+    "tracing_to",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
     "CheckpointManager",
     "RunState",
     "allocate_epoch",

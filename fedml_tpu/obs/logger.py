@@ -53,7 +53,10 @@ class WandbSink:
         self._wandb = wandb
 
     def log(self, metrics: Dict, step: int):
-        self._wandb.log(metrics, step=step)
+        # ``step`` rides the wandb axis, not the metric dict (the full
+        # entry now includes it for the file sinks).
+        self._wandb.log({k: v for k, v in metrics.items() if k != "step"},
+                        step=step)
 
     def close(self):
         self._run.finish()
@@ -93,8 +96,12 @@ class MetricsLogger:
             metrics = {f"{prefix}/{k}": v for k, v in metrics.items()}
         entry = {"step": step, "ts": time.time(), **metrics}
         self.history.append(entry)
+        # Sinks receive the FULL entry, ``ts`` included: metrics.jsonl
+        # rows from different processes (server + silo ranks appending to
+        # one run_dir) are only orderable by wall clock, and the old
+        # metrics-only fan-out silently dropped it.
         for s in self.sinks:
-            s.log(metrics, step)
+            s.log(entry, step)
 
     def summary(self) -> Dict:
         """Last value per key — the wandb-summary.json equivalent the

@@ -13,8 +13,22 @@ The reference logs coarse aggregation wall-clock (FedAVGAggregator.py:60,
 from __future__ import annotations
 
 import contextlib
+import logging
 import time
 from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+# One warning per failure site per process: the profiler backend being
+# unavailable (axon tunnel, missing plugin) is worth saying exactly once,
+# not once per round — and never worth crashing the run over.
+_WARNED: set = set()
+
+
+def _warn_once(key: str, msg: str, *args) -> None:
+    if key not in _WARNED:
+        _WARNED.add(key)
+        log.warning(msg, *args)
 
 
 class RoundTimer:
@@ -81,13 +95,19 @@ def trace(log_dir: str, host_tracer_level: int = 2):
     try:
         jax.profiler.start_trace(log_dir, create_perfetto_link=False)
         started = True
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001 — degrade to no-op, visibly
+        _warn_once("start_trace",
+                   "jax profiler start_trace failed (%s: %s) — running "
+                   "WITHOUT an XLA trace; no artifacts will land in %r",
+                   type(e).__name__, e, log_dir)
     try:
         yield
     finally:
         if started:
             try:
                 jax.profiler.stop_trace()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — artifacts may be partial
+                _warn_once("stop_trace",
+                           "jax profiler stop_trace failed (%s: %s) — trace "
+                           "artifacts in %r may be incomplete",
+                           type(e).__name__, e, log_dir)

@@ -397,17 +397,14 @@ class FleetSimulator:
         completed = (self.server._stopped
                      and not getattr(self.server, "aborted", False)
                      and last >= self.cfg.comm_round)
+        # Every tier now exposes the same health() surface (PR 11
+        # unified it; the async dict used to be hand-assembled here).
+        health = self.server.health()
         if self.mode == "sync":
-            health = self.server.health()
             test_history = self.aggregator.test_history
             staleness: List[int] = []
             arrivals: List[Tuple[int, int]] = []
         else:
-            health = {
-                "evictions": self.server.evictions,
-                "duplicate_drops": self.server.duplicate_drops,
-                "reassignments": self.server.reassignments,
-            }
             test_history = self.server.test_history
             staleness = list(self.server.staleness_history)
             arrivals = list(self.server.arrival_log)

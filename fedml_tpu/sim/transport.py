@@ -33,6 +33,15 @@ from fedml_tpu.comm.message import Message
 from fedml_tpu.sim.clock import EventQueue
 
 
+def _tracer():
+    """The active span tracer (obs.trace), imported lazily so the sim
+    fabric stays importable without pulling the obs package (which
+    imports jax) until a drill actually runs."""
+    from fedml_tpu.obs import trace as obs_trace
+
+    return obs_trace.active()
+
+
 class SimNetwork:
     """Shared virtual-time router: observers per rank, deliveries as
     events. Single-threaded by construction."""
@@ -73,18 +82,41 @@ class SimNetwork:
             latency = self.latency_fn(msg)
         if latency is None:
             self.counts["dropped_send"] += 1
+            tr = _tracer()
+            if tr:
+                tr.instant("wire.drop", cat="wire", reason="send",
+                           sender=int(msg.get_sender_id()),
+                           receiver=int(msg.get_receiver_id()))
             return
-        self.events.after(latency, lambda m=msg: self._deliver(m))
+        # The in-flight time becomes one "wire.sim" span at delivery:
+        # install a SpanTracer over THIS simulation's VirtualClock
+        # (obs.trace.tracing_to(dir, clock=sim.clock)) and the trace's
+        # time axis is virtual seconds — compute charge + wire latency
+        # drawn exactly as the drill scheduled them.
+        t_sent = _tracer().now()
+        self.events.after(latency, lambda m=msg, t0=t_sent: self._deliver(
+            m, t0))
 
-    def _deliver(self, msg: Message) -> None:
+    def _deliver(self, msg: Message, t_sent: float = 0.0) -> None:
         receiver = int(msg.get_receiver_id())
+        tr = _tracer()
         if receiver in self._stopped:
             self.counts["dropped_stopped"] += 1
+            if tr:
+                tr.instant("wire.drop", cat="wire", reason="stopped",
+                           receiver=receiver)
             return
         if self.deliver_guard is not None and not self.deliver_guard(msg):
             self.counts["dropped_offline"] += 1
+            if tr:
+                tr.instant("wire.drop", cat="wire", reason="offline",
+                           receiver=receiver)
             return
         self.counts["delivered"] += 1
+        if tr:
+            tr.complete("wire.sim", t_sent, cat="wire",
+                        sender=int(msg.get_sender_id()), receiver=receiver,
+                        msg_type=int(msg.get_type()))
         for obs in list(self._observers.get(receiver, ())):
             obs.receive_message(msg.get_type(), msg)
 

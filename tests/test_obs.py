@@ -29,6 +29,65 @@ def test_metrics_logger_jsonl_and_summary(tmp_path):
     assert s["loss"] == 0.5 and s["acc"] == 0.7
 
 
+def test_metrics_jsonl_rows_carry_wall_clock_ts(tmp_path):
+    """Satellite (PR 11): ``log`` stamped ``ts`` into history but sinks
+    never received it, so metrics.jsonl rows from different processes
+    appending to one run_dir were unorderable by time. Pin the JsonlSink
+    round-trip: every row carries the same monotone-ish wall-clock ts
+    the in-memory history holds."""
+    logger = MetricsLogger.for_run(run_dir=str(tmp_path), stdout=False)
+    logger.log({"loss": 1.0}, step=0)
+    logger.log({"evictions": 2}, step=0, prefix="ctrl")
+    logger.close()
+    rows = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    assert all(isinstance(r["ts"], float) for r in rows)
+    assert rows[0]["ts"] <= rows[1]["ts"]
+    for row, hist in zip(rows, logger.history):
+        assert row["ts"] == hist["ts"] and row["step"] == hist["step"]
+    assert rows[1]["ctrl/evictions"] == 2  # prefixing unchanged
+
+
+def test_profiler_trace_failure_warns_once_and_noops(monkeypatch, caplog):
+    """Satellite (PR 11): ``obs.timing.trace`` used to swallow profiler
+    start/stop failures silently (``except Exception: pass`` twice). Now
+    the body still runs (no-op fallback) and the reason is logged ONCE
+    at warning level — fast-lane coverage for the profiler-artifact path
+    (the full XLA trace test moved to the slow lane in PR 5)."""
+    import logging
+
+    import jax
+
+    from fedml_tpu.obs import timing
+
+    monkeypatch.setattr(timing, "_WARNED", set())
+
+    def boom(*a, **kw):
+        raise RuntimeError("no profiler backend on this box")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    ran = []
+    with caplog.at_level(logging.WARNING, logger="fedml_tpu.obs.timing"):
+        with timing.trace("/tmp/nowhere"):
+            ran.append(1)
+        with timing.trace("/tmp/nowhere"):
+            ran.append(2)
+    assert ran == [1, 2]  # the traced body always runs
+    warns = [r for r in caplog.records if "start_trace failed" in r.message]
+    assert len(warns) == 1 and "no profiler backend" in warns[0].message
+
+    # stop-side failure: start succeeds, stop raises → warned once too
+    monkeypatch.setattr(timing, "_WARNED", set())
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda *a, **kw: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", boom)
+    with caplog.at_level(logging.WARNING, logger="fedml_tpu.obs.timing"):
+        with timing.trace("/tmp/nowhere"):
+            pass
+        with timing.trace("/tmp/nowhere"):
+            pass
+    stops = [r for r in caplog.records if "stop_trace failed" in r.message]
+    assert len(stops) == 1
+
+
 def test_round_timer_phases():
     t = RoundTimer()
     with t.phase("a"):
