@@ -98,27 +98,14 @@ def _check_section_deadline():
 
 
 def _rss_mb():
-    """CURRENT host RSS in MB (/proc/self/statm — Linux; falls back to
-    getrusage peak elsewhere). Current, not ru_maxrss: the process peak
-    is monotone across sections, so per-section memory claims (the
-    sharded store's flat-RSS story) need point-in-time samples. Sampled
-    once per timed block by the section machinery, so every section's
-    record carries its memory trajectory for free."""
-    import os
+    """CURRENT host RSS in MB — single-sourced in
+    :func:`fedml_tpu.utils.rss_mb` since PR 12 (sim.FleetResult.summary()
+    reports the same memory axis without this harness). Sampled once per
+    timed block by the section machinery, so every section's record
+    carries its memory trajectory for free."""
+    from fedml_tpu.utils import rss_mb
 
-    try:
-        with open("/proc/self/statm") as f:
-            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE") / 1e6
-    except Exception:
-        # Non-Linux fallback: ru_maxrss is the MONOTONE process peak
-        # (point-in-time claims like synthetic_1m's flat-RSS ratio
-        # degenerate toward 1.0 here — Linux is the measured platform),
-        # and macOS reports it in bytes where Linux uses KB.
-        import resource
-        import sys
-
-        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-        return peak / (1024.0 ** 2 if sys.platform == "darwin" else 1024.0)
+    return rss_mb()
 
 
 # Cross-section scale-comparison state (the 342k flat-store point vs the
@@ -920,7 +907,7 @@ def bench_wire_codec():
 
 
 def bench_ingest_profile(C=8, D=4096, K=10, rounds=6):
-    """The measured baseline for the server-ingest wall (ROADMAP item 1;
+    """The measured ruler for the server-ingest wall (ROADMAP item 1;
     arXiv:2307.06561 frames server ingest as *the* FL bottleneck): every
     upload funnels through ONE single-threaded dispatch loop doing
     decode + fold. This section runs the loopback ``topk+int8`` chaos
@@ -929,15 +916,21 @@ def bench_ingest_profile(C=8, D=4096, K=10, rounds=6):
     reports WHERE an upload's server time goes:
 
     - ``ingest_occupancy`` (headline): dispatch-thread busy seconds over
-      the first→last-message span — the number a parallel-ingest pool
-      must drive DOWN at constant uploads/s (or hold at 1.0 while
-      uploads/s scales with workers);
+      the first→last-message span — measured 0.78 in r11, the baseline
+      the parallel ingest pool must drive DOWN at the same offered load;
     - decode/fold p50/p95 milliseconds + bytes/upload from the
-      per-upload histograms (log-bucketed, ≤~9% quantile error).
+      per-upload histograms (log-bucketed, ≤~9% quantile error);
+    - a ``pooled`` arm (r12): the IDENTICAL drill with
+      ``cfg.ingest_workers=2`` — decode+fold move to the pool
+      (comm/ingest.py), so the before/after of the dispatch-thread
+      occupancy is visible in one ruler. The serving-scale saturation
+      curve lives in the ``serving_1m`` section.
 
     The model is deliberately bigger than the wire_codec section's
     (D=4096: ~41k params) so decode/fold cost is measurable above
     header noise while the section stays seconds-scale."""
+    import dataclasses
+
     from fedml_tpu.algos.config import FedConfig
     from fedml_tpu.algos.fedavg_distributed import FedML_FedAvg_distributed
     from fedml_tpu.comm.resilience import ChaosSpec
@@ -955,28 +948,261 @@ def bench_ingest_profile(C=8, D=4096, K=10, rounds=6):
     cfg = FedConfig(client_num_in_total=C, client_num_per_round=4,
                     comm_round=rounds, epochs=1, batch_size=16, lr=0.2,
                     frequency_of_the_test=1000)
-    _check_section_deadline()
-    t0 = time.perf_counter()
-    # Same drill shape as wire_codec: tensor wire round-trip + chaos
-    # (dup+delay), idle_timeout_s bounding chaos-stranded workers.
-    agg = FedML_FedAvg_distributed(
-        LogisticRegression(num_classes=K), fed, test, cfg,
-        wire_codec="topk0.05+int8", loopback_wire="tensor",
-        chaos=ChaosSpec(seed=11, dup_p=0.1, delay_p=0.1),
-        idle_timeout_s=15.0)
-    dt = time.perf_counter() - t0
-    prof = dict(agg.ingest_profile)
-    uploads = int(prof.get("uploads") or 0)
-    return {
+
+    def drill(cfg):
+        _check_section_deadline()
+        t0 = time.perf_counter()
+        # Same drill shape as wire_codec: tensor wire round-trip + chaos
+        # (dup+delay), idle_timeout_s bounding chaos-stranded workers.
+        agg = FedML_FedAvg_distributed(
+            LogisticRegression(num_classes=K), fed, test, cfg,
+            wire_codec="topk0.05+int8", loopback_wire="tensor",
+            chaos=ChaosSpec(seed=11, dup_p=0.1, delay_p=0.1),
+            idle_timeout_s=15.0)
+        dt = time.perf_counter() - t0
+        prof = dict(agg.ingest_profile)
+        uploads = int(prof.get("uploads") or 0)
+        return {
+            "uploads_per_sec": round(uploads / dt, 2) if dt > 0 else None,
+            "final_accuracy": round(float(
+                (agg.test_history[-1] if agg.test_history else {}).get(
+                    "accuracy", 0.0)), 4),
+            **prof,
+        }
+
+    out = {
         "rounds": rounds, "workers": cfg.client_num_per_round,
         "model_params": D * K + K, "wire": "tensor",
         "codec": "topk0.05+int8", "chaos": "dup_p=0.1 delay_p=0.1",
-        "uploads_per_sec": round(uploads / dt, 2) if dt > 0 else None,
-        "final_accuracy": round(float(
-            (agg.test_history[-1] if agg.test_history else {}).get(
-                "accuracy", 0.0)), 4),
-        **prof,
+        **drill(cfg),
+        "pooled": drill(dataclasses.replace(cfg, ingest_workers=2)),
     }
+    base, pooled = out.get("ingest_occupancy"), \
+        out["pooled"].get("ingest_occupancy")
+    out["pooled_occupancy_delta"] = (round(pooled - base, 4)
+                                     if base is not None
+                                     and pooled is not None else None)
+    return out
+
+
+def bench_serving_1m(C=1_048_576, G=64, n_devices=32, features=32,
+                     classes=32_768, horizon_s=900.0, buffer_k=32,
+                     saturation_uploads=480, workers_arms=(0, 1, 2, 4)):
+    """The COMPOSED 1M-device serving drill (ROADMAP item 1): the three
+    subsystems built since the last re-anchor run as ONE system, then
+    the server-ingest wall they expose is broken with the parallel
+    ingest pool (comm/ingest.py) and the break is measured.
+
+    **Composition** — a diurnal-churn fleet of ``n_devices`` active
+    device ranks serving a 2^20-client population: ``ClientDirectory``
+    (PR 7) owns the million-client count metadata and samples every
+    assignment; ``ShardedFederatedStore`` (PR 7) holds the population's
+    data in G memmap-spilled shards (gathers page in only assigned
+    clients); devices ship ``topk0.05+int8`` error-feedback deltas
+    (PR 10's codec) over the SIM tensor wire (bytes counted per rank)
+    into the FedBuff buffered server (PR 6) under ChaosTransport
+    dup+delay — replayed on the virtual clock, so the same seed is
+    event-for-event reproducible. Reported: uploads/s (virtual),
+    bytes/s, staleness tails, evictions, churn-killed uploads, and host
+    RSS (the memory axis). The drill runs twice — ``ingest_workers`` 1
+    and 2 — and pins the pooled mean's interleaving-invariance at this
+    scale: ``sim_nets_bitequal`` is the bit-comparison of the two final
+    nets.
+
+    **Ingest saturation** — the SIM replays client work on one event
+    thread, so wall-clock uploads/s there measures the GIL, not the
+    server. The saturation curve instead drives the SERVER ALONE at
+    offered load (the fake-clock protocol-test pattern: pre-encoded
+    topk+int8 frames of the same 1M-param model fed straight into the
+    real ``FedBuffServerManager`` handler): ``uploads_per_sec`` vs
+    ``ingest_workers`` ∈ {0, 1, 2, 4}, where workers=0 is the inline
+    r11 baseline (``ingest_occupancy`` ≈ 1: the dispatch thread IS the
+    wall) and the pool arms move decode+fold off the dispatch thread.
+    Headline scalars: ``uploads_per_sec`` (the 4-worker arm) and
+    ``ingest_speedup_4v1``."""
+    import dataclasses
+    import shutil
+    import tempfile
+
+    import jax
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedbuff import FedBuffServerManager
+    from fedml_tpu.algos.fedasync import (MSG_ARG_KEY_MODEL_VERSION,
+                                          MSG_ARG_KEY_TASK_SEQ)
+    from fedml_tpu.algos.fedavg_distributed import (
+        MSG_TYPE_C2S_SEND_MODEL_TO_SERVER)
+    from fedml_tpu.comm.codec import CODEC_KEY, make_wire_codec, tree_spec
+    from fedml_tpu.comm.loopback import LoopbackNetwork
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.resilience import ChaosSpec
+    from fedml_tpu.data.directory import ShardedFederatedStore
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.sim import (FleetSimulator, FleetSpec, StoreFleetData,
+                               make_fleet_trace)
+
+    codec_spec = "topk0.05+int8"
+    model = LogisticRegression(num_classes=classes)
+    n_params = features * classes + classes
+    out = {"clients": C, "shards": G, "devices": n_devices,
+           "model_params": n_params, "codec": codec_spec, "wire": "tensor",
+           "buffer_k": buffer_k, "chaos": "dup_p=0.05 delay_p=0.05",
+           "virtual_horizon_s": horizon_s}
+
+    # -- the 2^20-client population: directory + memmap-sharded store ----
+    sizes = [C // G + (1 if s < C % G else 0) for s in range(G)]
+
+    def builder(s):
+        rng = np.random.RandomState(77_000 + s)
+        n = sizes[s]
+        counts = np.full(n, 2, np.int64)  # 2 samples per client
+        tot = 2 * n
+        return (rng.randn(tot, features).astype(np.float32),
+                rng.randint(0, classes, tot).astype(np.int32), counts)
+
+    spill = tempfile.mkdtemp(prefix="bench_serving1m_")
+    try:
+        t0 = time.perf_counter()
+        store = ShardedFederatedStore.from_shard_builder(
+            builder, G, batch_size=2, spill_dir=spill,
+            progress=lambda s: _check_section_deadline())
+        out["store_build_s"] = round(time.perf_counter() - t0, 1)
+        out["dataset_disk_mb"] = round(store.nbytes() / 1e6, 1)
+        out["directory_mb"] = round(store.directory.nbytes() / 1e6, 2)
+        data = StoreFleetData(store)
+
+        # -- composed SIM drill: churn × codec × chaos × pool ------------
+        spec = FleetSpec(n_devices=n_devices, seed=11, horizon_s=horizon_s,
+                         mean_online=0.8, base_round_s=30.0, slot_s=120.0,
+                         speed_alpha=1.5, diurnal_amplitude=0.4,
+                         diurnal_period_s=2400.0, arrival_spread_s=60.0)
+        trace = make_fleet_trace(spec)
+        cfg0 = FedConfig(client_num_in_total=C,
+                         client_num_per_round=n_devices,
+                         comm_round=10 ** 9, epochs=1, batch_size=2,
+                         lr=0.05, frequency_of_the_test=10 ** 9)
+        sim_nets = []
+        for w in (1, 2):
+            _check_section_deadline()
+            sim = FleetSimulator(
+                model, data, None,
+                dataclasses.replace(cfg0, ingest_workers=w), trace,
+                mode="fedbuff", buffer_k=buffer_k, wire_codec=codec_spec,
+                sim_wire="tensor",
+                chaos=ChaosSpec(seed=11, dup_p=0.05, delay_p=0.05),
+                directory=store.directory)
+            # Warm the shared jit cache outside the timed window.
+            c0 = int(store.directory.sample_cohort(0, 1)[0])
+            jax.block_until_ready(sim.local_train(
+                sim.net0, data.x[c0], data.y[c0], data.mask[c0],
+                jax.random.PRNGKey(0))[0])
+            t0 = time.perf_counter()
+            res = sim.run()
+            dt = time.perf_counter() - t0
+            uploads = len(res.arrival_log)
+            h = sim.server.health()
+            s = res.summary()
+            virt = max(res.virtual_s, 1e-9)
+            sim_nets.append(sim.server.net)
+            out[f"sim_workers_{w}"] = {
+                "uploads": uploads, "wall_s": round(dt, 2),
+                "updates": res.updates,
+                "uploads_per_vmin": round(60.0 * uploads / virt, 2),
+                "bytes_rx_total": h["bytes_rx"],
+                "bytes_per_upload": round(h["bytes_rx"] / max(uploads, 1),
+                                          1),
+                "bytes_per_vsec": round(h["bytes_rx"] / virt, 1),
+                "staleness_p50": s.get("staleness_p50"),
+                "staleness_p95": s.get("staleness_p95"),
+                "staleness_max": s.get("staleness_max"),
+                "evictions": s["evictions"],
+                "churn_killed_uploads": s["churn_killed_uploads"],
+                "host_rss_mb": s["host_rss_mb"],
+            }
+        out["sim_nets_bitequal"] = bool(all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(sim_nets[0]),
+                            jax.tree.leaves(sim_nets[1]))))
+
+        # -- ingest-saturation curve: the server alone at offered load --
+        rng = np.random.RandomState(5)
+        # The servers start from the composed drill's final net (host
+        # numpy copy) — same shapes as the frames, zero extra init cost.
+        net0 = jax.tree.map(np.asarray, sim_nets[0])
+        spec_tree = tree_spec(net0)
+        codec = make_wire_codec(codec_spec)
+        frames = []
+        for r in range(min(n_devices, 8)):
+            delta = jax.tree.map(
+                lambda l: (0.01 * rng.randn(*np.shape(l))).astype(
+                    np.float32), net0)
+            frames.append(codec.encode(delta, None, 1000 + r)[0])
+
+        def saturation_arm(workers):
+            _check_section_deadline()
+            class A:  # the fake-clock protocol-test shim
+                pass
+
+            a = A()
+            a.chaos = None
+            a.network = LoopbackNetwork(n_devices + 1)
+            # Full participation here (client_num_in_total = the device
+            # count): the saturation sub-drill isolates the INGEST path,
+            # and the per-version 2^20-population cohort draw is ~19 ms
+            # of unrelated dispatch-thread work per flush that would
+            # blur the curve. The composed SIM arms above keep the full
+            # 1M directory sampling in the loop.
+            cfg = dataclasses.replace(cfg0, ingest_workers=workers,
+                                      client_num_in_total=n_devices)
+            srv = FedBuffServerManager(a, net0, cfg, n_devices + 1,
+                                       buffer_k=buffer_k)
+            srv.register_message_receive_handlers()
+            seqs = {}
+            t0 = time.perf_counter()
+            for i in range(saturation_uploads):
+                worker = 1 + (i % n_devices)
+                m = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, worker, 0)
+                m.add(Message.MSG_ARG_KEY_MODEL_PARAMS,
+                      frames[i % len(frames)])
+                m.add(CODEC_KEY, codec_spec)
+                m.add(MSG_ARG_KEY_MODEL_VERSION, srv.version)
+                m.add(MSG_ARG_KEY_TASK_SEQ, seqs.get(worker, 0))
+                seqs[worker] = seqs.get(worker, 0) + 1
+                # Through receive_message, not the bare handler: the
+                # dispatch-thread occupancy clock lives there.
+                srv.receive_message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, m)
+            if srv._pool is not None:
+                srv._pool.drain()
+            dt = time.perf_counter() - t0
+            prof = srv.ingest_profile()
+            pool = prof.get("ingest_pool") or {}
+            occ = pool.get("occupancy_per_worker")
+            arm = {
+                "uploads": saturation_uploads, "wall_s": round(dt, 2),
+                "uploads_per_sec": round(saturation_uploads / dt, 1),
+                "versions": srv.version,
+                "ingest_occupancy": prof.get("ingest_occupancy"),
+                "pool_occupancy_mean": (round(float(np.mean(occ)), 4)
+                                        if occ else None),
+                "pool_task_ms_p50": prof.get("pool_task_ms_p50"),
+            }
+            if srv._pool is not None:
+                srv._pool.close()
+            return arm
+
+        sat = {f"workers_{w}": saturation_arm(w) for w in workers_arms}
+        out["saturation"] = sat
+        u1 = sat.get("workers_1", {}).get("uploads_per_sec")
+        u4 = sat.get("workers_4", {}).get("uploads_per_sec")
+        out["uploads_per_sec"] = u4
+        out["ingest_speedup_4v1"] = (round(u4 / u1, 2)
+                                     if u1 and u4 else None)
+        u0 = sat.get("workers_0", {}).get("uploads_per_sec")
+        out["ingest_speedup_4v0"] = (round(u4 / u0, 2)
+                                     if u0 and u4 else None)
+        return out
+    finally:
+        shutil.rmtree(spill, ignore_errors=True)
 
 
 def bench_fleet_sim():
@@ -1747,6 +1973,7 @@ def main():
                 ("chaos", bench_chaos),
                 ("wire_codec", bench_wire_codec),
                 ("ingest_profile", bench_ingest_profile),
+                ("serving_1m", bench_serving_1m),
                 ("fleet_sim", bench_fleet_sim),
                 ("stackoverflow_342k", bench_stackoverflow_342k),
                 ("synthetic_1m", bench_synthetic_1m),
@@ -1923,6 +2150,13 @@ def build_headline(out, full_path="docs/bench_local.json"):
             # attack (decode/fold p50/p95 live in the full blob).
             "ingest_occupancy": _scalar("ingest_profile",
                                         "ingest_occupancy"),
+            # The r12 serving headline: the composed 1M-device drill's
+            # ingest-saturation curve — uploads/s at 4 pool workers and
+            # its ratio over the 1-worker serial pool (the server-ingest
+            # wall, broken; per-arm occupancies live in the full blob).
+            "uploads_per_sec": _scalar("serving_1m", "uploads_per_sec"),
+            "ingest_speedup_4v1": _scalar("serving_1m",
+                                          "ingest_speedup_4v1"),
             "fleet_buffered_vs_firstk": _scalar(
                 "fleet_sim", "buffered_vs_firstk_throughput"),
             "fleet_buffered_stale_p95_vs_async": _scalar(
@@ -1934,17 +2168,15 @@ def build_headline(out, full_path="docs/bench_local.json"):
             "synthetic_1m_rps": _scalar("synthetic_1m", "rounds_per_sec"),
             "synthetic_1m_peak_rss_ratio": _scalar("synthetic_1m",
                                                    "peak_rss_ratio"),
-            "vit_sps": _scalar("vit_cifar_shaped", "samples_per_sec"),
             # b128_sps / s2d_b128_sps rotated out in r9, s2d_sps in r10
             # (tuned_best and the s2d section's MFU pair carry the s2d
-            # story) to fund the layout/fused/MFU and wire_codec scalars
-            # under the <1KB tail budget.
+            # story), vit_sps + sharded_sps in r12 (stable since r4; the
+            # full blob keeps them) to fund the layout/fused/MFU,
+            # wire_codec and serving_1m scalars under the <1KB budget.
             "fused_speedup": _scalar("layout_fused_round",
                                      "fused_speedup"),
             "layout_pad_ratio": _scalar("layout_fused_round",
                                         "layout_pad_ratio"),
-            "sharded_sps": _scalar("sharded_path_mesh1",
-                                   "samples_per_sec"),
             "flash_speedup_t16384": _scalar("flash_attention_sweep",
                                             "points", "t16384", "speedup"),
             "transformer_mfu": _scalar("transformer_fed_mfu", "mfu"),

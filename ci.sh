@@ -183,6 +183,52 @@ print(f"compressed smoke OK: acc={accs[-1]:.2f}, "
       f"rx={h['bytes_rx']}B tx={h['bytes_tx']}B")
 PYEOF
 
+echo "== parallel ingest pool: workers=2 bit-equal to workers=1 + pool spans =="
+python - <<'PYEOF'
+import json, os, tempfile
+import numpy as np, jax
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg_distributed import FedML_FedAvg_distributed
+from fedml_tpu.data.batching import batch_global, build_federated_arrays
+from fedml_tpu.data.partition import partition_homo
+from fedml_tpu.data.synthetic import make_classification
+from fedml_tpu.models.lr import LogisticRegression
+
+x, y = make_classification(240, n_features=16, n_classes=4, seed=1)
+fed = build_federated_arrays(x, y, partition_homo(len(x), 4), batch_size=16)
+test = batch_global(x[:64], y[:64], 16)
+
+def run(workers, trace_dir=None):
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=2, epochs=2, batch_size=16, lr=0.3,
+                    frequency_of_the_test=1, ingest_workers=workers)
+    return FedML_FedAvg_distributed(
+        LogisticRegression(num_classes=4), fed, test, cfg,
+        wire_codec="topk0.25+int8", loopback_wire="tensor",
+        trace_dir=trace_dir)
+
+with tempfile.TemporaryDirectory() as td:
+    a1 = run(1)
+    a2 = run(2, trace_dir=td)
+    # The pooled fixed-point fold is associative-exact: any worker count
+    # lands the bit-identical final net regardless of loopback's
+    # thread-scheduled arrival order.
+    for l1, l2 in zip(jax.tree.leaves(a1.net), jax.tree.leaves(a2.net)):
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    prof = a2.ingest_profile
+    assert prof["ingest_pool"]["workers"] == 2, prof
+    # Every pool worker traced its tasks: nonzero per-worker span count.
+    chrome = json.load(open(os.path.join(td, "trace.chrome.json")))
+    per_worker = {}
+    for e in chrome["traceEvents"]:
+        if e["name"] == "ingest.pool":
+            per_worker[e["args"]["worker"]] = \
+                per_worker.get(e["args"]["worker"], 0) + 1
+    assert per_worker and all(n > 0 for n in per_worker.values()), per_worker
+    assert sum(per_worker.values()) == 8  # 2 rounds x 4 uploads
+print(f"ingest pool smoke OK: bit-equal nets, pool spans {per_worker}")
+PYEOF
+
 echo "== obs smoke: flight recorder + span trace + ingest histograms =="
 python - <<'PYEOF'
 import json, os, tempfile

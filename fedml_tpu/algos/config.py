@@ -136,6 +136,19 @@ class FedConfig:
     checkpoint_every: int = 0
     round_timeout_s: float = 0.0
     heartbeat_interval_s: float = 0.0
+    # Parallel server-ingest pool (comm/ingest.py, --ingest_workers):
+    # N decode+fold worker threads pull codec decode / delta
+    # reconstruction / accumulator folds off the message-passing
+    # servers' single dispatch thread — the measured serving wall
+    # (ingest_occupancy 0.78, arXiv:2307.06561). Mean aggregation only
+    # (per-worker fixed-point partial accumulators merge associative-
+    # exactly, so any worker count is bit-equal to the 1-worker pool
+    # regardless of arrival interleaving; non-mean robust aggregators
+    # keep the serialized stack-then-reduce path and REFUSE this flag).
+    # 0 (default) keeps the legacy inline float fold untouched. The
+    # simulator tiers refuse the flag loudly (their rounds have no
+    # dispatch thread to unblock).
+    ingest_workers: int = 0
     # Federation flight recorder (obs/trace.py, --trace at the CLI;
     # docs/OBSERVABILITY.md): record upload-lifecycle spans (client
     # serialize → wire → codec decode → accumulator fold → round commit,

@@ -86,8 +86,16 @@ class FedAsyncServerManager(ServerManager):
                  staleness_exp: float = 0.5, eval_fn=None, test_data=None,
                  *, done_timeout_s: Optional[float] = None,
                  metrics=None, flight_dir: Optional[str] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, directory=None):
         super().__init__(args, rank=0, size=size, backend=backend)
+        # Optional data.directory.ClientDirectory: the production cohort
+        # sampler (PR 7) — client assignment draws from its O(clients)
+        # count metadata instead of the flat sample_clients law, so a
+        # million-client fleet drill samples the same ids a re-sharded
+        # deployment would (re-sharding invariance is pinned in
+        # tests/test_directory.py).
+        self._directory = directory
+        self._cohort_cache = None  # (version, sampled ids) memo
         self.net = net
         self.cfg = cfg
         self.alpha = alpha
@@ -150,6 +158,23 @@ class FedAsyncServerManager(ServerManager):
         self._h_bytes = self.registry.histogram("bytes_per_upload", lo=1.0)
         self._h_stale = self.registry.histogram("staleness", lo=1.0)
         self._g_queue = self.registry.gauge("ingest_queue_depth")
+        # Parallel ingest pool (comm/ingest.py, cfg.ingest_workers > 0).
+        # Pure async mixes every arrival into the global immediately —
+        # an inherently sequential fold — so HERE the pool only hosts
+        # the numpy frame decode (strict request/response semantics and
+        # the mix order are unchanged, and any worker count is trivially
+        # bit-equal to inline). The buffered subclass (fedbuff.py)
+        # defers decode AND fold into the pool and reaps the
+        # parallelism; see _defer_decode.
+        workers = int(getattr(cfg, "ingest_workers", 0) or 0)
+        if workers > 0:
+            from fedml_tpu.comm.ingest import IngestPool
+
+            self._pool = IngestPool(workers, registry=self.registry)
+            self._g_pool_queue = self.registry.gauge(
+                "ingest_pool_queue_depth")
+        else:
+            self._pool = None
         self.flight = obs_trace.FlightRecorder(
             clock=clock,
             path=(os.path.join(flight_dir, "flight_recorder.jsonl")
@@ -212,7 +237,27 @@ class FedAsyncServerManager(ServerManager):
 
     def finish(self) -> None:
         self._stopped = True
+        if self._pool is not None:
+            self._pool.close()
         super().finish()
+
+    def _defer_decode(self) -> bool:
+        """True when the pooled path defers the frame decode into its
+        ingest task (the buffered tier) instead of decoding before
+        ``_ingest`` — the base async tier decodes up front (via the pool
+        when one exists, synchronously) because its mix is sequential."""
+        return False
+
+    def _decode_upload(self, wcodec: str, payload, **meta):
+        """Frame decode, routed through the ingest pool when one is
+        configured (the numpy decode releases the GIL there); raises
+        :class:`~fedml_tpu.comm.codec.CodecError` either way, so the
+        caller's refusal policy is path-independent."""
+        if self._pool is None:
+            return self._wire_decoders.decode(wcodec, payload, self._spec)
+        return self._pool.run(
+            lambda: self._wire_decoders.decode(wcodec, payload, self._spec),
+            **meta)
 
     # -- bounded termination (the sync control plane's watchdog, scoped to
     # the done handshake: async progress never blocks on one worker, but
@@ -298,6 +343,33 @@ class FedAsyncServerManager(ServerManager):
                                version=self.version)
             self._send_assignment(worker, recovery=True)
 
+    def _refuse_upload(self, worker: int, err, *, codec=None,
+                       task_seq=None) -> None:
+        """The async tiers' ONE evict-and-release refusal policy, shared
+        by the inline decode path (handle_upload) and the buffered
+        tier's pooled flush barrier (fedbuff._flush_buffer): a refusal
+        is a deterministic encoder mismatch (resends are bit-identical),
+        so neither waiting nor re-assigning can recover the worker —
+        evict it and send done=True so it exits cleanly; the run
+        finishes when no members remain. (The sync tier keeps its own
+        twin with round-completion/abort semantics this tier lacks.)"""
+        self.codec_refusals += 1
+        log.error("rank %d: codec %r frame refused (%s) — evicting and "
+                  "releasing the worker (a mismatched encoder can never "
+                  "upload a usable model)", worker, codec, err)
+        fields = {"sender": worker, "error": str(err)[:200]}
+        if task_seq is not None:
+            fields["task_seq"] = task_seq
+        if codec is not None:
+            fields["codec"] = str(codec)
+        self.flight.record("codec_refusal", **fields)
+        with self._lock:
+            if worker in self._members:
+                self._members.discard(worker)
+                self.evictions += 1
+        self.flight.dump()
+        self._send_done(worker)  # release; finishes when empty
+
     def _evict_dead(self, worker: int, err: BaseException, what: str) -> None:
         """A send failed past the retry policy: evict — guarded, so
         repeated failures to an already-evicted rank don't inflate the
@@ -339,10 +411,25 @@ class FedAsyncServerManager(ServerManager):
 
     def _assign_client(self, worker: int) -> int:
         """Deterministic per-(version, worker) client assignment — the
-        async analogue of the reference's seeded per-round sampling."""
-        idx = sample_clients(self.version, self.cfg.client_num_in_total,
-                             min(self.size - 1, self.cfg.client_num_in_total))
-        return int(idx[(worker - 1) % len(idx)])
+        async analogue of the reference's seeded per-round sampling.
+        With a ClientDirectory attached, the draw rides the directory's
+        count metadata (the production sampler; invariant under
+        re-sharding). The sampled cohort is MEMOIZED per version: the
+        draw is O(client_num_in_total) — ~16 ms of dispatch-thread work
+        at 2^20 clients — and every worker assigned at one version gets
+        a slice of the SAME deterministic cohort, so re-drawing it per
+        reply burned a model-fold's worth of GIL per upload for
+        identical values (caught by the serving_1m saturation drill)."""
+        n = min(self.size - 1, self.cfg.client_num_in_total)
+        cache = self._cohort_cache
+        if cache is None or cache[0] != self.version:
+            if self._directory is not None:
+                idx = self._directory.sample_cohort(self.version, n)
+            else:
+                idx = sample_clients(self.version,
+                                     self.cfg.client_num_in_total, n)
+            cache = self._cohort_cache = (self.version, idx)
+        return int(cache[1][(worker - 1) % len(cache[1])])
 
     def send_init_msg(self) -> None:
         for worker in range(1, self.size):
@@ -428,8 +515,10 @@ class FedAsyncServerManager(ServerManager):
             depth = depth()
             if depth is not None:
                 self._g_queue.set(depth)
+        if self._pool is not None:
+            self._g_pool_queue.set(self._pool.queue_depth())
         wcodec = msg.get(wire_codec.CODEC_KEY)
-        if wcodec:
+        if wcodec and not self._defer_decode():
             # Wire-codec frame (comm/codec.py): self-described, decoded
             # pickle-free against the server's model spec. A corrupt
             # frame is REFUSED (never mixed); the transport guarantees
@@ -444,25 +533,13 @@ class FedAsyncServerManager(ServerManager):
                 with tr.span("ingest.decode", cat="ingest", corr=ck,
                              codec=wcodec):
                     msg.add(MSG_ARG_KEY_MODEL_PARAMS,
-                            self._wire_decoders.decode(
+                            self._decode_upload(
                                 wcodec, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
-                                self._spec))
+                                sender=worker, task_seq=task))
                 self._h_decode.record((time.perf_counter() - t0) * 1e3)
             except (wire_codec.CodecError, ValueError) as err:
-                self.codec_refusals += 1
-                log.error("rank %d: codec %r frame refused (%s) — "
-                          "evicting and releasing the worker (a "
-                          "mismatched encoder can never upload a "
-                          "usable model)", worker, wcodec, err)
-                self.flight.record("codec_refusal", sender=worker,
-                                   task_seq=task, codec=str(wcodec),
-                                   error=str(err)[:200])
-                with self._lock:
-                    if worker in self._members:
-                        self._members.discard(worker)
-                        self.evictions += 1
-                self.flight.dump()
-                self._send_done(worker)  # release; finishes when empty
+                self._refuse_upload(worker, err, codec=wcodec,
+                                    task_seq=task)
                 return
         staleness = self.version - base_ver
         self.staleness_history.append(staleness)
@@ -489,6 +566,13 @@ class FedAsyncServerManager(ServerManager):
         if self.version >= self.cfg.comm_round:
             self._send_done(worker)
             return
+        with self._lock:
+            if worker not in self._members:
+                # Evicted during _ingest (the buffered tier's pooled
+                # flush refuses corrupt frames at its barrier and
+                # releases the sender with a done) — don't hand a
+                # released worker fresh work.
+                return
         self._send_assignment(worker)
 
     def _ingest(self, msg: Message, staleness: int) -> None:

@@ -134,6 +134,16 @@ class FedAvgAPI(FederatedLoop):
                 "tier capability (cross-silo / FedAsync / FedBuff, "
                 "comm/codec.py); the simulator tiers compress on device "
                 "via cfg.compress")
+        if getattr(cfg, "ingest_workers", 0):
+            # Same convention: the parallel ingest pool unblocks a
+            # message-passing server's dispatch thread; the simulator
+            # tiers aggregate inside the jitted round and have no such
+            # thread to unblock.
+            raise NotImplementedError(
+                f"cfg.ingest_workers={cfg.ingest_workers} is a message-"
+                "passing server capability (cross-silo / FedAsync / "
+                "FedBuff, comm/ingest.py); the simulator tiers have no "
+                "dispatch thread to parallelize")
         self._loss_fn = loss_fn
         self._nan_guard = nan_guard
         # Byzantine-robust server aggregation (core/robust_agg): resolved

@@ -220,6 +220,26 @@ class FedAVGAggregator:
             self.model_dict.pop(i, None)
         return self.net
 
+    def aggregate_pooled(self, indices, pool):
+        """The pooled-mean twin of :meth:`aggregate_from`: the ingest
+        pool (comm/ingest.py) already holds ``Σ w·x`` in exact fixed
+        point across its per-worker partials — merge, divide once, cast
+        to the reference dtypes. The pool's task count must equal the
+        arrived set (same protocol pin as the streaming subset check: a
+        mismatch is a bug, not something to silently mis-weight). An
+        empty index set keeps the previous net."""
+        indices = list(indices)
+        mean, count = pool.finalize_mean(self.net)
+        if count != len(indices):
+            raise ValueError(
+                f"ingest pool folded {count} uploads but the round "
+                f"arrived {len(indices)}: the pooled mean cannot subset "
+                "after arrival")
+        if not indices or mean is None:
+            return self.net
+        self.net = mean
+        return self.net
+
     def client_sampling(self, round_idx: int) -> np.ndarray:
         return sample_clients(
             round_idx, self.cfg.client_num_in_total, self.cfg.client_num_per_round
@@ -315,6 +335,27 @@ class FedAVGServerManager(ServerManager):
         self._h_fold = self.registry.histogram("fold_ms")
         self._h_bytes = self.registry.histogram("bytes_per_upload", lo=1.0)
         self._g_queue = self.registry.gauge("ingest_queue_depth")
+        # Parallel ingest pool (comm/ingest.py, cfg.ingest_workers > 0):
+        # decode + delta reconstruction + the mean fold move to worker
+        # threads with per-worker associative-exact partial accumulators;
+        # the round flush barriers on the pool and merges. Mean only —
+        # the robust aggregators reduce the cohort side by side
+        # (stack-then-reduce), which is inherently serialized.
+        workers = int(getattr(cfg, "ingest_workers", 0) or 0)
+        if workers > 0 and not aggregator.aggregator.is_mean:
+            raise ValueError(
+                f"ingest_workers={workers} needs the mean aggregator: "
+                f"{aggregator.aggregator.name!r} retains the serialized "
+                "stack-then-reduce cohort buffer — run it with "
+                "ingest_workers=0 (comm/ingest.py)")
+        if workers > 0:
+            from fedml_tpu.comm.ingest import IngestPool
+
+            self._pool = IngestPool(workers, registry=self.registry)
+            self._g_pool_queue = self.registry.gauge(
+                "ingest_pool_queue_depth")
+        else:
+            self._pool = None
         self.flight = obs_trace.FlightRecorder(
             clock=clock,
             path=(os.path.join(flight_dir, "flight_recorder.jsonl")
@@ -369,6 +410,8 @@ class FedAVGServerManager(ServerManager):
 
     def finish(self) -> None:
         self._stopped = True
+        if self._pool is not None:
+            self._pool.close()
         if self._ckpt is not None:
             try:
                 self._save_checkpoint(wait=True)
@@ -693,6 +736,21 @@ class FedAVGServerManager(ServerManager):
             depth = depth()
             if depth is not None:
                 self._g_queue.set(depth)
+        if self._pool is not None:
+            # Pooled ingest: the dispatch thread only does the accept
+            # bookkeeping; decode + delta reconstruction + the exact
+            # partial fold run on the pool, and the round flush barriers
+            # on it. A frame that refuses in a worker is surfaced at the
+            # barrier and evict-and-released there (_settle_pool).
+            self._g_pool_queue.set(self._pool.queue_depth())
+            self._submit_ingest(sender, t, payload, codec, wcodec,
+                                float(msg.get(MSG_ARG_KEY_NUM_SAMPLES)), ck)
+            with self._lock:
+                self._arrived.add(sender)
+                ready = len(self._arrived) >= self._k_effective()
+            if ready:
+                self._complete_round()
+            return
         if codec:
             # Dispatch on the frame's self-described codec, not a server
             # flag: per-rank launches may configure compression on the
@@ -764,7 +822,76 @@ class FedAVGServerManager(ServerManager):
         if ready:
             self._complete_round()
 
+    def _submit_ingest(self, sender: int, round_idx: int, payload, codec,
+                       wcodec, weight: float, ck) -> None:
+        """Build one upload's decode+fold task and hand it to the pool.
+        The closure snapshots this round's broadcast anchor (compressed
+        uploads are deltas against it) so a late-running task cannot
+        reconstruct against the NEXT round's net."""
+        anchor = self._broadcast_net
+        spec = self._spec
+
+        def task():
+            if codec:
+                if codec not in self._decoders:
+                    self._decoders[codec] = make_compressor(codec)
+                delta = self._decoders[codec].decode(payload, spec)
+            elif wcodec:
+                delta = self._wire_decoders.decode(wcodec, payload, spec)
+            else:
+                delta = None
+            if delta is None:
+                return ([np.asarray(l) for l in jax.tree.leaves(payload)],
+                        weight)
+            # Delta frame: the fold computes w*(anchor + delta) in the
+            # accumulator's preallocated scratch — no model-sized
+            # temporary on the task path.
+            return ([np.asarray(d) for d in jax.tree.leaves(delta)],
+                    weight,
+                    [np.asarray(a) for a in jax.tree.leaves(anchor)])
+
+        # ck (the correlation key) already carries epoch/round/sender —
+        # the span args double as the failure metadata _settle_pool reads.
+        self._pool.submit(task, **ck)
+
+    def _settle_pool(self) -> bool:
+        """Round-flush barrier on the ingest pool. Failed tasks (corrupt
+        codec frames) get the refusal policy HERE — evict AND RELEASE,
+        same as the inline path, just deferred to the barrier — and the
+        round's readiness is re-checked over the survivors. Returns True
+        when the round can complete now."""
+        failures = self._pool.drain()
+        for meta, err in failures:
+            sender = int(meta.get("sender", -1))
+            self.codec_refusals += 1
+            log.error("rank %d: pooled ingest refused (%s) — evicting and "
+                      "releasing the worker (a mismatched encoder can "
+                      "never upload a usable model)", sender, err)
+            self.flight.record("codec_refusal", sender=sender,
+                               round=meta.get("round"),
+                               error=str(err)[:200])
+            with self._lock:
+                self._arrived.discard(sender)
+            self._evict([sender])
+            self.flight.dump()
+        with self._lock:
+            empty = not self._members
+            ready = bool(self._arrived) and (
+                len(self._arrived) >= self._k_effective())
+        if failures and empty:
+            # Mark the abort BEFORE the releases below: sending the
+            # last done finishes the server, and the flag must already
+            # be truthful when run() returns (inline-path ordering).
+            log.error("all workers refused/evicted at round %d: "
+                      "abandoning the run", self.round_idx)
+            self.aborted = True
+        for meta, _ in failures:
+            self._send_done(int(meta.get("sender", -1)))  # release
+        return ready and not empty
+
     def _complete_round(self) -> None:
+        if self._pool is not None and not self._settle_pool():
+            return  # refusals thinned the round below readiness
         with self._lock:
             arrived = sorted(self._arrived)
             self._arrived = set()
@@ -772,8 +899,12 @@ class FedAVGServerManager(ServerManager):
                 "round.commit", cat="round",
                 corr=obs_trace.corr(epoch=self.epoch, round=self.round_idx),
                 arrived=len(arrived)):
-            global_net = self.aggregator.aggregate_from(
-                [w - 1 for w in arrived])
+            if self._pool is not None:
+                global_net = self.aggregator.aggregate_pooled(
+                    [w - 1 for w in arrived], self._pool)
+            else:
+                global_net = self.aggregator.aggregate_from(
+                    [w - 1 for w in arrived])
         self.flight.record("round_commit", round=self.round_idx,
                            arrived=len(arrived))
         self._broadcast_net = global_net

@@ -57,6 +57,7 @@ import numpy as np
 
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.fedasync import (
+    MSG_ARG_KEY_TASK_SEQ,
     FedAsyncClientManager,
     FedAsyncServerManager,
     staleness_weight,
@@ -65,6 +66,7 @@ from fedml_tpu.algos.fedavg_distributed import (
     MSG_ARG_KEY_MODEL_PARAMS,
     build_federation_setup,
 )
+from fedml_tpu.comm import codec as wire_codec
 from fedml_tpu.comm.loopback import run_workers
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.resilience import ChaosSpec
@@ -103,16 +105,27 @@ class FedBuffServerManager(FedAsyncServerManager):
                  nan_guard: bool = True,
                  done_timeout_s: Optional[float] = None,
                  metrics=None, flight_dir=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, directory=None):
         super().__init__(args, net, cfg, size, backend=backend, alpha=alpha,
                          staleness_exp=staleness_exp, eval_fn=eval_fn,
                          test_data=test_data, done_timeout_s=done_timeout_s,
                          metrics=metrics, flight_dir=flight_dir,
-                         clock=clock)
+                         clock=clock, directory=directory)
         if buffer_k < 1:
             raise ValueError(f"buffer_k must be >= 1, got {buffer_k}")
         self.buffer_k = buffer_k
         self.aggregator = make_aggregator(aggregator)
+        if self._pool is not None and not self.aggregator.is_mean:
+            # super().__init__ already started the pool's worker
+            # threads — close them before refusing, or every failed
+            # construction leaks N blocked daemon threads.
+            self._pool.close()
+            raise ValueError(
+                f"ingest_workers={cfg.ingest_workers} needs the mean "
+                f"aggregator: {self.aggregator.name!r} reduces the k-deep "
+                "buffer side by side (stack-then-reduce), which is "
+                "inherently serialized — run it with ingest_workers=0 "
+                "(comm/ingest.py)")
         self.nan_guard = nan_guard
         self.guard_drops = 0  # non-finite deltas weight-zeroed out
         # Mean fast path: running discounted sum + weight, O(model).
@@ -145,8 +158,52 @@ class FedBuffServerManager(FedAsyncServerManager):
         h["guard_drops"] = self.guard_drops
         return h
 
+    def _defer_decode(self) -> bool:
+        # With a pool, the buffered tier moves frame decode AND the
+        # discounted fold into its ingest task (the window between
+        # flushes is where the parallelism lives: the net only changes
+        # at the flush, so deferral changes no reply a worker sees).
+        return self._pool is not None
+
+    def _submit_buffered(self, msg: Message, disc: float) -> None:
+        payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        wcodec = msg.get(wire_codec.CODEC_KEY)
+        spec = self._spec
+        guard = self.nan_guard
+        sender = msg.get_sender_id()
+        task_seq = msg.get(MSG_ARG_KEY_TASK_SEQ)
+
+        def task():
+            delta = (self._wire_decoders.decode(wcodec, payload, spec)
+                     if wcodec else payload)
+            leaves = [np.asarray(l) for l in jax.tree.leaves(delta)]
+            w = disc
+            if guard and not all(np.isfinite(l).all() for l in leaves):
+                # Weight-zeroed like the inline tier's nan_guard; the
+                # exact accumulator maps non-finite entries to 0, so a
+                # poisoned delta contributes nothing either way.
+                with self._lock:
+                    self.guard_drops += 1
+                w = 0.0
+            return leaves, w
+
+        self._pool.submit(task, sender=sender,
+                          **({"task_seq": int(task_seq)}
+                             if task_seq is not None else {}))
+
     def _ingest(self, msg: Message, staleness: int) -> None:
         disc = staleness_weight(1.0, staleness, self.staleness_exp)
+        if self._pool is not None:
+            # Pooled path: decode + guard + discounted fold run on the
+            # pool; the slot is consumed NOW (the arrival happened — a
+            # frame that later refuses weighs 0 in this window, the
+            # participation-gate semantics of a guard drop, and its
+            # sender is evict-and-released at the flush barrier).
+            self._submit_buffered(msg, disc)
+            self._count += 1
+            if self._count >= self.buffer_k:
+                self._flush()
+            return
         delta = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         if self.nan_guard and not _tree_finite(delta):
             # Weight-zeroed like the windowed tier's nan_guard: the slot
@@ -198,6 +255,25 @@ class FedBuffServerManager(FedAsyncServerManager):
         self.registry.gauge("buffer_depth").set(flushed)
 
     def _flush_buffer(self) -> None:
+        if self._pool is not None:
+            # Barrier on the window's pending decode+fold tasks, apply
+            # the refusal policy to failures, then merge the per-worker
+            # exact partials: mean delta = Σ disc·d / Σ disc, identical
+            # bits for any worker count / interleaving (comm/ingest.py).
+            for meta, err in self._pool.drain():
+                # The shared async-tier refusal policy (fedasync.
+                # _refuse_upload), applied at the flush barrier where
+                # pooled failures surface.
+                self._refuse_upload(int(meta.get("sender", -1)), err,
+                                    task_seq=meta.get("task_seq"))
+            mean_delta, _ = self._pool.finalize_mean(self.net,
+                                                     dtype=np.float32)
+            if mean_delta is not None:
+                self.net = self._apply(self.net, mean_delta,
+                                       jnp.float32(self.alpha))
+            self._count = 0
+            self.version += 1
+            return
         if self.aggregator.is_mean:
             if self._wsum > 0.0:
                 delta = self._lift(self._acc, jnp.float32(1.0 / self._wsum))

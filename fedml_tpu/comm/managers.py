@@ -151,4 +151,15 @@ class ServerManager(_Manager):
                          "staleness"):
                 out.update(hist_fields(reg.histogram(name), name))
             out["uploads"] = reg.histogram("fold_ms").count
+        # Parallel ingest pool (comm/ingest.py): per-worker occupancy +
+        # task latency ride the same profile so the before/after of the
+        # pooled fold is visible in one ruler (docs/OBSERVABILITY.md).
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            out["ingest_pool"] = pool.profile()
+            if reg is not None:
+                out.update(hist_fields(reg.histogram("pool_task_ms"),
+                                       "pool_task_ms"))
+                out["uploads"] = max(out["uploads"],
+                                     reg.histogram("pool_task_ms").count)
         return out

@@ -146,6 +146,16 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "(e.g. topk0.01+int8). Sparsifiers carry "
                         "per-client error feedback; falls back loudly "
                         "against a codec-ignorant peer (comm/codec.py)")
+    p.add_argument("--ingest_workers", type=int, default=0,
+                   help="parallel server-ingest pool for the message-"
+                        "passing tiers (cross-silo / FedAsync / FedBuff, "
+                        "comm/ingest.py): N decode+fold worker threads "
+                        "pull codec decode and the mean accumulator fold "
+                        "off the server's dispatch thread; per-worker "
+                        "fixed-point partials merge associative-exactly, "
+                        "so any N is bit-equal to N=1. 0 (default) keeps "
+                        "the inline fold; mean aggregation only — "
+                        "non-mean --aggregator combos refuse loudly")
     p.add_argument("--compute_layout", type=str, default="none",
                    help="lane-fill compute layout for the client step: "
                         "none | auto (pad channel dims to MXU lane/"
@@ -229,6 +239,24 @@ def reject_async_tier_flags(args, algorithm: str, *,
             "main_extra) — the flag would be silently inert here")
 
 
+def reject_ingest_pool_flag(args, algorithm: str) -> None:
+    """Refuse ``--ingest_workers`` for runners with no message-passing
+    server dispatch thread to parallelize (the PR 4/6 flag-rejection
+    convention): a serving drill whose pool flag silently does nothing
+    would report the baseline as the optimized arm. The cross-silo CLI
+    and main_extra's FedAsync/FedBuff are the tiers that read it; the
+    non-mean ``--aggregator`` combination is refused by the server
+    managers themselves (the robust stack-then-reduce path is
+    inherently serialized)."""
+    if getattr(args, "ingest_workers", 0):
+        raise SystemExit(
+            f"{algorithm} does not support --ingest_workers "
+            f"{args.ingest_workers}: the parallel ingest pool unblocks a "
+            "message-passing server's dispatch thread (cross-silo / "
+            "FedAsync / FedBuff, comm/ingest.py) — the flag would be "
+            "silently inert here")
+
+
 def trace_dir_from(args) -> "str | None":
     """Resolve ``--trace`` into the runners' ``trace_dir``: the run
     directory when tracing is on (refusing loudly without one — trace
@@ -289,5 +317,6 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         checkpoint_every=args.checkpoint_frequency,
         round_timeout_s=args.round_timeout_s,
         heartbeat_interval_s=args.heartbeat_interval_s,
+        ingest_workers=args.ingest_workers,
         trace=args.trace,
     )

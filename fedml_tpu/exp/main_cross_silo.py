@@ -74,6 +74,9 @@ def main(argv=None):
     # --wire_codec (also shared) is the NEGOTIATED wire codec
     # (comm/codec.py: bf16/fp16/int8/topk/randmask, composable, error
     # feedback on sparsifiers) — mutually exclusive with --compress.
+    # --ingest_workers (also shared) arms the server's parallel ingest
+    # pool (comm/ingest.py; rank 0 only — silos ignore it): decode +
+    # mean-fold off the dispatch thread, bit-equal for any worker count.
     parser.add_argument("--aggregate_k", type=int, default=0,
                         help="straggler-tolerant first-k rounds: aggregate "
                              "as soon as k fresh uploads arrive (0 = wait "

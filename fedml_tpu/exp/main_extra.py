@@ -19,7 +19,8 @@ import numpy as np
 
 from fedml_tpu.exp.args import (add_args, config_from_args,
                                 reject_async_tier_flags,
-                                reject_fedavg_family_flags)
+                                reject_fedavg_family_flags,
+                                reject_ingest_pool_flag)
 from fedml_tpu.exp.setup import global_test_batches, load_data
 from fedml_tpu.data.loaders import to_federated_arrays
 
@@ -264,6 +265,10 @@ def main(argv=None):
             f"{args.wire_codec}: the negotiated wire codec rides the "
             "message-passing upload path (FedAsync/FedBuff here, or the "
             "cross-silo CLI) — the flag would be silently inert")
+    if args.algorithm not in ("FedAsync", "FedBuff"):
+        # The parallel ingest pool likewise rides only the message-
+        # passing server tiers (FedAsync/FedBuff here; cross-silo CLI).
+        reject_ingest_pool_flag(args, args.algorithm)
     logging.basicConfig(level=logging.INFO,
                         format=f"[{args.algorithm} %(asctime)s] %(message)s")
     history = RUNNERS[args.algorithm](args)

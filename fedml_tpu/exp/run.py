@@ -109,10 +109,14 @@ def run(args, algorithm: str = "FedAvg"):
             "backend from fedml_tpu.comm")
     # The synchronous simulator tiers have no arrival buffer or
     # staleness stream — those knobs belong to main_extra's
-    # FedAsync/FedBuff runners and must refuse, not no-op.
-    from fedml_tpu.exp.args import reject_async_tier_flags
+    # FedAsync/FedBuff runners and must refuse, not no-op. Same for the
+    # parallel ingest pool: the simulator aggregates inside the jitted
+    # round, there is no server dispatch thread to unblock.
+    from fedml_tpu.exp.args import (reject_async_tier_flags,
+                                    reject_ingest_pool_flag)
 
     reject_async_tier_flags(args, algorithm)
+    reject_ingest_pool_flag(args, algorithm)
     fed, arrays, test, model, cfg, mesh = setup_standard(args)
     api = make_api(algorithm, args, model, arrays, test, cfg, mesh,
                    class_num=fed.class_num)

@@ -22,7 +22,7 @@ See docs/ROBUSTNESS.md "Serving under churn".
 """
 
 from fedml_tpu.sim.clock import EventQueue, VirtualClock
-from fedml_tpu.sim.fleet import FleetResult, FleetSimulator
+from fedml_tpu.sim.fleet import FleetResult, FleetSimulator, StoreFleetData
 from fedml_tpu.sim.trace import FleetSpec, FleetTrace, make_fleet_trace
 from fedml_tpu.sim.transport import SimCommManager, SimNetwork
 
@@ -34,6 +34,7 @@ __all__ = [
     "FleetTrace",
     "SimCommManager",
     "SimNetwork",
+    "StoreFleetData",
     "VirtualClock",
     "make_fleet_trace",
 ]

@@ -93,6 +93,8 @@ class FleetResult:
         return 60.0 * self.updates / max(self.virtual_s, 1e-9)
 
     def summary(self) -> dict:
+        from fedml_tpu.utils import rss_mb
+
         out = {
             "mode": self.mode,
             "completed": self.completed,
@@ -101,6 +103,11 @@ class FleetResult:
             "updates_per_vmin": round(self.updates_per_vmin, 3),
             "final_accuracy": self.final_accuracy,
             "churn_killed_uploads": self.churn_killed,
+            # The memory axis of the serving story (ROADMAP item 1) —
+            # CURRENT host RSS at summary time, the same single-sourced
+            # sample bench.py records per section, so sim drills report
+            # it without the bench harness.
+            "host_rss_mb": round(rss_mb(), 1),
             "evictions": self.health.get("evictions", 0),
             # Churn recovery: the sync tier counts re-admissions of
             # evicted ranks, the async/buffered tiers count recovery
@@ -121,6 +128,63 @@ class FleetResult:
         return out
 
 
+class StoreFleetData:
+    """A ``FederatedArrays``-shaped LAZY view over a ``FederatedStore``
+    (flat or sharded) for the message-passing client managers: ``x[c]``/
+    ``y[c]``/``mask[c]`` gather client ``c``'s rows on demand (memmap
+    page-ins touch only assigned clients — the composition that lets a
+    2^20-client ``ShardedFederatedStore`` + ``ClientDirectory`` back a
+    fleet drill whose resident set is O(active devices)), and ``counts``
+    is the store's O(clients) count vector. Every client is gathered at
+    ONE forced step bucket (the store-wide max) so the jitted local
+    trainer sees a single shape. A one-client cache keeps the three
+    field reads of one training call to a single gather; the sim event
+    loop is single-threaded, so no locking."""
+
+    class _Field:
+        def __init__(self, parent: "StoreFleetData", name: str):
+            self._parent = parent
+            self._name = name
+
+        def __getitem__(self, c: int):
+            return getattr(self._parent._gather(int(c)), self._name)[0]
+
+        @property
+        def dtype(self):
+            return getattr(self._parent._probe, self._name).dtype
+
+        @property
+        def shape(self):
+            # [C, S, B, ...]: only the feature dims (shape[3:]) and the
+            # client count are meaningful to callers (the trainer builds
+            # its sample from shape[3:]).
+            probe = getattr(self._parent._probe, self._name)
+            return (self._parent.store.num_clients,) + tuple(probe.shape[1:])
+
+    def __init__(self, store):
+        self.store = store
+        self.counts = np.asarray(store.counts)
+        # One fixed bucket for every client → one trainer shape.
+        self._steps = store._resolve_steps(self.counts, None)
+        self._cache_c: Optional[int] = None
+        self._cache = None
+        self._probe = self._gather(0)
+        self.x = self._Field(self, "x")
+        self.y = self._Field(self, "y")
+        self.mask = self._Field(self, "mask")
+
+    @property
+    def batch_size(self) -> int:
+        return self.store.batch_size
+
+    def _gather(self, c: int):
+        if self._cache_c != c:
+            self._cache = self.store.gather_cohort(np.asarray([c]),
+                                                   steps=self._steps)
+            self._cache_c = c
+        return self._cache
+
+
 class FleetSimulator:
     """Build one federation (server + trace.n_devices clients) in
     ``mode`` ∈ {"sync", "fedasync", "fedbuff"} and replay the trace.
@@ -130,14 +194,30 @@ class FleetSimulator:
     defaults to the tier's own default); ``buffer_k`` / ``aggregator``
     the buffered tier's knobs; ``corrupt_ranks`` + ``corruptor`` flag
     Byzantine devices (fedbuff mode). ``chaos`` installs the fleet-wide
-    ChaosTransport with virtual-time fault timers."""
+    ChaosTransport with virtual-time fault timers.
+
+    Serving-drill composition knobs (the 1M-device drill, ROADMAP item
+    1): ``wire_codec`` puts the negotiated codec on every device's
+    uploads (top-k/randmask + error feedback need delta payloads —
+    fedbuff mode; casts/int8 work everywhere); ``sim_wire`` makes the
+    SIM fabric round-trip every message through a real wire format
+    (bytes counted per rank — ``health()``'s bytes_tx/rx go live);
+    ``directory`` routes the async tiers' client assignment through a
+    ``data.directory.ClientDirectory`` (the production cohort sampler —
+    cohorts drawn from 2^20-client count metadata, re-sharding
+    invariant); ``cfg.ingest_workers`` arms the server's parallel
+    ingest pool (comm/ingest.py — decode+fold off the dispatch thread,
+    bit-equal for any worker count, so the SAME seeded drill measures
+    the ingest-saturation curve)."""
 
     def __init__(self, model, train_fed, test_global, cfg: FedConfig,
                  trace: FleetTrace, mode: str = "fedbuff", *,
                  loss_fn=softmax_ce, chaos: Optional[ChaosSpec] = None,
                  aggregate_k: int = 0, alpha: Optional[float] = None,
                  staleness_exp: float = 0.5, buffer_k: int = 2,
-                 aggregator="mean", corrupt_ranks=(), corruptor=None):
+                 aggregator="mean", corrupt_ranks=(), corruptor=None,
+                 wire_codec: str = "none", sim_wire: str = "none",
+                 directory=None):
         if mode not in MODES:
             raise ValueError(f"unknown sim mode {mode!r}; known {MODES}")
         self.mode = mode
@@ -158,11 +238,18 @@ class FleetSimulator:
         self.events = EventQueue(self.clock)
         self.network = SimNetwork(spec.n_devices + 1, self.events,
                                   latency_fn=self._latency,
-                                  deliver_guard=self._deliver_guard)
+                                  deliver_guard=self._deliver_guard,
+                                  wire=sim_wire)
         size, net0, local_train, eval_fn, args = build_federation_setup(
             model, train_fed, test_global, cfg, "SIM", loss_fn, chaos=chaos)
         args.network = self.network
         args.chaos_after = self.events.after
+        # The jitted local trainer every client shares — exposed so a
+        # bench harness can warm the jit cache OUTSIDE its timed window
+        # (the serving arms compare wall-clock uploads/s; a first-call
+        # compile inside one arm would skew the curve).
+        self.local_train = local_train
+        self.net0 = net0
         self._ready_at: Dict[Tuple[int, int], float] = {}
         self._ready_rank: Dict[int, float] = {}
         self._task_idx: Dict[int, int] = {r: -1 for r in range(1, size)}
@@ -200,18 +287,21 @@ class FleetSimulator:
                 aggregate_k=aggregate_k, clock=self.clock)
             self.clients = [
                 FedAVGClientManager(args, r, size, train_fed,
-                                    timed_local_train(r), cfg, backend="SIM")
+                                    timed_local_train(r), cfg, backend="SIM",
+                                    wire_codec_spec=wire_codec)
                 for r in range(1, size)]
         elif mode == "fedasync":
             self.server = FedAsyncServerManager(
                 args, net0, cfg, size, backend="SIM",
                 alpha=(0.6 if alpha is None else alpha),
                 staleness_exp=staleness_exp, eval_fn=eval_fn,
-                test_data=test_global, clock=self.clock)
+                test_data=test_global, clock=self.clock,
+                directory=directory)
             self.clients = [
                 FedAsyncClientManager(args, r, size, train_fed,
                                       timed_local_train(r), cfg,
-                                      backend="SIM")
+                                      backend="SIM",
+                                      wire_codec_spec=wire_codec)
                 for r in range(1, size)]
         else:  # fedbuff
             self.server = FedBuffServerManager(
@@ -219,12 +309,14 @@ class FleetSimulator:
                 alpha=(1.0 if alpha is None else alpha),
                 staleness_exp=staleness_exp, buffer_k=buffer_k,
                 aggregator=aggregator, eval_fn=eval_fn,
-                test_data=test_global, clock=self.clock)
+                test_data=test_global, clock=self.clock,
+                directory=directory)
             corrupt = set(corrupt_ranks)
             self.clients = [
                 FedBuffClientManager(args, r, size, train_fed,
                                      timed_local_train(r), cfg,
                                      backend="SIM",
+                                     wire_codec_spec=wire_codec,
                                      corruptor=(corruptor if r in corrupt
                                                 else None))
                 for r in range(1, size)]

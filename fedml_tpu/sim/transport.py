@@ -44,18 +44,35 @@ def _tracer():
 
 class SimNetwork:
     """Shared virtual-time router: observers per rank, deliveries as
-    events. Single-threaded by construction."""
+    events. Single-threaded by construction.
+
+    ``wire`` (default ``"none"``): with a real wire format name
+    (``tensor`` | ``json`` | ``pickle``) every inter-rank message is
+    serialized at post and deserialized at delivery — the LoopbackNetwork
+    round-trip mode's virtual-time twin, so a fleet drill exercises the
+    exact frame code the socket backends ship AND counts honest
+    bytes-on-wire (per-rank :class:`~fedml_tpu.comm.wire.ByteLedger`\\ s,
+    surfaced through each manager's ``bytes_ledger`` → ``health()``).
+    Self-addressed messages (the watchdog tick) skip the round-trip —
+    they never cross a wire."""
 
     def __init__(self, size: int, events: EventQueue,
                  latency_fn: Optional[Callable[[Message],
                                                Optional[float]]] = None,
                  deliver_guard: Optional[Callable[[Message], bool]] = None,
-                 default_latency_s: float = 0.0):
+                 default_latency_s: float = 0.0, wire: str = "none"):
+        from fedml_tpu.comm.wire import WIRE_FORMATS, ByteLedger
+
+        if wire not in ("none",) + WIRE_FORMATS:
+            raise ValueError(f"unknown sim wire format {wire!r}")
         self.size = size
         self.events = events
         self.latency_fn = latency_fn
         self.deliver_guard = deliver_guard
         self.default_latency_s = default_latency_s
+        self.wire = wire
+        self.ledgers: Dict[int, "ByteLedger"] = {
+            r: ByteLedger() for r in range(size)}
         self._observers: Dict[int, List[Observer]] = {}
         self._stopped: Set[int] = set()
         self.counts: Dict[str, int] = {
@@ -88,16 +105,28 @@ class SimNetwork:
                            sender=int(msg.get_sender_id()),
                            receiver=int(msg.get_receiver_id()))
             return
+        # Wire round-trip (after the latency decision, which reads the
+        # live message): bytes sit in flight, the sender's ledger counts
+        # tx NOW and the receiver's counts rx at delivery.
+        blob = None
+        sender = int(msg.get_sender_id())
+        receiver = int(msg.get_receiver_id())
+        if self.wire != "none" and sender != receiver:
+            from fedml_tpu.comm.wire import serialize_message
+
+            blob = serialize_message(msg, self.wire)
+            self.ledgers[sender].count_tx(receiver, len(blob))
         # The in-flight time becomes one "wire.sim" span at delivery:
         # install a SpanTracer over THIS simulation's VirtualClock
         # (obs.trace.tracing_to(dir, clock=sim.clock)) and the trace's
         # time axis is virtual seconds — compute charge + wire latency
         # drawn exactly as the drill scheduled them.
         t_sent = _tracer().now()
-        self.events.after(latency, lambda m=msg, t0=t_sent: self._deliver(
-            m, t0))
+        self.events.after(latency, lambda m=msg, b=blob, t0=t_sent:
+                          self._deliver(m, t0, b))
 
-    def _deliver(self, msg: Message, t_sent: float = 0.0) -> None:
+    def _deliver(self, msg: Message, t_sent: float = 0.0,
+                 blob=None) -> None:
         receiver = int(msg.get_receiver_id())
         tr = _tracer()
         if receiver in self._stopped:
@@ -113,6 +142,12 @@ class SimNetwork:
                            receiver=receiver)
             return
         self.counts["delivered"] += 1
+        if blob is not None:
+            from fedml_tpu.comm.wire import deserialize_message
+
+            self.ledgers[receiver].count_rx(int(msg.get_sender_id()),
+                                            len(blob))
+            msg = deserialize_message(blob, self.wire)
         if tr:
             tr.complete("wire.sim", t_sent, cat="wire",
                         sender=int(msg.get_sender_id()), receiver=receiver,
@@ -130,6 +165,13 @@ class SimCommManager(BaseCommunicationManager):
     def __init__(self, network: SimNetwork, rank: int):
         self.network = network
         self.rank = rank
+
+    @property
+    def bytes_ledger(self):
+        """This rank's tx/rx byte totals (live only when the network
+        runs a wire round-trip mode) — the surface ``health()`` reads on
+        every backend."""
+        return self.network.ledgers[self.rank]
 
     def send_message(self, msg: Message) -> None:
         if self.network.stopped(self.rank):

@@ -48,6 +48,28 @@ def logging_config(process_id: int = 0, level=logging.INFO):
     )
 
 
+def rss_mb() -> float:
+    """CURRENT host RSS in MB (/proc/self/statm — Linux; falls back to
+    the getrusage peak elsewhere). Current, not ru_maxrss: the process
+    peak is monotone, so point-in-time memory claims (the sharded
+    store's flat-RSS story, a sim drill's host-memory axis) need live
+    samples. Single-sourced here for bench.py's per-section trajectory
+    AND ``sim.FleetResult.summary()``'s host-RSS axis."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE") / 1e6
+    except Exception:
+        # Non-Linux fallback: ru_maxrss is the MONOTONE process peak
+        # (point-in-time claims degenerate toward ratio 1.0 here —
+        # Linux is the measured platform), and macOS reports bytes
+        # where Linux uses KB.
+        import resource
+        import sys
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return peak / (1024.0 ** 2 if sys.platform == "darwin" else 1024.0)
+
+
 def post_complete_message_to_sweep_process(args, pipe_path: str = "./tmp/fedml"):
     """Write a completion line to a fifo so a sweep driver can advance
     (reference fedavg/utils.py:19-27). No-op if the fifo cannot be created."""

@@ -84,7 +84,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     for name in ("bench_femnist_cnn_3400", "bench_store_windowed",
                  "bench_store_windowed_fedopt", "bench_robust_agg",
                  "bench_chaos", "bench_wire_codec", "bench_ingest_profile",
-                 "bench_fleet_sim",
+                 "bench_serving_1m", "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
                  "bench_vit",
                  "bench_layout_fused_round", "bench_resnet56_s2d",
@@ -111,7 +111,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     # Every section that RAN finished inside the budget: elapsed at its
     # start + the full section cap fit under 300s.
     assert len(ran) * 50 + 100 <= 300
-    assert len(ran) + len(skipped) == 16
+    assert len(ran) + len(skipped) == 17
 
 
 def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
@@ -123,7 +123,7 @@ def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
     for name in ("bench_femnist_cnn_3400", "bench_store_windowed",
                  "bench_store_windowed_fedopt", "bench_robust_agg",
                  "bench_chaos", "bench_wire_codec", "bench_ingest_profile",
-                 "bench_fleet_sim",
+                 "bench_serving_1m", "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
                  "bench_vit",
                  "bench_layout_fused_round", "bench_resnet56_s2d",

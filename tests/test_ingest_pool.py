@@ -1,0 +1,562 @@
+"""Parallel server-ingest pool (comm/ingest.py, PR 12).
+
+The contract under test: with ``cfg.ingest_workers >= 1`` the mean fold
+runs on per-worker FIXED-POINT partial accumulators whose merge is
+associative-exact, so the pooled fold is bit-equal to the 1-worker
+"serial" pool REGARDLESS of arrival interleaving or worker count; a
+corrupt frame raised inside a worker is surfaced at the flush barrier
+and evict-and-released (never wedges the pool, never zeroes silently
+into the mean); and the tiers with no dispatch thread to unblock refuse
+the flag loudly (the PR 4/6 convention).
+"""
+
+import itertools
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.comm.ingest import (IngestPool, PartialAccumulator,
+                                   quantize_contribution)
+
+
+# --------------------------------------------------------------------------
+# The exact-fold math
+
+
+def _rand_contribs(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    leaves = [rng.randn(400).astype(np.float32),
+              rng.randn(7, 3).astype(np.float32)]
+    return [([l * rng.randn() for l in leaves], float(abs(rng.randn()) + 0.1))
+            for _ in range(n)]
+
+
+def test_partial_fold_exact_across_orders_and_partitions():
+    """Any arrival order × any partitioning into partials merges to the
+    identical bits — the property that makes the pool's worker→upload
+    assignment irrelevant."""
+    contribs = _rand_contribs()
+    rng = np.random.RandomState(1)
+
+    def fold(order, nparts):
+        parts = [PartialAccumulator() for _ in range(nparts)]
+        for i, j in enumerate(order):
+            parts[i % nparts].add(*contribs[j])
+        total = PartialAccumulator()
+        for p in parts:
+            p.merge_into(total)
+        return total
+
+    ref = fold(range(len(contribs)), 1)
+    for _ in range(4):
+        order = rng.permutation(len(contribs))
+        for nparts in (1, 2, 3, 4, 7):
+            got = fold(order, nparts)
+            assert got.wsum == ref.wsum and got.count == ref.count
+            for a, b in zip(got.leaves, ref.leaves):
+                np.testing.assert_array_equal(a, b)
+
+
+def test_fixed_point_mean_close_to_float_reference():
+    contribs = _rand_contribs(seed=3)
+    acc = PartialAccumulator()
+    for leaves, w in contribs:
+        acc.add(leaves, w)
+    wsum = sum(w for _, w in contribs)
+    ref0 = sum(np.asarray(l[0], np.float64) * w for l, w in contribs) / wsum
+    got0 = (acc.leaves[0] / 2.0 ** 30) / (acc.wsum / 2.0 ** 30)
+    # fp32-grade products + 2^-30 grid: well inside update tolerances.
+    np.testing.assert_allclose(got0, ref0, atol=5e-6)
+
+
+def test_add_matches_quantize_reference_bitwise():
+    leaves = [np.random.RandomState(3).randn(257).astype(np.float32)]
+    acc = PartialAccumulator()
+    acc.add(leaves, 0.73)
+    np.testing.assert_array_equal(
+        acc.leaves[0], quantize_contribution(leaves[0], 0.73))
+
+
+def test_quantize_nonfinite_and_saturation_deterministic():
+    x = np.array([np.nan, np.inf, -np.inf, 1.0, -2.5, 1e300])
+    q = quantize_contribution(x)
+    # NaN maps to 0; ±inf and huge magnitudes saturate at the clip.
+    assert q[0] == 0
+    assert q[1] == 2 ** 50 and q[2] == -2 ** 50
+    assert q[3] == 2 ** 30 and q[4] == int(-2.5 * 2 ** 30)
+    assert q[5] == 2 ** 50
+
+
+def test_finite_saturation_is_counted_not_silent():
+    """A FINITE value (or weight) beyond the ±2^50 grid envelope is
+    clamped — which distorts that contribution's weight vs the inline
+    fold — so it must be COUNTED (surfaced via profile + a once-per-pool
+    warning), while the deliberate non-finite containment is not."""
+    acc = PartialAccumulator()
+    acc.add([np.array([1.0, 2.0], np.float32)], 1.0)
+    assert acc.saturated == 0
+    acc.add([np.array([2.0 ** 25, 1.0], np.float32)], 1.0)  # value clips
+    assert acc.saturated == 1
+    acc.add([np.array([1.0, 0.0], np.float32)], 2.0 ** 25)  # weight clips
+    assert acc.saturated == 2
+    acc.add([np.array([np.nan, np.inf], np.float32)], 1.0)  # by design
+    assert acc.saturated == 2
+    sat_before = acc.saturated
+    acc.reset()
+    assert acc.saturated == sat_before  # telemetry survives flushes
+    pool = IngestPool(1)
+    try:
+        pool.submit(lambda: ([np.array([2.0 ** 25], np.float32)], 1.0))
+        pool.drain()
+        assert pool.profile()["saturated_contributions"] == 1
+    finally:
+        pool.close()
+
+
+def test_fedbuff_pooled_corrupt_frame_refused_at_flush():
+    """The buffered tier's pooled refusal: a corrupt frame consumes its
+    window slot at weight 0, and the sender is evict-and-released at the
+    flush barrier through the SHARED async-tier refusal policy."""
+    import time
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedbuff import FedBuffServerManager
+    from fedml_tpu.algos.fedasync import (MSG_ARG_KEY_MODEL_VERSION,
+                                          MSG_ARG_KEY_TASK_SEQ)
+    from fedml_tpu.algos.fedavg_distributed import \
+        MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+    from fedml_tpu.comm.codec import CODEC_KEY, make_wire_codec
+    from fedml_tpu.comm.loopback import LoopbackNetwork
+    from fedml_tpu.comm.message import Message
+
+    class A:
+        pass
+
+    a = A()
+    a.chaos = None
+    a.network = LoopbackNetwork(4)
+    cfg = FedConfig(client_num_in_total=3, client_num_per_round=3,
+                    comm_round=10, frequency_of_the_test=10 ** 6,
+                    ingest_workers=2)
+    net0 = {"w": np.zeros(32, np.float32)}
+    srv = FedBuffServerManager(a, net0, cfg, 4, buffer_k=2,
+                               clock=time.monotonic)
+    srv.register_message_receive_handlers()
+    good, _ = make_wire_codec("int8").encode(
+        {"w": np.ones(32, np.float32)}, None, 1)
+    corrupt = dict(good)
+    corrupt["q"] = corrupt["q"][:3]
+
+    def upload(worker, payload, seq):
+        m = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, worker, 0)
+        m.add(Message.MSG_ARG_KEY_MODEL_PARAMS, payload)
+        m.add(CODEC_KEY, "int8")
+        m.add(MSG_ARG_KEY_MODEL_VERSION, srv.version)
+        m.add(MSG_ARG_KEY_TASK_SEQ, seq)
+        srv.handle_upload(m)
+
+    try:
+        upload(1, good, 0)
+        upload(2, corrupt, 0)  # window of 2 → flush → refusal surfaces
+        h = srv.health()
+        assert h["codec_refusals"] == 1 and h["evictions"] == 1
+        assert srv.version == 1  # the window flushed (weight-0 slot)
+        # The good delta alone made the mean: net ≈ alpha * ones.
+        np.testing.assert_allclose(np.asarray(srv.net["w"]),
+                                   np.ones(32), atol=0.02)
+        released = [m for m in a.network.inbox(2).queue
+                    if getattr(m, "get", None) and m.get("done")]
+        assert released
+        # Next window still flows — the pool is not wedged.
+        upload(1, good, 1)
+        upload(3, good, 0)
+        assert srv.version == 2
+    finally:
+        srv.finish()
+
+
+def test_pool_run_reraises_in_caller():
+    pool = IngestPool(2)
+    try:
+        assert pool.run(lambda: 41 + 1) == 42
+        with pytest.raises(ValueError, match="boom"):
+            pool.run(lambda: (_ for _ in ()).throw(ValueError("boom")))
+        assert pool.drain() == []  # run() failures are the caller's
+    finally:
+        pool.close()
+
+
+# --------------------------------------------------------------------------
+# Sync-tier protocol (fake-clock, direct handler invocation)
+
+
+def _sync_server(workers, n=4, comm_round=3, aggregate_k=0):
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import (FedAVGAggregator,
+                                                    FedAVGServerManager)
+    from fedml_tpu.comm.loopback import LoopbackNetwork
+
+    class A:
+        pass
+
+    a = A()
+    a.chaos = None
+    a.network = LoopbackNetwork(n + 1)
+    cfg = FedConfig(client_num_in_total=n, client_num_per_round=n,
+                    comm_round=comm_round, frequency_of_the_test=10 ** 6,
+                    ingest_workers=workers)
+    net0 = {"w": np.zeros(64, np.float32), "b": np.zeros(3, np.float32)}
+    agg = FedAVGAggregator(net0, n, cfg)
+    srv = FedAVGServerManager(a, agg, cfg, n + 1, clock=time.monotonic,
+                              aggregate_k=aggregate_k)
+    return srv, agg, a
+
+
+def _upload(srv, worker, tree, r=0, samples=10, codec_payload=None,
+            codec_name=None):
+    from fedml_tpu.algos.fedavg_distributed import \
+        MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+    from fedml_tpu.comm.message import Message
+
+    m = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, worker, 0)
+    m.add(Message.MSG_ARG_KEY_MODEL_PARAMS,
+          codec_payload if codec_payload is not None else tree)
+    m.add(Message.MSG_ARG_KEY_NUM_SAMPLES, samples)
+    m.add("round", r)
+    if codec_name:
+        m.add("wire_codec", codec_name)
+    srv.handle_message_receive_model_from_client(m)
+
+
+def _client_tree(i, seed=0):
+    rng = np.random.RandomState(100 + seed * 31 + i)
+    return {"w": rng.randn(64).astype(np.float32),
+            "b": rng.randn(3).astype(np.float32)}
+
+
+def test_pooled_fold_bit_equal_across_arrival_orders_x_worker_counts():
+    """The permutation matrix: seeded arrival orders × worker counts all
+    produce the identical post-round net — the pooled mean is invariant
+    under interleaving AND pool size (the serial fold is the 1-worker
+    column)."""
+    n = 6
+    trees = [_client_tree(i) for i in range(n)]
+    weights = [10 + 3 * i for i in range(n)]
+    rng = np.random.RandomState(7)
+    orders = [list(range(n))] + [list(rng.permutation(n)) for _ in range(3)]
+    nets = []
+    for order, workers in itertools.product(orders, (1, 2, 4)):
+        srv, agg, _ = _sync_server(workers, n=n)
+        try:
+            for i in order:
+                _upload(srv, i + 1, trees[i], samples=weights[i])
+            assert srv.round_idx == 1  # the round completed
+            nets.append({k: np.asarray(v) for k, v in agg.net.items()})
+        finally:
+            srv.finish()
+    for other in nets[1:]:
+        for k in nets[0]:
+            np.testing.assert_array_equal(nets[0][k], other[k])
+    # And the exact mean is the right mean.
+    wsum = float(sum(weights))
+    ref = sum(np.asarray(t["w"], np.float64) * w
+              for t, w in zip(trees, weights)) / wsum
+    np.testing.assert_allclose(nets[0]["w"], ref, atol=5e-6)
+
+
+def test_pooled_corrupt_frame_evicts_releases_and_pool_survives():
+    """A frame that refuses inside a pool worker is surfaced at the
+    round's flush barrier (refusal is DEFERRED to the completion
+    attempt — the pooled-path policy): sender evicted AND released
+    (done), counters bumped, the round re-checks readiness over the
+    survivors and completes — and the pool keeps serving the NEXT round
+    (not wedged)."""
+    from fedml_tpu.comm.codec import make_wire_codec
+
+    srv, agg, a = _sync_server(2, n=3)
+    try:
+        good_tree = {"w": np.ones(64, np.float32), "b": np.ones(3, np.float32)}
+        good, _ = make_wire_codec("int8").encode(good_tree, None, 1)
+        corrupt = dict(good)
+        corrupt["q"] = corrupt["q"][:5]  # truncated
+        _upload(srv, 1, None, codec_payload=good, codec_name="int8")
+        _upload(srv, 2, None, codec_payload=corrupt, codec_name="int8")
+        assert srv.round_idx == 0  # 2 of 3 arrived: no completion yet
+        # The k-th arrival triggers the barrier: refusal surfaces, the
+        # sender is evicted+released, and the round completes over the
+        # two survivors (k_eff shrank with the membership).
+        _upload(srv, 3, None, codec_payload=good, codec_name="int8")
+        h = srv.health()
+        assert h["codec_refusals"] == 1 and h["evictions"] == 1
+        assert h["members"] == 2
+        released = [m for m in a.network.inbox(2).queue
+                    if getattr(m, "get", None) and m.get("done")]
+        assert released
+        assert srv.round_idx == 1  # completed over the survivors
+        # Both survivors uploaded int8-of-ones deltas vs the zero net.
+        np.testing.assert_allclose(np.asarray(agg.net["w"]),
+                                   np.ones(64), atol=0.02)
+        # Round 2 still works: the pool was not wedged by the failure.
+        for w in (1, 3):
+            _upload(srv, w, _client_tree(w, seed=9), r=1)
+        assert srv.round_idx == 2
+    finally:
+        srv.finish()
+
+
+def test_pooled_all_refused_aborts_instead_of_deadlocking():
+    from fedml_tpu.comm.codec import make_wire_codec
+
+    srv, agg, a = _sync_server(1, n=1)
+    good, _ = make_wire_codec("int8").encode(
+        {"w": np.ones(64, np.float32), "b": np.ones(3, np.float32)}, None, 1)
+    corrupt = dict(good)
+    corrupt["q"] = corrupt["q"][:5]
+    _upload(srv, 1, None, codec_payload=corrupt, codec_name="int8")
+    assert srv.aborted and srv._stopped
+    assert srv.health()["codec_refusals"] == 1
+
+
+def test_pool_profile_rides_ingest_profile():
+    srv, agg, _ = _sync_server(2, n=3)
+    try:
+        for i in range(3):
+            _upload(srv, i + 1, _client_tree(i))
+        prof = srv.ingest_profile()
+        pool = prof["ingest_pool"]
+        assert pool["workers"] == 2 and pool["tasks"] == 3
+        assert len(pool["busy_s_per_worker"]) == 2
+        assert prof["pool_task_ms_count"] == 3
+        assert prof["uploads"] == 3
+        # The ctrl/ registry carries the queue-depth gauge + task hist.
+        snap = srv.registry.snapshot()
+        assert "ingest_pool_queue_depth" in snap
+        assert snap["pool_task_ms_count"] == 3
+    finally:
+        srv.finish()
+
+
+# --------------------------------------------------------------------------
+# Loud refusals
+
+
+def test_non_mean_aggregator_refuses_pool_sync_and_fedbuff():
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import (FedAVGAggregator,
+                                                    FedAVGServerManager)
+    from fedml_tpu.algos.fedbuff import FedBuffServerManager
+    from fedml_tpu.comm.loopback import LoopbackNetwork
+
+    class A:
+        pass
+
+    a = A()
+    a.chaos = None
+    a.network = LoopbackNetwork(3)
+    cfg = FedConfig(client_num_in_total=2, client_num_per_round=2,
+                    comm_round=2, ingest_workers=2)
+    net0 = {"w": np.zeros(8, np.float32)}
+    agg = FedAVGAggregator(net0, 2, cfg, aggregator="coord_median")
+    with pytest.raises(ValueError, match="ingest_workers.*mean"):
+        FedAVGServerManager(a, agg, cfg, 3)
+    with pytest.raises(ValueError, match="ingest_workers.*mean"):
+        FedBuffServerManager(a, net0, cfg, 3, aggregator="coord_median")
+
+
+def test_simulator_tier_refuses_ingest_workers():
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg import FedAvgAPI
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.models.lr import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    fed = build_federated_arrays(x, y, {0: np.arange(32)}, 16)
+    cfg = FedConfig(client_num_in_total=1, client_num_per_round=1,
+                    comm_round=1, epochs=1, batch_size=16, ingest_workers=2)
+    with pytest.raises(NotImplementedError, match="ingest_workers"):
+        FedAvgAPI(LogisticRegression(num_classes=2), fed, None, cfg)
+
+
+def test_cli_runners_reject_ingest_workers():
+    """The PR 4/6 convention at the driver layer: simulator-tier CLIs and
+    the non-async main_extra algorithms refuse --ingest_workers."""
+    from fedml_tpu.exp import parse_args, run
+    from fedml_tpu.exp.args import reject_ingest_pool_flag
+    from fedml_tpu.exp.main_extra import main as extra_main
+
+    args = parse_args([
+        "--model", "lr", "--dataset", "synthetic_1_1",
+        "--client_num_in_total", "4", "--client_num_per_round", "4",
+        "--comm_round", "1", "--ingest_workers", "2"])
+    with pytest.raises(SystemExit, match="ingest_workers"):
+        run(args, algorithm="FedAvg")
+    with pytest.raises(SystemExit, match="ingest_workers"):
+        extra_main(["--algorithm", "VFL", "--ingest_workers", "2",
+                    "--comm_round", "1"])
+    # The helper itself: 0 passes silently, the async tiers never call it.
+    args.ingest_workers = 0
+    reject_ingest_pool_flag(args, "anything")
+
+
+# --------------------------------------------------------------------------
+# End-to-end: live federations, pooled == serial
+
+
+def test_loopback_sync_pooled_bit_equal_1_vs_2_workers():
+    """The ci.sh pin's in-suite twin: the same loopback codec federation
+    at ingest_workers=1 and =2 lands the bit-identical final net (the
+    exact fold is interleaving-invariant, so loopback's thread-scheduled
+    arrival order cannot leak into the result)."""
+    import jax
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import FedML_FedAvg_distributed
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+    from fedml_tpu.models.lr import LogisticRegression
+
+    x, y = make_classification(160, n_features=12, n_classes=3, seed=2)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 3),
+                                 batch_size=16)
+
+    def go(workers):
+        cfg = FedConfig(client_num_in_total=3, client_num_per_round=3,
+                        comm_round=2, epochs=1, batch_size=16, lr=0.3,
+                        frequency_of_the_test=10 ** 6,
+                        ingest_workers=workers)
+        return FedML_FedAvg_distributed(
+            LogisticRegression(num_classes=3), fed, None, cfg,
+            wire_codec="topk0.25+int8", loopback_wire="tensor")
+
+    a1, a2 = go(1), go(2)
+    for l1, l2 in zip(jax.tree.leaves(a1.net), jax.tree.leaves(a2.net)):
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    assert a2.ingest_profile["ingest_pool"]["workers"] == 2
+
+
+def test_fedasync_pooled_decode_bit_equal_to_inline():
+    """Pure async only hosts the DECODE in the pool (its mix is
+    sequential) — at identical arrival order, any worker count is
+    bit-equal to inline. A single worker makes the loopback run strictly
+    sequential (request/response), so the order is pinned without the
+    SIM; the pooled fedbuff SIM test covers the multi-device case."""
+    import jax
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedasync import FedML_FedAsync_distributed
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+    from fedml_tpu.models.lr import LogisticRegression
+
+    x, y = make_classification(160, n_features=12, n_classes=3, seed=2)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 2),
+                                 batch_size=16)
+
+    def go(workers):
+        cfg = FedConfig(client_num_in_total=2, client_num_per_round=1,
+                        comm_round=4, epochs=1, batch_size=16, lr=0.3,
+                        frequency_of_the_test=10 ** 6,
+                        ingest_workers=workers)
+        return FedML_FedAsync_distributed(
+            LogisticRegression(num_classes=3), fed, None, cfg,
+            wire_codec="int8", loopback_wire="tensor")
+
+    s0, s2 = go(0), go(2)
+    for l1, l2 in zip(jax.tree.leaves(s0.net), jax.tree.leaves(s2.net)):
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+def _sim_drill(workers, corrupt=False, **kw):
+    import dataclasses
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.core.faults import UpdateCorruptor
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.partition import partition_homo
+    from fedml_tpu.data.synthetic import make_classification
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.sim import FleetSimulator, FleetSpec, make_fleet_trace
+
+    x, y = make_classification(240, n_features=8, n_classes=4, seed=1)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 6),
+                                 batch_size=16)
+    spec = FleetSpec(n_devices=6, seed=11, horizon_s=4000.0,
+                     mean_online=0.8, base_round_s=30.0, slot_s=180.0,
+                     speed_alpha=1.3, diurnal_amplitude=0.3,
+                     arrival_spread_s=60.0)
+    cfg = FedConfig(client_num_in_total=6, client_num_per_round=6,
+                    comm_round=8, epochs=1, batch_size=16, lr=0.3,
+                    frequency_of_the_test=10 ** 6, ingest_workers=workers)
+    corr = dict(corrupt_ranks=(1,),
+                corruptor=UpdateCorruptor("nan", 1.0, seed=0)) if corrupt \
+        else {}
+    sim = FleetSimulator(LogisticRegression(num_classes=4), fed, None, cfg,
+                         make_fleet_trace(spec), mode="fedbuff", buffer_k=3,
+                         wire_codec="topk0.2+int8", sim_wire="tensor",
+                         **corr, **kw)
+    res = sim.run()
+    return sim, res
+
+
+def test_sim_fedbuff_pooled_bit_equal_and_bytes_counted():
+    """The buffered tier's protocol is arrival-ORDER-sensitive (which k
+    arrivals share a window), so its pooled bit-equality pin rides the
+    deterministic SIM fabric: same seeded drill, workers 1 vs 4 —
+    identical arrival logs, identical final net bits — with the tensor
+    wire round-trip counting honest bytes per rank."""
+    import jax
+
+    s1, r1 = _sim_drill(1)
+    s4, r4 = _sim_drill(4)
+    assert r1.arrival_log == r4.arrival_log
+    for l1, l2 in zip(jax.tree.leaves(s1.server.net),
+                      jax.tree.leaves(s4.server.net)):
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    h = s4.server.health()
+    assert h["bytes_rx"] > 0 and h["bytes_tx"] > 0
+    assert r4.summary()["host_rss_mb"] > 0  # the new memory axis
+
+
+def test_sim_fedbuff_pooled_guard_drops_match_inline():
+    """A NaN-corrupting device's deltas are weight-zeroed in the pooled
+    window exactly like the inline nan_guard (disc=0 participation
+    gate) — guard counters and the final net agree with workers=0."""
+    import jax
+
+    s0, r0 = _sim_drill(0, corrupt=True)
+    s2, r2 = _sim_drill(2, corrupt=True)
+    assert s0.server.guard_drops == s2.server.guard_drops > 0
+    assert r0.arrival_log == r2.arrival_log
+    # Inline float fold vs exact fixed-point fold: same windows, same
+    # discounts — numerically equal to fp32-level tolerance.
+    for l1, l2 in zip(jax.tree.leaves(s0.server.net),
+                      jax.tree.leaves(s2.server.net)):
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   atol=1e-5)
+
+
+def test_store_fleet_data_lazy_view_matches_store():
+    from fedml_tpu.data.store import FederatedStore
+    from fedml_tpu.sim import StoreFleetData
+
+    rng = np.random.RandomState(0)
+    counts = 1 + rng.randint(0, 5, 12)
+    edges = np.concatenate([[0], np.cumsum(counts)])
+    x = rng.randn(int(counts.sum()), 6).astype(np.float32)
+    y = rng.randint(0, 3, len(x)).astype(np.int32)
+    parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(12)}
+    store = FederatedStore(x, y, parts, batch_size=4)
+    data = StoreFleetData(store)
+    assert data.x.shape[0] == 12 and data.x.shape[3:] == (6,)
+    for c in (0, 7, 11, 3):
+        ref = store.gather_cohort(np.asarray([c]), steps=data._steps)
+        np.testing.assert_array_equal(np.asarray(data.x[c]),
+                                      np.asarray(ref.x[0]))
+        np.testing.assert_array_equal(np.asarray(data.mask[c]),
+                                      np.asarray(ref.mask[0]))
+        assert int(data.counts[c]) == int(counts[c])
