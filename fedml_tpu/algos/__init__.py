@@ -1,6 +1,7 @@
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.centralized import CentralizedTrainer
 from fedml_tpu.algos.decentralized import DecentralizedAPI
+from fedml_tpu.algos.fedac import FedAcAPI, ServerAvgAPI
 from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.algos.fedgan import FedGanAPI
 from fedml_tpu.algos.fedgkt import FedGKTAPI
@@ -23,6 +24,8 @@ from fedml_tpu.algos.scaffold import ScaffoldAPI
 from fedml_tpu.algos.vertical_fl import VflAPI
 
 __all__ = [
+    "FedAcAPI",
+    "ServerAvgAPI",
     "DittoAPI",
     "FedBNAPI",
     "FedML_FedAsync_distributed",

@@ -27,7 +27,6 @@ import jax
 import jax.numpy as jnp
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
-from fedml_tpu.data.batching import gather_clients
 from fedml_tpu.trainer.local import (
     make_client_optimizer,
     make_local_train_fn_from_cfg,
@@ -41,11 +40,38 @@ from fedml_tpu.core.tree import gather_stacked as _gather_stacked
 from fedml_tpu.core.tree import scatter_stacked as _scatter_stacked
 
 
+#: fold_in child reserved for the personal step's per-client streams —
+#: forked from the ROUND key (the windowed carry protocol's key slot),
+#: so the host loop and the scanned round derive identical randomness.
+#: Disjoint from the trainer's client streams (fold_in on slot index),
+#: the transform's 0x7F and the corruptor's 0xC0.
+_PERSONAL_TAG = 0xD1770
+
+
 class DittoAPI(FedAvgAPI):
     """FedAvg for the global model + per-client personal models with a
-    proximal pull of strength ``lam`` toward the current global."""
+    proximal pull of strength ``lam`` toward the current global.
 
-    supports_streaming = False  # personal nets are a device-resident [C, ...] stack
+    Carry capability record ("custom" protocol): the personal-model
+    stack IS the carry. The published step runs the standard global
+    round, then gathers the cohort's personal models, applies the
+    proximal personal update against the NEW global, and scatter-merges
+    — one donated dispatch per round, scanned W-deep on the windowed
+    tier. Streams from a ``FederatedStore`` (personal nets stay
+    device-resident; the cohort rides the shared ``_cohort`` path).
+
+    The personal step's rng streams fork from the ROUND key via
+    ``fold_in`` (``_PERSONAL_TAG``) instead of a second ``self.rng``
+    split — the prefix-stability discipline that makes windowed rounds
+    bit-equal to host rounds. (This changed Ditto's personal-step
+    randomness relative to the pre-record implementation; no test pins
+    those streams.) Per-round metrics report the global train loss; the
+    per-round ``personal_loss`` scalar was retired with the fused step
+    (``evaluate_personalized`` remains the personalization metric)."""
+
+    supports_streaming = True  # personal nets device-resident; cohort streams
+    window_protocol = "custom"
+    window_carry = "personal-model stack"
 
     def __init__(self, *args, lam: float = 0.1, **kw):
         self.lam = lam
@@ -91,25 +117,47 @@ class DittoAPI(FedAvgAPI):
         self._personal_jit = jax.jit(rounds)
         return self._personal_jit
 
-    def train_one_round(self, round_idx: int) -> Dict[str, float]:
-        # 1) ordinary FedAvg round for the global model
-        metrics = super().train_one_round(round_idx)
-        # 2) proximal personal updates for the sampled clients
-        idx, wmask = self.sample_round(round_idx)
-        idx = jnp.asarray(idx)
-        wmask_a = jnp.asarray(wmask, jnp.float32)
-        sub = gather_clients(self.train_fed, idx)
-        personal_sub = _gather_stacked(self.personal_nets, idx)
-        self.rng, rnd = jax.random.split(self.rng)
-        rngs = jax.vmap(lambda i: jax.random.fold_in(rnd, i))(
-            jnp.arange(idx.shape[0]))
-        trained, losses = self._personal_round_fn()(
-            personal_sub, self.net.params, sub.x, sub.y, sub.mask, rngs)
-        self.personal_nets = _scatter_stacked(
-            self.personal_nets, idx, trained, wmask_a)
-        metrics["personal_loss"] = float(
-            jnp.sum(losses * wmask_a) / jnp.maximum(jnp.sum(wmask_a), 1.0))
-        return metrics
+    # --- carry capability record ("custom"): personal nets ride the scan -
+    def _build_fused_step(self):
+        """ONE Ditto round as one donated dispatch: the standard global
+        round (``round_fn`` — aggregation/guards/compression untouched)
+        followed by the cohort's proximal personal updates against the
+        NEW global, with the personal stack gathered/scatter-merged in
+        the same dispatch. The scatter gate is the pad mask (``umask``):
+        an empty sampled client's personal training is a tree_select
+        no-op, so writing its unchanged slot back is bit-identical to
+        skipping it."""
+        round_fn = self.round_fn
+        personal_fn = self._personal_round_fn()
+
+        def step(net, personal_nets, x, y, mask, weights, key, idx, umask):
+            avg, loss = round_fn(net, x, y, mask, weights, weights, key)
+            personal_sub = _gather_stacked(personal_nets, idx)
+            base = jax.random.fold_in(key, _PERSONAL_TAG)
+            rngs = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                jnp.arange(x.shape[0]))
+            trained, _plosses = personal_fn(
+                personal_sub, avg.params, x, y, mask, rngs)
+            personal_nets = _scatter_stacked(
+                personal_nets, idx, trained, umask)
+            return (avg, personal_nets), loss
+
+        return step
+
+    def _window_carry_init(self):
+        return self.personal_nets
+
+    def _window_carry_commit(self, extra) -> None:
+        self.personal_nets = extra
+
+    def _window_scan_extras(self, idx2d, wmask2d):
+        from fedml_tpu.obs.sanitizer import planned_transfer
+
+        import numpy as np
+
+        with planned_transfer():
+            return (jnp.asarray(np.asarray(idx2d), jnp.int32),
+                    jnp.asarray(np.asarray(wmask2d), jnp.float32))
 
     # -- checkpoint/resume: personal models are run state too -------------
     def checkpoint_extra_state(self):
@@ -121,13 +169,32 @@ class DittoAPI(FedAvgAPI):
     def evaluate_personalized(self) -> Dict[str, float]:
         """Sample-weighted mean per-client accuracy of each personal model
         on its OWN local shard — the quantity personalization optimizes
-        (the global model's global-test eval remains ``evaluate()``)."""
+        (the global model's global-test eval remains ``evaluate()``).
+        Store-backed federations iterate the population in host-gathered
+        chunks (device holds one chunk of data + personal models at a
+        time)."""
         f = self.train_fed
         fn = getattr(self, "_personal_eval_jit", None)
         if fn is None:  # cache: an inline vmap would re-trace every call
             fn = jax.jit(jax.vmap(
                 lambda net, x, y, mask: self.eval_fn(net, x, y, mask)))
             self._personal_eval_jit = fn
+        if self._streaming:
+            import numpy as np
+
+            tot_acc = tot_loss = tot_n = 0.0
+            for lo in range(0, f.num_clients, 256):
+                idx = np.arange(lo, min(lo + 256, f.num_clients))
+                sub = f.gather_cohort(idx)
+                psub = _gather_stacked(self.personal_nets, jnp.asarray(idx))
+                m = fn(psub, sub.x, sub.y, sub.mask)
+                num = np.asarray(m["num"])
+                tot_acc += float((np.asarray(m["accuracy"]) * num).sum())
+                tot_loss += float((np.asarray(m["loss"]) * num).sum())
+                tot_n += float(num.sum())
+            n = max(tot_n, 1.0)
+            return {"personal_accuracy": tot_acc / n,
+                    "personal_loss_eval": tot_loss / n}
         m = fn(self.personal_nets, f.x, f.y, f.mask)
         n = jnp.maximum(jnp.sum(m["num"]), 1.0)
         return {

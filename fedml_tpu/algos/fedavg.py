@@ -172,17 +172,20 @@ class FedAvgAPI(FederatedLoop):
                         "aggregator (coord_median, trimmed_mean<beta>) "
                         "here, or the flat FedAvg family for the exact "
                         "full-cohort all_gather path")
-            elif (type(self).train_one_round is not FedAvgAPI.train_one_round
-                    or type(self).run_round is not FederatedLoop.run_round
-                    or type(self)._make_vmap_round
-                    is not FedAvgAPI._make_vmap_round
-                    or type(self)._make_sharded_round
-                    is not FedAvgAPI._make_sharded_round):
-                raise NotImplementedError(
-                    f"{type(self).__name__} customizes the round or its "
-                    f"aggregation; cfg.aggregator={cfg.aggregator!r} only "
-                    "rides the FedAvg family's shared round builders (a "
-                    "custom round would silently keep its own aggregation)")
+            else:
+                # Capability-record facts: a custom round, custom round
+                # BUILDERS, or a custom fused step (SCAFFOLD/FedDyn's
+                # stateful one-dispatch rounds) all mean the aggregation
+                # is not the shared builders' — the flag would silently
+                # keep the algorithm's own reduction.
+                rec = self.capability()
+                if rec.custom_round or rec.custom_builders or rec.custom_step:
+                    raise NotImplementedError(
+                        f"{type(self).__name__} customizes the round or its "
+                        f"aggregation; cfg.aggregator={cfg.aggregator!r} only "
+                        "rides the FedAvg family's shared round builders (a "
+                        "custom round would silently keep its own "
+                        "aggregation)")
         self._group_reduce = bool(getattr(cfg, "group_reduce", False))
         if self._group_reduce:
             if mesh is None:
@@ -210,6 +213,9 @@ class FedAvgAPI(FederatedLoop):
         self.n_shards = 1 if mesh is None else int(mesh.shape[mesh.axis_names[0]])
         sample_x = (train_fed.example_input() if self._streaming
                     else np.asarray(train_fed.x[0, 0]))
+        # Hook for models whose init input is NOT a data batch (FedGAN's
+        # generator initializes from latent noise). Default: identity.
+        sample_x = self._net_init_input(sample_x)
         # Lane-fill compute layout (parallel/layout.py): the jitted
         # client step trains a lane-PADDED physical twin; everything
         # above the step — self.net, aggregation, checkpoints, the wire
@@ -260,14 +266,18 @@ class FedAvgAPI(FederatedLoop):
         self.net = self.fns.init(init_rng, sample_x)
 
         if cfg.client_selection == "oort":
-            if type(self).train_one_round is not FedAvgAPI.train_one_round:
+            rec = self.capability()
+            if (rec.custom_round or rec.custom_step
+                    or self.window_protocol != "round"):
                 # The utility-update hook lives in FedAvgAPI's round; a
-                # subclass round that skips it would silently degenerate
-                # oort to pure exploration (= uniform sampling).
+                # custom round/step that skips it would silently
+                # degenerate oort to pure exploration (= uniform
+                # sampling).
                 raise NotImplementedError(
-                    f"{type(self).__name__} overrides train_one_round and "
-                    "would skip oort's per-round utility update; oort "
-                    "serves the FedAvg family's shared round only")
+                    f"{type(self).__name__} runs a custom round (capability "
+                    "record) and would skip oort's per-round utility "
+                    "update; oort serves the FedAvg family's shared round "
+                    "only")
             # Eager init: the checkpoint template must match the saved
             # structure (lazy init would save oort state but restore
             # against an empty template).
@@ -332,6 +342,12 @@ class FedAvgAPI(FederatedLoop):
         self.round_fn = jax.jit(round_fn)
 
     # --- hooks subclasses override (FedOpt/FedProx/...) -------------------
+    def _net_init_input(self, sample_x):
+        """The array handed to ``fns.init`` (and the compute layout).
+        Defaults to a sample data batch; models initialized from a
+        different input shape (FedGAN's latent noise) override this."""
+        return sample_x
+
     def _on_client_lr_change(self):
         """Called whenever the client lr actually changes (lr schedules).
         Subclasses holding their OWN lr-dependent jitted functions (Ditto's
@@ -741,42 +757,82 @@ class FedAvgAPI(FederatedLoop):
 
         return gather_clients(self.train_fed, jnp.asarray(idx))
 
+    # --- capability record (algos/capability.py) ------------------------
+    def capability(self):
+        """This algorithm's :class:`~fedml_tpu.algos.capability.
+        CarryCapability` record — derived once per class from the carry
+        protocol declarations; every scan-tier guard below keys on it
+        (and refuses with the record-derived message)."""
+        from fedml_tpu.algos.capability import record_for
+
+        return record_for(type(self))
+
+    def _build_fused_step(self):
+        """The UNJITTED one-round step this algorithm publishes —
+        ``step(net, extra, x, y, mask, weights, key, *extras) ->
+        ((net', extra'), loss)`` — the SINGLE function both the fused
+        host round (jitted with donation, W=1) and the windowed scan
+        (``lax.scan`` over its leading-axis-W twin) execute, so the two
+        tiers are bit-equal by construction.
+
+        "round"-protocol algorithms get it for free from ``round_fn`` +
+        the pure ``_window_server_update``; "custom"-protocol algorithms
+        override this (SCAFFOLD/FedDyn wrap their stateful round with
+        ``make_fused_stateful_round_step``; Ditto/FedBN build bespoke
+        steps over their per-client state stacks)."""
+        if self.window_protocol != "round":
+            from fedml_tpu.algos.capability import refusal
+
+            raise NotImplementedError(
+                refusal(type(self), "the fused round step"))
+        from fedml_tpu.parallel.shard import make_fused_round_step
+
+        return make_fused_round_step(self.round_fn,
+                                     self._window_server_update())
+
+    def _fused_round_extras(self, round_idx: int, idx, wmask):
+        """Per-round trailing operands for the fused step. "round"
+        protocol: the ``_round_aux`` hook (the corruption drill's
+        adversary mask, FedNova's τ-normalized weights). "custom"
+        protocol: the W=1 slice of ``_window_scan_extras`` — the same
+        cohort index maps / scatter masks the windowed scan feeds, so
+        the fused host round and the scanned round consume identical
+        operands."""
+        if self.window_protocol == "custom":
+            return tuple(
+                a[0] for a in self._window_scan_extras(
+                    np.asarray(idx)[None], np.asarray(wmask)[None]))
+        return self._round_aux(round_idx, idx, wmask)
+
     # --- fused round step (one donated dispatch per host-loop round) ---
     def _fused_round_step(self):
         """The cached donated FUSED round step — client training +
-        aggregation + the pure server update in ONE dispatch
-        (``parallel/shard.make_fused_round_step``, the windowed scan's
-        donation discipline at W=1) — or ``None`` when this algorithm/
-        config must keep the separate ``run_round`` + ``_server_update``
-        procedure (custom rounds, oort's three-output round, no pure
-        server update). Returns ``(pre, gather)``: ``pre`` takes
-        pre-gathered cohort operands; ``gather`` (resident single-device
-        only) traces the client gather inside the same dispatch."""
-        if self.window_protocol != "round":
-            return None
-        if (type(self).train_one_round is not FedAvgAPI.train_one_round
-                or type(self).run_round is not FederatedLoop.run_round):
+        aggregation + the algorithm's carry update in ONE dispatch (the
+        windowed scan's donation discipline at W=1) — or ``None`` when
+        this algorithm/config must keep the separate ``run_round`` +
+        ``_server_update`` procedure (capability record says no fused
+        step; oort's three-output round). Returns ``(pre, gather)``:
+        ``pre`` takes pre-gathered cohort operands; ``gather`` (resident
+        single-device "round" protocol only) traces the client gather
+        inside the same dispatch."""
+        if not self.capability().fused:
             return None
         if self.cfg.client_selection == "oort":
             return None  # with_client_losses: 3-output round
-        try:
-            server_update = self._window_server_update()
-        except NotImplementedError:
-            return None
         fn = self._fused_step_fn
         if fn is None:
-            from fedml_tpu.parallel.shard import make_fused_round_step
-
-            step = make_fused_round_step(self.round_fn, server_update)
+            step = self._build_fused_step()
             # Donate the (net, extra) carry: the caller always rebinds
             # self.net and commits the carry before anything reads the
             # donated originals — XLA reuses the old model's buffers
             # instead of holding old net + round average + new net live
             # (obs.sanitizer.donation_audit pins the 1-copy steady
-            # state).
+            # state). For custom-protocol carries this also donates the
+            # client-state STACK — one live copy instead of two.
             pre = jax.jit(step, donate_argnums=(0, 1))
             gather = None
-            if self.mesh is None and not self._streaming:
+            if (self.mesh is None and not self._streaming
+                    and self.window_protocol == "round"):
                 from fedml_tpu.data.batching import gather_clients
 
                 def gather_step(net, extra, fed, idx, wmask, key):
@@ -798,7 +854,7 @@ class FedAvgAPI(FederatedLoop):
         self.rng, rnd_rng = jax.random.split(self.rng)
         self._last_round_key = rnd_rng
         idx, wmask = self.sample_round(round_idx)
-        aux = self._round_aux(round_idx, idx, wmask)
+        aux = self._fused_round_extras(round_idx, idx, wmask)
         extra = self._window_carry_init()
         if self._streaming:
             sub = self._stream_cohort(round_idx, idx)
@@ -825,6 +881,14 @@ class FedAvgAPI(FederatedLoop):
         if self._fused_round_step() is not None:
             loss = self._train_round_fused(round_idx)
             return {"round": round_idx, "train_loss": float(loss)}
+        if self.window_protocol == "custom":
+            # A custom-protocol class without its fused step must not
+            # silently fall through to plain run_round rounds — that is
+            # the exact drift the capability record exists to refuse.
+            from fedml_tpu.algos.capability import refusal
+
+            raise NotImplementedError(
+                refusal(type(self), "train_one_round"))
         avg, loss = self.run_round(round_idx)
         self.net = self._server_update(self.net, avg)
         if self.cfg.client_selection == "oort":
@@ -854,13 +918,18 @@ class FedAvgAPI(FederatedLoop):
         unsynced dispatches costs the tunnel more than the syncs save
         (A/B on the 3400-client FEMNIST bench config: ~8.8 vs ~5.5
         rounds/sec). Prefer this method on directly-attached devices."""
-        # Shared consistency guard with the windowed tier: any subclass
-        # whose per-round procedure is run_round + _server_update
-        # pipelines (stateful _server_update overrides like FedOpt's
-        # included — the loop applies them host-side as device math;
-        # windowed-scan purity is NOT required here); custom-round
-        # subclasses refuse loudly.
-        self._check_round_protocol("train_rounds_pipelined")
+        # Capability-record guard: "round"-protocol algorithms pipeline
+        # whenever their per-round procedure is run_round +
+        # _server_update (stateful host-side _server_update overrides
+        # like FedOpt's included — purity only matters inside the
+        # windowed scan); "custom"-protocol algorithms pipeline through
+        # their fused one-dispatch step. Everything else refuses with
+        # the record-derived reason.
+        if not self.capability().pipelined:
+            from fedml_tpu.algos.capability import refusal
+
+            raise NotImplementedError(
+                refusal(type(self), "train_rounds_pipelined"))
         if self.cfg.client_selection == "oort":
             raise NotImplementedError(
                 "oort updates per-client utilities after every round "
@@ -943,6 +1012,17 @@ class FedAvgAPI(FederatedLoop):
         Default: none."""
         return ()
 
+    def _window_update_mask(self, idx2d, wmask2d) -> np.ndarray:
+        """``[W, k]`` float32 mask of slots that actually TRAIN in their
+        round: active (un-padded) AND non-empty — the scatter gate for
+        per-client state carried through the scan (SCAFFOLD's controls,
+        FedDyn's corrections). Layout-agnostic: host counts serve both
+        the resident arrays and the store (where it equals
+        ``FederatedStore.window_trained_mask`` by construction)."""
+        counts = self._host_counts()
+        return (np.asarray(wmask2d, np.float32)
+                * (counts[np.asarray(idx2d)] > 0).astype(np.float32))
+
     def _get_window_put(self):
         """The (cached) mesh layout ``put`` for window-scoped device
         arrays — the superbatch, the per-window weights, and any
@@ -961,69 +1041,53 @@ class FedAvgAPI(FederatedLoop):
     def _build_window_scan(self):
         """The UNJITTED window scan for this algorithm —
         ``scan(net, extra, x, y, mask, weights, keys, *extras) ->
-        ((net', extra'), losses)``. "round"-protocol subclasses get it
-        for free from ``round_fn`` + ``_window_server_update``."""
-        from fedml_tpu.parallel.shard import make_window_scan
+        ((net', extra'), losses)``. Derived from the ONE fused step the
+        algorithm publishes (:meth:`_build_fused_step`), so the windowed
+        scan and the fused host round execute the same function and
+        cannot drift."""
+        from fedml_tpu.parallel.shard import make_step_window_scan
 
-        return make_window_scan(self.round_fn, self._window_server_update())
+        return make_step_window_scan(self._build_fused_step())
 
     def _check_round_protocol(self, what: str) -> None:
         """Consistency guard for the tiers that replay the STANDARD
         round: the per-round procedure must be exactly ``run_round`` +
-        ``_server_update`` — a subclass with its own round (SCAFFOLD's
-        control updates, FedNova's tau algebra, Ditto's personal step)
-        would silently run plain rounds here. Note this is deliberately
-        all the pipelined loop requires: it applies ``_server_update``
-        host-side, so impure/stateful overrides (and classes that set
-        ``window_protocol = None`` to opt out of the windowed scan)
-        still pipeline; purity only matters inside the windowed scan
-        (:meth:`_window_server_update`)."""
-        if (type(self).train_one_round is not FedAvgAPI.train_one_round
-                or type(self).run_round is not FederatedLoop.run_round):
-            raise NotImplementedError(
-                f"{type(self).__name__} customizes the round itself; "
-                f"{what} only serves algorithms whose per-round "
-                "procedure is run_round + _server_update (declare the "
-                "'custom' windowed carry protocol for a bespoke scan "
-                "body)")
+        ``_server_update`` — a subclass with its own round would
+        silently run plain rounds here. Refusal text comes from the
+        capability record."""
+        if self.capability().custom_round:
+            from fedml_tpu.algos.capability import refusal
+
+            raise NotImplementedError(refusal(type(self), what))
 
     def _check_windowed_supported(self):
         """Shared guard for the windowed streaming tier — keyed on the
-        windowed carry protocol, not type identity."""
-        if self.window_protocol is None:
-            raise NotImplementedError(
-                f"{type(self).__name__} opts out of the windowed carry "
-                "protocol (window_protocol=None); use the per-round host "
-                "loop")
-        if self.window_protocol == "round":
-            self._check_round_protocol("train_rounds_windowed")
-            self._window_server_update()  # raises when no pure form exists
-        elif self.window_protocol == "custom":
-            if type(self)._build_window_scan is FedAvgAPI._build_window_scan:
-                # "custom" without a custom scan body would inherit the
-                # plain round replay — the silent-drift failure the
-                # protocol exists to refuse.
-                raise NotImplementedError(
-                    f"{type(self).__name__} declares window_protocol="
-                    "'custom' but does not override _build_window_scan; "
-                    "provide the custom scan body + carry hooks")
-            if (type(self)._window_carry_init
-                    is not FedAvgAPI._window_carry_init
-                    and type(self)._window_carry_commit
-                    is FedAvgAPI._window_carry_commit):
-                # State flows INTO the scan but the no-op default commit
-                # would silently drop the scanned-out result — remainder
-                # rounds/eval/checkpoints would read stale instance
-                # state with no error (a forgotten init at least fails
-                # loudly at trace time; a forgotten commit never does).
-                raise NotImplementedError(
-                    f"{type(self).__name__} overrides _window_carry_init "
-                    "without _window_carry_commit; the scanned-out carry "
-                    "would be silently discarded")
-        else:
+        capability record (algos/capability.py), not type identity."""
+        from fedml_tpu.algos.capability import refusal
+
+        if self.window_protocol not in (None, "round", "custom"):
             raise NotImplementedError(
                 f"unknown window_protocol {self.window_protocol!r}; "
                 "declare 'round', 'custom', or None")
+        if (self.window_protocol == "custom"
+                and type(self)._window_carry_init
+                is not FedAvgAPI._window_carry_init
+                and type(self)._window_carry_commit
+                is FedAvgAPI._window_carry_commit):
+            # State flows INTO the scan but the no-op default commit
+            # would silently drop the scanned-out result — remainder
+            # rounds/eval/checkpoints would read stale instance
+            # state with no error (a forgotten init at least fails
+            # loudly at trace time; a forgotten commit never does).
+            raise NotImplementedError(
+                f"{type(self).__name__} overrides _window_carry_init "
+                "without _window_carry_commit; the scanned-out carry "
+                "would be silently discarded")
+        if not self.capability().windowed:
+            raise NotImplementedError(
+                refusal(type(self), "train_rounds_windowed"))
+        if self.window_protocol == "round":
+            self._window_server_update()  # raises when no pure form exists
         if not self._streaming:
             raise NotImplementedError(
                 "windowed execution streams window superbatches from a "
@@ -1133,21 +1197,25 @@ class FedAvgAPI(FederatedLoop):
                 # fresh instance state.
                 for t in range(length):
                     r = start_round + off + t
-                    if self.window_protocol == "round":
+                    if self._fused_round_step() is not None:
                         # The fused donated step (the scan's discipline
-                        # at W=1) — "round" protocol + random selection
-                        # guarantee it exists here; keeping the
-                        # remainder on the same fused program as the
-                        # host loop preserves host↔windowed
-                        # bit-equality by construction.
-                        if self._fused_round_step() is not None:
+                        # at W=1) — both protocols publish it through
+                        # _build_fused_step; keeping the remainder on
+                        # the same program as the scan body preserves
+                        # host↔windowed bit-equality by construction.
+                        # Its per-round prelude H2Ds (wmask, cohort
+                        # weights, per-round extras) are the remainder
+                        # path's deliberate design — planned, like the
+                        # trailing loss fetch.
+                        with planned_transfer():
                             losses.append(self._train_round_fused(r))
-                            continue
+                    elif self.window_protocol == "round":
                         avg, loss = self.run_round(r)
                         self.net = self._server_update(self.net, avg)
                         losses.append(loss)
                     else:
-                        # "custom": train_one_round IS the round. Its
+                        # "custom" without a fused step (scan-only
+                        # classes): train_one_round IS the round. Its
                         # per-round host syncs (eager state gather/
                         # scatter scalars, the float(loss) fetch) are
                         # the remainder path's deliberate design — mark
@@ -1225,26 +1293,29 @@ class FedAvgAPI(FederatedLoop):
         round) rather than the reference's ``np.random.seed(round_idx)``
         — with FULL participation both are the identity and this method is
         bit-equal to the host loop (tested); with subsampling the client
-        choice differs from host-loop runs. Only plain FedAvg server
-        updates (new = avg) can ride the scan; subclasses with stateful
-        server optimizers must use the host loop. On a client mesh the
-        scan rides the shard_map round under full participation (the
-        gather is the identity there; client shards stay pinned to their
-        devices across all rounds); subsampled mesh rounds still need the
-        host loop's resharding gather.
+        choice differs from host-loop runs. Any "round"-protocol
+        algorithm with a PURE server update rides the scan — the carry
+        protocol's ``(net, extra)`` threads between scanned rounds
+        exactly as in the windowed tier (FedOpt's optimizer state,
+        FedAc's acceleration sequences), committed back at the end;
+        algorithms needing per-round host-computed aux operands
+        (FedNova's τ weights, the corruption drill's masks) refuse with
+        the record-derived reason. On a client mesh the scan rides the
+        shard_map round under full participation (the gather is the
+        identity there; client shards stay pinned to their devices
+        across all rounds); subsampled mesh rounds still need the host
+        loop's resharding gather.
 
-        The incoming ``self.net`` is DONATED to the scan
-        (``donate_argnums``): callers that want to compare params before
-        vs after must copy ``api.net`` before calling — the pre-call
-        reference points at a donated (deleted) buffer afterwards."""
-        if (type(self)._server_update is not FedAvgAPI._server_update
-                or type(self).train_one_round is not FedAvgAPI.train_one_round
-                or type(self).run_round is not FederatedLoop.run_round):
+        The incoming ``self.net`` (and the algorithm's carry) is DONATED
+        to the scan (``donate_argnums``): callers that want to compare
+        params before vs after must copy ``api.net`` before calling —
+        the pre-call reference points at a donated (deleted) buffer
+        afterwards."""
+        if not self.capability().on_device:
+            from fedml_tpu.algos.capability import refusal
+
             raise NotImplementedError(
-                "train_rounds_on_device supports plain-FedAvg rounds only; "
-                "this subclass customizes the round or server update "
-                "(hierarchical grouping, MPC aggregation, server optimizers "
-                "cannot ride the scan)")
+                refusal(type(self), "train_rounds_on_device"))
         if self._streaming:
             raise NotImplementedError(
                 "train_rounds_on_device needs the whole dataset device-"
@@ -1274,10 +1345,11 @@ class FedAvgAPI(FederatedLoop):
         scan_fn = getattr(self, "_rounds_scan_fn", None)
         if scan_fn is None:
             round_fn = self.round_fn  # jitted; nested jit is fine under scan
+            server_update = self._window_server_update()
 
             from fedml_tpu.data.batching import gather_clients
 
-            def body(fed, net, key):
+            def body(fed, net, extra, key):
                 if self.mesh is not None or cpr == n_total:
                     sub = fed  # full participation: gather is the identity
                 else:
@@ -1289,20 +1361,28 @@ class FedAvgAPI(FederatedLoop):
                 # The round key is used AS the host loop uses rnd_rng, so
                 # with full participation this scan is bit-equal to it.
                 avg, loss = round_fn(net, sub.x, sub.y, sub.mask, w, w, key)
-                return avg, loss
+                if server_update is None:
+                    return (avg, extra), loss
+                # The carry protocol's pure fold — exactly the windowed
+                # scan's between-round step, so stateful-server
+                # algorithms (FedOpt, FedAc, ServerAvg) ride on-device
+                # with their state never leaving the device.
+                return server_update(net, avg, extra, key), loss
 
             # fed and keys are jit ARGUMENTS (FederatedArrays is a struct
             # pytree): the dataset is not baked into the program as
             # constants, and the compiled scan is cached on self — repeat
             # calls with the same n_rounds reuse the executable.
-            def scan_fn(net, fed, keys):
+            def scan_fn(net, extra, fed, keys):
                 return jax.lax.scan(
-                    lambda n, k: body(fed, n, k), net, keys)
+                    lambda c, k: body(fed, c[0], c[1], k), (net, extra),
+                    keys)
 
-            # Donate the incoming net: the caller always replaces
-            # self.net with the scan result, so XLA may reuse the old
-            # params' buffers instead of holding both copies live.
-            scan_fn = jax.jit(scan_fn, donate_argnums=(0,))
+            # Donate the incoming (net, extra) carry: the caller always
+            # replaces self.net / commits the carry from the scan
+            # result, so XLA may reuse the old buffers instead of
+            # holding both copies live.
+            scan_fn = jax.jit(scan_fn, donate_argnums=(0, 1))
             self._rounds_scan_fn = scan_fn
 
         fed = self.train_fed
@@ -1329,7 +1409,13 @@ class FedAvgAPI(FederatedLoop):
             # fedlint: disable=R1(round-order chain reproduced on purpose: full-participation bit-equality with the host loop is tested)
             self.rng, rnd = jax.random.split(self.rng)
             keys.append(rnd)
-        self.net, losses = scan_fn(self.net, fed, jnp.stack(keys))
+        # Distinct names for the donated operands: the carry that comes
+        # BACK is what instance state rebinds to (fedlint R5 discipline
+        # — the donated buffers are dead after the call).
+        net0, extra0 = self.net, self._window_carry_init()
+        carry, losses = scan_fn(net0, extra0, fed, jnp.stack(keys))
+        self.net, extra = carry
+        self._window_carry_commit(extra)
         return losses
 
     def _eval_net(self):

@@ -31,7 +31,6 @@ import jax.tree_util as jtu
 
 from fedml_tpu.algos.ditto import _gather_stacked, _scatter_stacked
 from fedml_tpu.algos.fedavg import FedAvgAPI
-from fedml_tpu.data.batching import gather_clients
 from fedml_tpu.trainer.local import NetState
 
 _NORM_PREFIXES = ("GroupNorm", "BatchNorm", "LayerNorm", "Norm_")
@@ -54,9 +53,19 @@ class FedBNAPI(FedAvgAPI):
     """FedAvg with client-local normalization layers. Requires a model
     that HAS norm layers (raises otherwise — running FedBN on a norm-free
     model is indistinguishable from FedAvg and almost certainly a
-    misconfiguration)."""
+    misconfiguration).
 
-    supports_streaming = False  # per-client norm params live device-resident
+    Carry capability record ("custom" protocol): the per-client norm
+    store + per-client model state ARE the carry ``(local_norms,
+    local_state)``. The published step grafts, trains, averages non-norm
+    leaves, and scatter-merges the trained norms/state in one donated
+    dispatch — scanned W-deep on the windowed tier. Streams from a
+    ``FederatedStore`` (the norm store stays device-resident; the
+    cohort arrives through the shared ``_cohort`` path)."""
+
+    supports_streaming = True  # norm store device-resident; cohort streams
+    window_protocol = "custom"
+    window_carry = "client norm-leaf store + client model-state stack"
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
@@ -150,27 +159,51 @@ class FedBNAPI(FedAvgAPI):
         self._fedbn_jit = jax.jit(round_fn)
         return self._fedbn_jit
 
-    def train_one_round(self, round_idx: int) -> Dict[str, float]:
-        idx, wmask = self.sample_round(round_idx)
-        idx = jnp.asarray(idx)
-        wmask_a = jnp.asarray(wmask, jnp.float32)
-        sub = gather_clients(self.train_fed, idx)
-        norms_sub = jax.tree.map(
-            lambda l, m: jnp.take(l, idx, axis=0) if m else l,
-            self.local_norms, self._norm_mask)
-        state_sub = _gather_stacked(self.local_state, idx)
-        self.rng, rnd = jax.random.split(self.rng)
-        weights = sub.counts.astype(jnp.float32) * wmask_a
-        self.net, new_norms, new_state, loss = self._fedbn_round_fn()(
-            self.net, norms_sub, state_sub,
-            sub.x, sub.y, sub.mask, weights, rnd)
-        self.local_norms = jax.tree.map(
-            lambda store, new, m: (_scatter_stacked(store, idx, new, wmask_a)
-                                   if m else store),
-            self.local_norms, new_norms, self._norm_mask)
-        self.local_state = _scatter_stacked(
-            self.local_state, idx, new_state, wmask_a)
-        return {"round": round_idx, "train_loss": float(loss)}
+    # --- carry capability record ("custom"): norms/state ride the scan ---
+    def _build_fused_step(self):
+        """ONE FedBN round as one donated dispatch: masked norm-leaf
+        gather + state gather + the graft/train/aggregate round + the
+        masked scatter-merge, carry ``(net, (local_norms, local_state))``
+        — the same step the windowed scan replays W-deep. The scatter
+        gate is the pad mask: an empty sampled client's local training
+        is a tree_select no-op, so writing its unchanged norms back is
+        bit-identical to skipping it (the pre-record host loop used the
+        same ``wmask`` gate)."""
+        round_fn = self._fedbn_round_fn()
+        mask_tree = self._norm_mask
+
+        def step(net, extra, x, y, mask, weights, key, idx, umask):
+            norms, state = extra
+            norms_sub = jax.tree.map(
+                lambda l, m: jnp.take(l, idx, axis=0) if m else l,
+                norms, mask_tree)
+            state_sub = _gather_stacked(state, idx)
+            new_net, new_norms, new_state, loss = round_fn(
+                net, norms_sub, state_sub, x, y, mask, weights, key)
+            norms = jax.tree.map(
+                lambda store, new, m: (
+                    _scatter_stacked(store, idx, new, umask) if m
+                    else store),
+                norms, new_norms, mask_tree)
+            state = _scatter_stacked(state, idx, new_state, umask)
+            return (new_net, (norms, state)), loss
+
+        return step
+
+    def _window_carry_init(self):
+        return (self.local_norms, self.local_state)
+
+    def _window_carry_commit(self, extra) -> None:
+        self.local_norms, self.local_state = extra
+
+    def _window_scan_extras(self, idx2d, wmask2d):
+        import numpy as np
+
+        from fedml_tpu.obs.sanitizer import planned_transfer
+
+        with planned_transfer():
+            return (jnp.asarray(np.asarray(idx2d), jnp.int32),
+                    jnp.asarray(np.asarray(wmask2d), jnp.float32))
 
     def evaluate(self) -> Dict[str, float]:
         """FedBN's headline metric IS the personalized per-client eval: the
@@ -182,7 +215,10 @@ class FedBNAPI(FedAvgAPI):
 
     def evaluate_personalized(self) -> Dict[str, float]:
         """Per-client eval with each client's OWN norms grafted in — the
-        only semantically complete evaluation of a FedBN model."""
+        only semantically complete evaluation of a FedBN model. On a
+        store-backed federation the population is walked in
+        host-gathered chunks (device holds one chunk of data + norms at
+        a time)."""
         f = self.train_fed
         fn = self._eval_clients_jit
         if fn is None:
@@ -195,6 +231,26 @@ class FedBNAPI(FedAvgAPI):
 
             fn = jax.jit(run)
             self._eval_clients_jit = fn
+        if self._streaming:
+            import numpy as np
+
+            tot_acc = tot_loss = tot_n = 0.0
+            for lo in range(0, f.num_clients, 256):
+                idx = np.arange(lo, min(lo + 256, f.num_clients))
+                sub = f.gather_cohort(idx)
+                jidx = jnp.asarray(idx)
+                norms_c = jax.tree.map(
+                    lambda l, m: jnp.take(l, jidx, axis=0) if m else l,
+                    self.local_norms, self._norm_mask)
+                state_c = _gather_stacked(self.local_state, jidx)
+                m = fn(self.net, norms_c, state_c, sub.x, sub.y, sub.mask)
+                num = np.asarray(m["num"])
+                tot_acc += float((np.asarray(m["accuracy"]) * num).sum())
+                tot_loss += float((np.asarray(m["loss"]) * num).sum())
+                tot_n += float(num.sum())
+            n = max(tot_n, 1.0)
+            return {"personal_accuracy": tot_acc / n,
+                    "personal_loss_eval": tot_loss / n}
         m = fn(self.net, self.local_norms, self.local_state, f.x, f.y, f.mask)
         num = m["num"]
         n = jnp.maximum(jnp.sum(num), 1.0)

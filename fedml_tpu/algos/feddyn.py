@@ -28,14 +28,11 @@ reductions), so the math cannot drift between paths.
 
 from __future__ import annotations
 
-from typing import Dict
-
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
-from fedml_tpu.algos.ditto import _gather_stacked, _scatter_stacked
-from fedml_tpu.data.batching import gather_clients
 from fedml_tpu.trainer.local import NetState
 
 
@@ -61,9 +58,20 @@ def make_feddyn_local_train(apply_fn, lr: float, alpha: float,
 class FedDynAPI(FedAvgAPI):
     """FedAvg + dynamic regularization. Plain-SGD clients only (the
     correction is defined on the SGD update). ``alpha`` is the paper's
-    regularization strength (typical 0.01-0.1)."""
+    regularization strength (typical 0.01-0.1).
 
-    supports_streaming = False  # per-client corrections are a device [C, ...] stack
+    Streams from a ``FederatedStore`` too (the SCAFFOLD pattern): the
+    client CORRECTIONS stay a device-resident ``[N, ...]`` stack —
+    per-client state, not data — while the round's cohort arrives
+    through the shared :meth:`FedAvgAPI._cohort` path. The carry
+    capability record below is the whole fast-path story: the fused
+    one-dispatch round, the pipelined loop, and the W-rounds-per-
+    dispatch windowed scan all derive from ONE ``_build_fused_step``,
+    with carry ``(net, (server_h, client_grads))``."""
+
+    supports_streaming = True  # corrections device-resident; cohort streams
+    window_protocol = "custom"
+    window_carry = "server h + client correction stack"
 
     def __init__(self, *args, alpha: float = 0.01, **kw):
         super().__init__(*args, **kw)
@@ -150,25 +158,35 @@ class FedDynAPI(FedAvgAPI):
         self._feddyn_jit = jax.jit(round_fn)
         return self._feddyn_jit
 
-    def train_one_round(self, round_idx: int) -> Dict[str, float]:
-        idx, wmask = self.sample_round(round_idx)
-        idx = jnp.asarray(idx)
-        wmask_a = jnp.asarray(wmask, jnp.float32)
-        sub = gather_clients(self.train_fed, idx)
-        gk_sub = _gather_stacked(self.client_grads, idx)
-        self.rng, rnd = jax.random.split(self.rng)
-        weights = sub.counts.astype(jnp.float32) * wmask_a
-        self.net, self.server_h, gk_new, loss = self._feddyn_round_fn()(
-            self.net, self.server_h, gk_sub,
-            sub.x, sub.y, sub.mask, weights, rnd)
-        # Only clients that actually trained update their correction (a
-        # sampled empty client ran zero real steps; writing its "update"
-        # would drift g_k by -alpha*0 = 0 here, but masking keeps the
-        # padded duplicate slots from clobbering real state).
-        trained_mask = wmask_a * (sub.counts > 0).astype(jnp.float32)
-        self.client_grads = _scatter_stacked(
-            self.client_grads, idx, gk_new, trained_mask)
-        return {"round": round_idx, "train_loss": float(loss)}
+    # --- carry capability record ("custom"): corrections ride every tier -
+    def _build_fused_step(self):
+        """ONE FedDyn round as one donated dispatch: cohort correction
+        gather + the stateful round + the masked scatter-merge, carry
+        ``(net, (server_h, client_grads))`` — the same step the windowed
+        scan replays W-deep (bit-equality by construction). The scatter
+        gate: only clients that actually trained update their correction
+        (a sampled empty client ran zero real steps; writing its
+        "update" would drift nothing here since alpha*0 = 0, but masking
+        keeps PADDED DUPLICATE slots from clobbering real state)."""
+        from fedml_tpu.parallel.shard import make_fused_stateful_round_step
+
+        return make_fused_stateful_round_step(self._feddyn_round_fn())
+
+    def _window_carry_init(self):
+        return (self.server_h, self.client_grads)
+
+    def _window_carry_commit(self, extra) -> None:
+        self.server_h, self.client_grads = extra
+
+    def _window_scan_extras(self, idx2d, wmask2d):
+        from fedml_tpu.obs.sanitizer import planned_transfer
+
+        # Per-round cohort index map + trained mask (layout-agnostic
+        # count gathers, shared with SCAFFOLD's extras).
+        trained = self._window_update_mask(idx2d, wmask2d)
+        with planned_transfer():
+            return (jnp.asarray(np.asarray(idx2d), jnp.int32),
+                    jnp.asarray(trained, jnp.float32))
 
     # -- checkpoint/resume: corrections are run state ---------------------
     def checkpoint_extra_state(self):

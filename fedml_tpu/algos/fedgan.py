@@ -10,20 +10,30 @@ Parity targets:
   nets live in ONE params pytree so the standard weighted tree-mean of the
   FedAvg round machinery already aggregates them jointly.
 
-TPU-first: the per-net optimizer split is ``optax.masked`` over the
+TPU-first: the per-net optimizer split is ``optax.multi_transform`` over the
 ``netg``/``netd`` subtrees (no Python-level parameter groups); the whole
 local loop is a ``lax.scan`` vmapped over clients like every other
 algorithm. The discriminator emits logits and losses use
 ``sigmoid_binary_cross_entropy`` (see fedml_tpu/models/gan.py docstring).
+
+Capability record: since the record refactor ``FedGanAPI`` IS a
+``FedAvgAPI`` whose local step is the adversarial D/G loop — the server
+update is the plain client average ("round" protocol, no carry), so
+FedGAN rides the fused round step, the pipelined loop, the windowed
+streaming scan and the on-device scan like plain FedAvg (the GAN local
+step is prefix-stable in the step count: per-step noise keys fold_in on
+the step index, padded steps are tree_select no-ops). Only ``evaluate``
+differs: GANs have no accuracy — the reference logs only losses.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
-from fedml_tpu.algos.loop import FederatedLoop
+from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.core.tree import tree_select
 from fedml_tpu.trainer.local import NetState, make_epoch_shuffle
 
@@ -148,48 +158,42 @@ def make_gan_local_train(module, lr: float, local_epochs: int,
     return local_train
 
 
-class FedGanAPI(FederatedLoop):
+class FedGanAPI(FedAvgAPI):
     """Federated GAN trainer (reference FedGanAPI.py + FedGANAggregator.py).
 
-    Unlike the classifier APIs the model is initialized from latent noise
-    (``[B, latent_dim]``), so this does not subclass FedAvgAPI — it reuses
-    the shared round scaffold (FederatedLoop.run_round: vmap/shard_map +
-    weighted tree-mean) with a GAN-specific local step. ``train_fed.y`` is
-    ignored; GANs have no accuracy eval (the reference logs only losses)."""
+    The model initializes from latent noise (``[B, latent_dim]``) via the
+    ``_net_init_input`` hook; the local step is the adversarial D/G loop
+    (``_build_local_train``); everything else — sampling, aggregation,
+    every execution tier in the capability record — is the inherited
+    FedAvg machinery. ``train_fed.y`` is ignored; GANs have no accuracy
+    eval (the reference logs only losses), so ``evaluate`` returns {}."""
 
-    def __init__(self, model, train_fed, cfg, mesh=None, latent_dim: int = None):
-        from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
-
+    def __init__(self, model, train_fed, cfg, mesh=None,
+                 latent_dim: int = None):
         if latent_dim is None:
             latent_dim = getattr(model, "latent_dim", 100)
         self.module = model
-        self.cfg = cfg
-        self.mesh = mesh
-        self.train_fed = train_fed
-        self.test_global = None
         self.latent_dim = latent_dim
+        super().__init__(model, train_fed, None, cfg, mesh=mesh)
+        # The adversarial step builds its own per-net Adam pair; cfg
+        # knobs the generic trainer honors (dp_clip/dp_noise/grad_clip/
+        # client_optimizer/compress) must refuse, not silently no-op —
+        # a user who set dp_noise_multiplier must not believe DP is
+        # active (same convention as FedNAS/SCAFFOLD/FedDyn).
+        self._require_plain_sgd_round("FedGanAPI's adversarial D/G step")
 
-        local_train = make_gan_local_train(model, cfg.lr, cfg.epochs, latent_dim)
-        if mesh is None:
-            self.n_shards = 1
-            round_fn = make_vmap_round(local_train)
-        else:
-            self.n_shards = int(mesh.shape[mesh.axis_names[0]])
-            round_fn = make_sharded_round(local_train, mesh, mesh.axis_names[0])
-        self.round_fn = jax.jit(round_fn)
+    def _net_init_input(self, sample_x):
+        # One latent batch, matching the packed batch size — the joint
+        # G→D __call__ initializes both subtrees from it.
+        b = int(np.asarray(sample_x).shape[0])
+        return jnp.zeros((b, self.latent_dim), jnp.float32)
 
-        rng = jax.random.PRNGKey(cfg.seed)
-        self.rng, init_rng = jax.random.split(rng)
-        z = jnp.zeros((int(train_fed.x.shape[2]), latent_dim), jnp.float32)
-        variables = model.init({"params": init_rng}, z, train=False)
-        params = variables["params"]
-        state = {k: v for k, v in variables.items() if k != "params"}
-        self.net = NetState(params=params, model_state=state)
-
-    def train_one_round(self, round_idx: int):
-        avg, loss = self.run_round(round_idx)
-        self.net = avg
-        return {"round": round_idx, "train_loss": float(loss)}
+    def _build_local_train(self, optimizer, loss_fn):
+        # The adversarial step builds its OWN per-net Adam pair from the
+        # live client lr; the generic optimizer/loss are unused.
+        del optimizer, loss_fn
+        return make_gan_local_train(self.module, self._client_lr,
+                                    self.cfg.epochs, self.latent_dim)
 
     def evaluate(self):
         return {}

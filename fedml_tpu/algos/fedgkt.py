@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from fedml_tpu.algos.capability import ExcludedScanTiers
 from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.core.tree import tree_select
 from fedml_tpu.data.batching import FederatedArrays
@@ -47,12 +48,19 @@ def kl_loss(student_logits, teacher_logits, temperature: float = 1.0):
     return t * t * jnp.sum(q * (jnp.log(q) - log_p), axis=-1)
 
 
-class FedGKTAPI:
+class FedGKTAPI(ExcludedScanTiers):
     """Alternating client/server distillation.
 
     ``client_model``: stump returning ``(logits, features)``
     (fedml_tpu.models.resnet_split.ResNetClientStump).
     ``server_model``: tail mapping features → logits."""
+
+    window_protocol = None
+    window_exclusion = (
+        "group knowledge transfer alternates TWO models (client stumps "
+        "+ server tail) through a feature/logit exchange each round — "
+        "the server phase trains on every client's features, so the "
+        "round is not a cohort fold with a pure server carry")
 
     def __init__(self, client_model, server_model, train_fed: FederatedArrays,
                  test_global, cfg: FedConfig, temperature: float = 3.0,

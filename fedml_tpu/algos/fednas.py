@@ -18,22 +18,30 @@ tree-mean (which covers w and α jointly, exactly the reference's two loops).
 The 2nd-order arch gradient ∇α L_val(w − ξ∇w L_train(w,α), α) is an exact
 ``jax.grad`` through the unrolled inner step — no finite-difference
 Hessian-vector approximation (architect.py:229) needed under XLA.
+
+Capability record: since the record refactor ``FedNASAPI`` IS a
+``FedAvgAPI`` whose local step is the bilevel search (server update =
+plain client average, "round" protocol, no carry) — FedNAS rides the
+fused round step, the pipelined loop, the windowed streaming scan and
+the on-device scan. For that the train/valid split had to become
+MASK-AWARE: the halves are cut at ``n_real // 2`` where ``n_real`` is
+the client's true (non-padded) step count, so a store cohort forced onto
+a larger window-max step bucket trains on exactly the same batches as
+the per-round host loop (all-masked tail steps change nothing — the
+prefix-stability contract every windowed algorithm must meet). On the
+resident layout, where every cohort shares one fixed S, the split is
+identical to the old static ``S // 2``.
 """
 
 from __future__ import annotations
 
-from typing import Dict
-
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
-from fedml_tpu.algos.config import FedConfig
-from fedml_tpu.algos.loop import FederatedLoop
-from fedml_tpu.core.tree import tree_select, tree_weighted_mean
-from fedml_tpu.data.batching import FederatedArrays, gather_clients
-from fedml_tpu.trainer.local import NetState, make_eval_fn, model_fns, softmax_ce
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.core.tree import tree_select
+from fedml_tpu.trainer.local import NetState, softmax_ce
 
 ALPHA_KEYS = ("alphas_normal", "alphas_reduce")
 
@@ -52,143 +60,147 @@ def _masked(tree, mask):
         mask, tree, is_leaf=lambda n: isinstance(n, bool))
 
 
-class FedNASAPI(FederatedLoop):
-    """Federated DARTS search (reference FedNASAPI.py:16).
+def make_fednas_local_search(apply_fn, lr_w: float, lr_a: float, xi: float,
+                             local_epochs: int, unrolled: bool):
+    """``local_search(net, x, y, mask, rng) -> (net', loss)`` — the
+    bilevel DARTS step with the shared local-train signature, so the
+    FedAvg round builders (vmap, shard_map, fused, windowed, on-device)
+    consume it unchanged.
 
-    Each client's packed batches are split in half: the first ``S//2``
-    steps are the train split, the rest the valid split (the reference
-    splits each client's local data into train/valid queues,
-    FedNASTrainer.py:22-30)."""
+    The local data splits in half by TRUE step count: steps ``[0, h)``
+    are the train queue, ``[h, 2h)`` the valid queue, ``h = n_real // 2``
+    (the reference's 50/50 queue split, FedNASTrainer.py:22-30; with odd
+    counts the final real step feeds neither half, deliberately). The
+    scan runs over the STATIC bound ``S // 2`` and gates steps at
+    ``i >= h`` off — exact no-ops, so a padded step bucket leaves the
+    trajectory bit-identical (windowed == host)."""
 
-    def __init__(self, model, train_fed: FederatedArrays, test_global,
-                 cfg: FedConfig, arch_lr: float = 3e-4, xi: float = 0.0,
-                 unrolled: bool = False):
-        """``xi``/``unrolled``: 2nd-order arch step w − ξ∇L_train lookahead
-        (architect.py unrolled mode); ``unrolled=False`` is the reference's
-        ``--arch_search_method`` default 1st-order (MiLeNAS-style)."""
-        self.cfg = cfg
-        self.train_fed = train_fed
-        self.test_global = test_global
-        self.fns = model_fns(model)
-        if int(train_fed.x.shape[1]) < 2:
-            raise ValueError(
-                "FedNAS needs >= 2 packed steps per client (the local data "
-                "is split into train/valid halves, FedNASTrainer.py:22-30); "
-                "use a smaller batch_size so each client packs >= 2 batches")
+    def ce_loss(p, state, xb, yb, mb, rng):
+        logits, new_state = apply_fn(
+            NetState(p, state), xb, train=True, rng=rng)
+        per = softmax_ce(logits, yb)
+        return (jnp.sum(per * mb) / jnp.maximum(jnp.sum(mb), 1.0),
+                new_state)
+
+    def local_search(net, x, y, mask, rng):
+        S = x.shape[0]
+        half = S // 2  # static scan bound (>= the dynamic h)
+        amask, wmask_tree = _split_mask(net.params)
+        # True (non-padded) step count: the trainer keeps padding at the
+        # tail, and a real step always has at least one unmasked sample.
+        n_real = jnp.sum(jnp.any(mask > 0, axis=1).astype(jnp.int32))
+        h = n_real // 2
+
+        def row(a, i):
+            # Dynamic step gather (clipped — garbage rows are gated off
+            # below). ``i`` is traced inside the scan.
+            return jnp.take(a, i, axis=0, mode="clip")
+
+        def step(carry, i):
+            net, step_base = carry
+            xt, yt, mt = row(x, i), row(y, i), row(mask, i)
+            xv, yv, mv = row(x, h + i), row(y, h + i), row(mask, h + i)
+            # Three per-step keys fork from disjoint children of the
+            # fold_in-on-index key (fedlint R1): prefix-stable in the
+            # step count, whatever bucket the cohort was forced onto.
+            per_step = jax.random.fold_in(step_base, i)
+            r1 = jax.random.fold_in(per_step, 0)
+            r2 = jax.random.fold_in(per_step, 1)
+            r3 = jax.random.fold_in(per_step, 2)
+
+            # --- architecture step on the valid half ---------------
+            def val_loss_wrt_alpha(p):
+                if unrolled:
+                    # exact 2nd-order: lookahead w' = w − ξ∇w L_train
+                    gw, _ = jax.grad(ce_loss, has_aux=True)(
+                        p, net.model_state, xt, yt, mt, r1)
+                    p = jax.tree.map(
+                        lambda a, g: a - xi * g, p, _masked(gw, wmask_tree))
+                loss, state = ce_loss(p, net.model_state, xv, yv, mv, r2)
+                return loss, state
+
+            ga, _ = jax.grad(val_loss_wrt_alpha, has_aux=True)(net.params)
+            params = jax.tree.map(
+                lambda a, g: a - lr_a * g, net.params, _masked(ga, amask))
+
+            # --- weight step on the train half ---------------------
+            (loss, new_state), gw = jax.value_and_grad(
+                ce_loss, has_aux=True)(
+                    params, net.model_state, xt, yt, mt, r3)
+            params = jax.tree.map(
+                lambda a, g: a - lr_w * g, params, _masked(gw, wmask_tree))
+
+            active = (i < h) & (jnp.sum(mt) > 0)
+            ns = jnp.where(active, jnp.sum(mt), 0.0)
+            net = tree_select(active, NetState(params, new_state), net)
+            return (net, step_base), (loss, ns)
+
+        def epoch(carry, e):
+            # Sample-weighted epoch loss: gated steps (beyond the true
+            # half, or all-masked) carry weight 0 and must not dilute
+            # the reported search loss.
+            net, _ = carry
+            step_base = jax.random.fold_in(rng, e)
+            carry, (losses, ns) = jax.lax.scan(
+                step, (net, step_base), jnp.arange(half))
+            return carry, jnp.sum(losses * ns) / jnp.maximum(jnp.sum(ns), 1.0)
+
+        (net, _), losses = jax.lax.scan(
+            epoch, (net, rng), jnp.arange(local_epochs))
+        return net, jnp.mean(losses)
+
+    return local_search
+
+
+class FedNASAPI(FedAvgAPI):
+    """Federated DARTS search (reference FedNASAPI.py:16) as a FedAvg-
+    family algorithm: only the local step differs.
+
+    ``xi``/``unrolled``: 2nd-order arch step w − ξ∇L_train lookahead
+    (architect.py unrolled mode); ``unrolled=False`` is the reference's
+    ``--arch_search_method`` default 1st-order (MiLeNAS-style)."""
+
+    window_carry = "— (alphas average with the weights)"
+
+    def __init__(self, model, train_fed, test_global, cfg,
+                 arch_lr: float = 3e-4, xi: float = 0.0,
+                 unrolled: bool = False, **kw):
+        # Consumed by _build_local_train, which super().__init__ calls
+        # through set_client_lr — set first.
         self.arch_lr = arch_lr
         self.xi = xi if unrolled else 0.0
         self.unrolled = unrolled
-        self.n_shards = 1
         # Architecture geometry for genotype() — taken from the model, not
         # re-guessed from alpha shapes.
         self._steps = int(getattr(model, "steps", 4))
         self._multiplier = int(getattr(model, "multiplier", 4))
+        super().__init__(model, train_fed, test_global, cfg, **kw)
+        # The bilevel step implements its own two plain-SGD updates; cfg
+        # knobs the generic trainer honors must refuse, not no-op.
+        self._require_plain_sgd_round("FedNASAPI's bilevel search step")
+        # EVERY client must pack >= 2 real steps (the local data splits
+        # into train/valid halves, FedNASTrainer.py:22-30): a 1-step
+        # client has h = n_real // 2 = 0, so it would train NOTHING
+        # while keeping full aggregation weight — refuse loudly on both
+        # layouts instead of silently diluting every round it joins.
+        steps = np.ceil(np.maximum(self._host_counts(), 1)
+                        / cfg.batch_size)
+        if int(steps.min()) < 2:
+            raise ValueError(
+                "FedNAS needs >= 2 packed steps for EVERY client (the "
+                "local data is split into train/valid halves, "
+                "FedNASTrainer.py:22-30); "
+                f"min(ceil(count/batch)) = {int(steps.min())} — use a "
+                "smaller batch_size so each client packs >= 2 batches")
 
-        rng = jax.random.PRNGKey(cfg.seed)
-        self.rng, init_rng = jax.random.split(rng)
-        sample_x = np.asarray(train_fed.x[0, 0])
-        self.net = self.fns.init(init_rng, sample_x)
-        self.round_fn = jax.jit(self._build_round())
-        self.eval_fn = jax.jit(make_eval_fn(self.fns.apply))
-
-    # ------------------------------------------------------------------
-    def _build_round(self):
-        apply_fn = self.fns.apply
-        lr_w, lr_a, xi = self.cfg.lr, self.arch_lr, self.xi
-        epochs = self.cfg.epochs
-        unrolled = self.unrolled
-
-        def ce_loss(p, state, xb, yb, mb, rng):
-            logits, new_state = apply_fn(
-                NetState(p, state), xb, train=True, rng=rng)
-            per = softmax_ce(logits, yb)
-            return (jnp.sum(per * mb) / jnp.maximum(jnp.sum(mb), 1.0),
-                    new_state)
-
-        def local_search(net, x, y, mask, rng):
-            # Floor split: with odd S the final batch is used by neither
-            # half (deliberate — equal-sized train/valid splits, like the
-            # reference's 50/50 queue split).
-            S = x.shape[0]
-            half = S // 2
-            amask, wmask = _split_mask(net.params)
-
-            def step(carry, inputs):
-                net, step_base = carry
-                (xt, yt, mt), (xv, yv, mv), idx = inputs
-                # Three per-step keys fork from disjoint children of the
-                # fold_in-on-index key (fedlint R1): prefix-stable in the
-                # step count, unlike the carried split chain it replaces.
-                per_step = jax.random.fold_in(step_base, idx)
-                r1 = jax.random.fold_in(per_step, 0)
-                r2 = jax.random.fold_in(per_step, 1)
-                r3 = jax.random.fold_in(per_step, 2)
-
-                # --- architecture step on the valid half ---------------
-                def val_loss_wrt_alpha(p):
-                    if unrolled:
-                        # exact 2nd-order: lookahead w' = w − ξ∇w L_train
-                        gw, _ = jax.grad(ce_loss, has_aux=True)(
-                            p, net.model_state, xt, yt, mt, r1)
-                        p = jax.tree.map(
-                            lambda a, g: a - xi * g, p, _masked(gw, wmask))
-                    loss, state = ce_loss(p, net.model_state, xv, yv, mv, r2)
-                    return loss, state
-
-                ga, _ = jax.grad(val_loss_wrt_alpha, has_aux=True)(net.params)
-                params = jax.tree.map(
-                    lambda a, g: a - lr_a * g, net.params, _masked(ga, amask))
-
-                # --- weight step on the train half ---------------------
-                (loss, new_state), gw = jax.value_and_grad(
-                    ce_loss, has_aux=True)(
-                        params, net.model_state, xt, yt, mt, r3)
-                params = jax.tree.map(
-                    lambda a, g: a - lr_w * g, params, _masked(gw, wmask))
-
-                ns = jnp.sum(mt)
-                net = tree_select(ns > 0, NetState(params, new_state), net)
-                return (net, step_base), (loss, ns)
-
-            def epoch(carry, e):
-                # Sample-weighted epoch loss: padded all-masked steps return
-                # loss 0 and must not dilute the reported search_loss.
-                net, _ = carry
-                step_base = jax.random.fold_in(rng, e)
-                carry, (losses, ns) = jax.lax.scan(
-                    step, (net, step_base),
-                    ((x[:half], y[:half], mask[:half]),
-                     (x[half:2 * half], y[half:2 * half], mask[half:2 * half]),
-                     jnp.arange(half)))
-                return carry, jnp.sum(losses * ns) / jnp.maximum(jnp.sum(ns), 1.0)
-
-            (net, _), losses = jax.lax.scan(
-                epoch, (net, rng), jnp.arange(epochs))
-            return net, jnp.mean(losses)
-
-        def round_fn(net, x, y, mask, weights, rng):
-            rngs = jax.vmap(
-                lambda i: jax.random.fold_in(rng, i))(jnp.arange(x.shape[0]))
-            client_nets, losses = jax.vmap(
-                local_search, in_axes=(None, 0, 0, 0, 0))(net, x, y, mask, rngs)
-            avg = tree_weighted_mean(client_nets, weights)
-            lw = weights / jnp.maximum(jnp.sum(weights), 1e-12)
-            return avg, jnp.sum(losses * lw)
-
-        return round_fn
-
-    # ------------------------------------------------------------------
-    def train_one_round(self, round_idx: int) -> Dict[str, float]:
-        idx, wmask = self.sample_round(round_idx)
-        sub = gather_clients(self.train_fed, idx)
-        weights = sub.counts.astype(jnp.float32) * jnp.asarray(wmask)
-        self.rng, rnd = jax.random.split(self.rng)
-        self.net, loss = self.round_fn(
-            self.net, sub.x, sub.y, sub.mask, weights, rnd)
-        return {"round": round_idx, "search_loss": float(loss)}
-
-    def _eval_net(self):
-        return self.net
+    def _build_local_train(self, optimizer, loss_fn):
+        # The bilevel step is self-contained plain SGD (weight lr = the
+        # live client lr, arch lr = arch_lr); the generic optimizer is
+        # unused and incompatible knobs were refused above.
+        del optimizer, loss_fn
+        return make_fednas_local_search(
+            self.fns.apply, self._client_lr, self.arch_lr, self.xi,
+            self.cfg.epochs, self.unrolled)
 
     def genotype(self):
         """Derive the searched architecture from the averaged alphas

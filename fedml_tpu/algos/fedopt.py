@@ -36,6 +36,8 @@ def make_server_optimizer(name: str, lr: float, momentum: float = 0.9):
 
 
 class FedOptAPI(FedAvgAPI):
+    window_carry = "server optimizer state"
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         cfg = self.cfg

@@ -26,6 +26,8 @@ class FedProxAPI(FedAvgAPI):
     (docs/EXECUTION.md support matrix; bit-equality pinned in
     tests/test_windowed.py)."""
 
+    window_carry = "— (μ term lives in the local step)"
+
     def _build_local_train(self, optimizer, loss_fn):
         mu = self.cfg.fedprox_mu
 

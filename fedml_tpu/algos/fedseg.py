@@ -136,6 +136,8 @@ class FedSegAPI(FedAvgAPI):
     with a single on-device confusion matrix.
     """
 
+    window_carry = "— (seg loss/metrics live in the local step/eval)"
+
     def __init__(self, model, train_fed, test_global, cfg, num_classes: int,
                  loss_mode: str = "ce", ignore_index: int = 255, **kw):
         self.num_classes = num_classes

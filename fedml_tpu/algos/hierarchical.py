@@ -57,6 +57,17 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
     supports_streaming = True  # group cohorts ride _group_cohort
     composes_group_aggregation = True  # two-stage robust aggregation
 
+    #: Carry capability record: opted out with the reason every scan-tier
+    #: guard raises. The global reduce is pure, but the ROUND is a host
+    #: loop over a per-round-variable set of groups, each running
+    #: group_comm_round inner rounds — no fixed-shape step exists to scan.
+    window_protocol = None
+    window_exclusion = (
+        "each round trains a data-dependent number of groups for "
+        "group_comm_round inner rounds on host — the per-round work has "
+        "no fixed scan shape; the mesh-shard analogue (cfg.group_reduce "
+        "on the flat FedAvg family) rides every tier instead")
+
     def __init__(self, model, train_fed, test_global, cfg, group_ids: Sequence[int],
                  mesh=None, **kwargs):
         super().__init__(model, train_fed, test_global, cfg, mesh=mesh, **kwargs)

@@ -13,6 +13,8 @@ from typing import Dict, List
 import jax
 import jax.numpy as jnp
 
+from fedml_tpu.algos.capability import ExcludedScanTiers
+
 
 def eval_segments(comm_round: int, frequency_of_the_test: int,
                   start: int = 0):
@@ -32,7 +34,7 @@ def eval_segments(comm_round: int, frequency_of_the_test: int,
         r = e + 1
 
 
-class FederatedLoop:
+class FederatedLoop(ExcludedScanTiers):
     """Mixin. Subclasses provide ``cfg``, ``train_one_round(round_idx)``,
     ``eval_fn``, ``test_global``, and ``_eval_net()``. Subclasses that also
     provide ``n_shards``, ``train_fed``, ``net``, ``rng`` and ``round_fn``
@@ -40,7 +42,13 @@ class FederatedLoop:
 
     ``round_fn_fused`` is an optional extension point: a jitted
     ``(net, train_fed, idx, wmask, rng)`` round with the client gather
-    traced inside (single-device fast path built by FedAvgAPI)."""
+    traced inside (single-device fast path built by FedAvgAPI).
+
+    The scan-tier entry points come from :class:`ExcludedScanTiers`
+    (record-derived refusals keyed on the carry capability
+    declarations below); FedAvgAPI overrides both the declarations —
+    derived structurally from the carry-protocol hooks — and the entry
+    points."""
 
     round_fn_fused = None
 

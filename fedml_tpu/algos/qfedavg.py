@@ -184,6 +184,8 @@ class QFedAvgAPI(FedAvgAPI):
     use q in [0.1, 5]. Works on the single-device vmap simulator and
     sharded over a client mesh (tested numerically identical)."""
 
+    window_carry = "— (fair q-update baked into round_fn)"
+
     def __init__(self, *args, q: float = 1.0, **kw):
         self.q = q
         super().__init__(*args, **kw)

@@ -72,6 +72,9 @@ def attack_success_rate(api, x_targeted, y_target, batch_size: int = 128):
 
 
 class FedAvgRobustAPI(FedAvgAPI):
+    window_carry = ("— (round-keyed weak-DP noise; [W, C] adversary "
+                    "mask rides the scanned aux slot)")
+
     def __init__(self, *args, adversary_clients=None, **kwargs):
         super().__init__(*args, **kwargs)
         cfg = self.cfg

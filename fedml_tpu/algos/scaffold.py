@@ -23,14 +23,11 @@ clients get exact control updates.
 
 from __future__ import annotations
 
-from typing import Dict
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
-from fedml_tpu.core.tree import gather_stacked, scatter_stacked
 from fedml_tpu.trainer.local import tree_select
 
 
@@ -66,10 +63,11 @@ class ScaffoldAPI(FedAvgAPI):
 
     #: Windowed carry protocol: the round itself consumes/produces the
     #: carried state (server control + client-control stack), so the
-    #: scan body is custom — see _build_window_scan. Custom rounds do
-    #: not ride train_rounds_pipelined (the per-round host procedure
-    #: here IS the round: eager control gather/scatter).
+    #: step is custom — see _build_fused_step, which serves the fused
+    #: host round, the pipelined loop AND the windowed scan (the
+    #: capability record derives all three from it).
     window_protocol = "custom"
+    window_carry = "server control + client-control stack"
 
     def __init__(self, *args, server_lr: float = 1.0, **kw):
         super().__init__(*args, **kw)
@@ -165,40 +163,22 @@ class ScaffoldAPI(FedAvgAPI):
         self._scaffold_jit = jax.jit(round_fn)
         return self._scaffold_jit
 
-    def train_one_round(self, round_idx: int) -> Dict[str, float]:
-        idx, wmask = self.sample_round(round_idx)
-        # Shared cohort path: device gather on the resident layout,
-        # host-gathered + double-buffered on the streaming store.
-        sub = self._cohort(round_idx, idx)
-        idx = jnp.asarray(idx)
-        wmask_a = jnp.asarray(wmask, jnp.float32)
-        ck_sub = gather_stacked(self.client_controls, idx)
-        self.rng, rnd = jax.random.split(self.rng)
-        weights = sub.counts.astype(jnp.float32) * wmask_a
-        self.net, self.server_control, ck_new, loss = self._scaffold_round_fn()(
-            self.net, self.server_control, ck_sub,
-            sub.x, sub.y, sub.mask, weights, rnd)
-        # Only clients that actually trained update their control: a
-        # sampled EMPTY client runs zero real steps, so writing its
-        # ck - c + 0 "update" would drift its stored control by -c each
-        # time it is sampled (the paper updates controls only for clients
-        # that computed updates).
-        trained_mask = wmask_a * (sub.counts > 0).astype(jnp.float32)
-        self.client_controls = scatter_stacked(
-            self.client_controls, idx, ck_new, trained_mask)
-        return {"round": round_idx, "train_loss": float(loss)}
+    # --- carry capability record ("custom"): controls ride every tier ----
+    def _build_fused_step(self):
+        """ONE SCAFFOLD round as one donated dispatch: cohort control
+        gather + the stateful round + the masked scatter-merge, carry
+        ``(net, (server_control, client_controls))``. The same step
+        scanned W-deep IS the windowed tier (``_build_window_scan``
+        derives from it), so a client sampled twice in one window sees
+        its own earlier control update (bit-equality with the host
+        loop). The scatter gate: only clients that actually trained
+        update their control — a sampled EMPTY client runs zero real
+        steps, so writing its ``ck - c + 0`` "update" would drift its
+        stored control by ``-c`` each time it is sampled (the paper
+        updates controls only for clients that computed updates)."""
+        from fedml_tpu.parallel.shard import make_fused_stateful_round_step
 
-    # --- windowed carry protocol ("custom"): controls ride the scan ------
-    def _build_window_scan(self):
-        """W SCAFFOLD rounds per dispatch: the scan carries
-        ``(net, (server_control, client_controls))`` and each scanned
-        round gathers its cohort's control slots, runs the stateful
-        round, and scatter-merges the updated slots back — inside the
-        body, so a client sampled twice in one window sees its own
-        earlier update (bit-equality with the host loop)."""
-        from fedml_tpu.parallel.shard import make_stateful_window_scan
-
-        return make_stateful_window_scan(self._scaffold_round_fn())
+        return make_fused_stateful_round_step(self._scaffold_round_fn())
 
     def _window_carry_init(self):
         return (self.server_control, self.client_controls)
@@ -209,12 +189,13 @@ class ScaffoldAPI(FedAvgAPI):
     def _window_scan_extras(self, idx2d, wmask2d):
         from fedml_tpu.obs.sanitizer import planned_transfer
 
-        # The scan body needs each round's cohort index map (control
+        # The step needs each round's cohort index map (control
         # gather/scatter) and its trained mask (empty clients must not
-        # write their slot — same rule as the host loop above). Both are
-        # window-keyed host gathers over store counts; the H2D rides the
+        # write their slot). Both are host gathers over counts
+        # (layout-agnostic — the resident host loop and the store-backed
+        # windowed scan consume the same operands); the H2D rides the
         # window's planned staging copies.
-        trained = self.train_fed.window_trained_mask(idx2d, wmask2d)
+        trained = self._window_update_mask(idx2d, wmask2d)
         with planned_transfer():
             return (jnp.asarray(np.asarray(idx2d), jnp.int32),
                     jnp.asarray(trained, jnp.float32))

@@ -37,14 +37,27 @@ from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.trainer.local import NetState, model_fns, softmax_ce
 
 
-class SplitNNAPI:
+from fedml_tpu.algos.capability import ExcludedScanTiers
+
+
+class SplitNNAPI(ExcludedScanTiers):
     """Relay-ring split learning over a packed federated dataset.
+
+    Carry capability record: excluded — see ``window_exclusion``.
 
     ``client_model``: module whose ``__call__(x, train)`` returns the cut
     activations. ``server_model``: module mapping activations → logits.
     One ``train_one_epoch`` = one full relay cycle (every client trains one
     local epoch, in ring order). ``cfg.epochs`` cycles ≈ the reference's
     MAX_EPOCH_PER_NODE."""
+
+    window_protocol = None
+    window_exclusion = (
+        "split learning trains ONE model cut across two trust domains "
+        "with a sequential relay ring (the server top updates between "
+        "clients, order-dependent) — there is no per-round cohort fold "
+        "to publish as a (carry_init, server_update, carry_commit) "
+        "record")
 
     def __init__(self, client_model, server_model, train_fed: FederatedArrays,
                  test_global, cfg: FedConfig, loss_fn=softmax_ce):

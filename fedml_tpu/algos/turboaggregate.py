@@ -41,6 +41,16 @@ class TurboAggregateAPI(FedAvgAPI):
     groups; ``scale`` = fixed-point quantization (2^16 ≈ 1.5e-5 absolute
     error per aggregate — well under SGD noise)."""
 
+    #: Carry capability record: opted out with the reason every scan-tier
+    #: guard raises — the aggregation is a host-side multi-party share
+    #: protocol, not a device fold the scan could replay.
+    window_protocol = None
+    window_exclusion = (
+        "aggregation is the host-side Turbo-Aggregate MPC protocol "
+        "(prime-field additive shares across trust domains, "
+        "core/mpc) — there is no pure (carry_init, server_update, "
+        "carry_commit) device record to scan")
+
     def __init__(self, *args, n_groups: int = 2, scale: int = 2 ** 16,
                  prime: int = mpc.DEFAULT_PRIME, **kwargs):
         super().__init__(*args, **kwargs)

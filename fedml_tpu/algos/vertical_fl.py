@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from fedml_tpu.algos.capability import ExcludedScanTiers
 from fedml_tpu.models.vfl import VFLDenseModel, VFLLocalModel
 
 
@@ -50,12 +51,19 @@ class VflParty:
         return self.dense.apply({"params": params["dense"]}, rep)
 
 
-class VflAPI:
+class VflAPI(ExcludedScanTiers):
     """Two-or-more-party VFL with a logistic top (reference
     VerticalMultiplePartyLogisticRegressionFederatedLearning, vfl.py:1).
 
     ``x_parties``: list of per-party feature matrices ``[N, d_p]`` with the
     guest first; ``y``: binary labels ``[N]`` held by the guest only."""
+
+    window_protocol = None
+    window_exclusion = (
+        "vertical FL partitions FEATURES, not clients: every party "
+        "joins every batch and the guest's common gradient crosses "
+        "trust domains per batch — no client-cohort round exists to "
+        "publish as a carry record")
 
     def __init__(self, feature_dims: Sequence[int], rep_dim: int = 32,
                  lr: float = 0.01, seed: int = 0):

@@ -175,6 +175,13 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--qffl_q", type=float, default=1.0,
                    help="q-FedAvg fairness exponent (0 = equal-weight "
                         "FedAvg; --algorithm QFedAvg)")
+    p.add_argument("--fedac_gamma", type=float, default=2.0,
+                   help="FedAc acceleration γ in units of the round's "
+                        "local progress (1 = FedAvg; --algorithm FedAc)")
+    p.add_argument("--server_avg_coef", type=float, default=0.5,
+                   help="server-averaging mix β toward the running mean "
+                        "of past globals (0 = FedAvg; --algorithm "
+                        "ServerAvg)")
     p.add_argument("--dp_clip", type=float, default=0.0,
                    help="example-level DP-SGD: per-example grad L2 clip "
                         "(0 disables DP)")

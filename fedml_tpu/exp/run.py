@@ -54,6 +54,8 @@ def make_api(algorithm: str, args, model, arrays, test, cfg, mesh,
         common["pad_id"] = pad_id
     table = {
         "FedAvg": algos.FedAvgAPI,
+        "FedAc": algos.FedAcAPI,
+        "ServerAvg": algos.ServerAvgAPI,
         "FedOpt": algos.FedOptAPI,
         "FedProx": algos.FedProxAPI,
         "FedNova": algos.FedNovaAPI,
@@ -71,6 +73,10 @@ def make_api(algorithm: str, args, model, arrays, test, cfg, mesh,
         common["q"] = args.qffl_q
     elif algorithm == "FedDyn":
         common["alpha"] = args.feddyn_alpha
+    elif algorithm == "FedAc":
+        common["gamma"] = getattr(args, "fedac_gamma", 2.0)
+    elif algorithm == "ServerAvg":
+        common["avg_coef"] = getattr(args, "server_avg_coef", 0.5)
     if algorithm in table:
         return table[algorithm](model, arrays, test, cfg, **common)
     if algorithm == "FedSeg":

@@ -7,7 +7,9 @@ from fedml_tpu.parallel.layout import (
 from fedml_tpu.parallel.mesh import client_mesh, mesh_2d
 from fedml_tpu.parallel.shard import (
     make_fused_round_step,
+    make_fused_stateful_round_step,
     make_sharded_round,
+    make_step_window_scan,
     make_vmap_round,
 )
 from fedml_tpu.parallel.ring_attention import (
@@ -35,7 +37,9 @@ __all__ = [
     "client_mesh",
     "mesh_2d",
     "make_fused_round_step",
+    "make_fused_stateful_round_step",
     "make_sharded_round",
+    "make_step_window_scan",
     "make_vmap_round",
     "make_ring_attention",
     "reference_attention",

@@ -336,6 +336,63 @@ def make_fused_round_step(round_fn, server_update=None):
     return step_fn
 
 
+def make_fused_stateful_round_step(round_fn):
+    """Fused ONE-dispatch round for ``make_stateful_client_round``-shaped
+    rounds (SCAFFOLD's controls, FedDyn's corrections): cohort state
+    gather + the stateful round + the masked scatter-merge run in the
+    SAME dispatch, with the carry ``(net, (s_global, s_clients))`` —
+    ``s_clients`` the FULL client-stacked state ``[N, ...]``. Callers
+    jit with ``donate_argnums=(0, 1)`` so the old model AND the old
+    state stack are reused in place (the host loop used to pay three
+    dispatches — eager gather, round, eager scatter — and hold the old
+    plus new state stacks live simultaneously).
+
+    Signature matches the capability protocol's step shape:
+    ``step(net, extra, x, y, mask, weights, key, idx, umask) ->
+    ((net', extra'), loss)`` where ``idx [k]`` is the round's padded
+    cohort index map and ``umask [k]`` gates the scatter (only clients
+    that actually trained write their slot — padded and empty-client
+    slots are routed out of bounds and dropped)."""
+    from fedml_tpu.core.tree import gather_stacked, scatter_stacked
+
+    def step_fn(net, extra, x, y, mask, weights, key, idx, umask):
+        s_global, s_clients = extra
+        sub = gather_stacked(s_clients, idx)
+        new_net, new_global, new_sub, loss = round_fn(
+            net, s_global, sub, x, y, mask, weights, key)
+        s_clients = scatter_stacked(s_clients, idx, new_sub, umask)
+        return (new_net, (new_global, s_clients)), loss
+
+    return step_fn
+
+
+def make_step_window_scan(step_fn):
+    """``lax.scan`` a capability-protocol fused round step over a window
+    of PRE-GATHERED rounds: the ONE step definition an algorithm
+    publishes (``_build_fused_step``) serves both the fused host round
+    (jitted with donation at W=1) and this scan — so windowed rounds are
+    bit-equal to fused host rounds BY CONSTRUCTION, not by parallel
+    implementations kept in sync.
+
+    Returns ``scan_fn(net, extra, x, y, mask, weights, keys, *aux) ->
+    ((net', extra'), losses)`` with ``x/y/mask [W, ...]``, ``weights
+    [W, C]``, ``keys [W, 2]`` the per-round rng keys in round order, and
+    ``aux`` any per-round scanned operands with leading axis W (the
+    ``_window_scan_extras`` slot: SCAFFOLD's cohort index maps, the
+    corruption drill's adversary masks, FedNova's τ-normalized
+    weights)."""
+
+    def scan_fn(net, extra, x, y, mask, weights, keys, *aux):
+        def body(carry, inp):
+            (xw, yw, mw, ww, kw), auxw = inp[:5], inp[5:]
+            return step_fn(carry[0], carry[1], xw, yw, mw, ww, kw, *auxw)
+
+        return jax.lax.scan(body, (net, extra),
+                            (x, y, mask, weights, keys) + tuple(aux))
+
+    return scan_fn
+
+
 def make_window_scan(round_fn, server_update=None):
     """``lax.scan`` over a window of PRE-GATHERED rounds: one jitted
     dispatch runs W whole federated rounds back-to-back — the windowed
@@ -371,22 +428,13 @@ def make_window_scan(round_fn, server_update=None):
     ``aux`` any extra per-round scanned inputs (leading axis W) the
     round takes as trailing operands — the "round"-protocol slot
     ``FedAvgAPI._window_scan_extras`` fills (the corruption drill's
-    ``[W, C]`` adversary mask)."""
+    ``[W, C]`` adversary mask).
 
-    def scan_fn(net, extra, x, y, mask, weights, keys, *aux):
-        def body(carry, inp):
-            net, extra = carry
-            (xw, yw, mw, ww, kw), auxw = inp[:5], inp[5:]
-            avg, loss = round_fn(net, xw, yw, mw, ww, ww, kw, *auxw)
-            if server_update is None:
-                return (avg, extra), loss
-            new_net, new_extra = server_update(net, avg, extra, kw)
-            return (new_net, new_extra), loss
-
-        return jax.lax.scan(body, (net, extra),
-                            (x, y, mask, weights, keys) + tuple(aux))
-
-    return scan_fn
+    Since the capability-record refactor this is literally
+    ``make_step_window_scan(make_fused_round_step(...))`` — the scanned
+    body and the fused host round are the SAME function."""
+    return make_step_window_scan(make_fused_round_step(round_fn,
+                                                       server_update))
 
 
 def make_stateful_window_scan(round_fn):
@@ -409,26 +457,12 @@ def make_stateful_window_scan(round_fn):
     and ``umask [W, k]`` gates the scatter — only clients that actually
     trained write their slot back (padded and empty-client slots are
     routed out of bounds and dropped, exactly as the host loop's
-    ``scatter_stacked``)."""
-    from fedml_tpu.core.tree import gather_stacked, scatter_stacked
+    ``scatter_stacked``).
 
-    def scan_fn(net, extra, x, y, mask, weights, keys, idx, umask):
-        def body(carry, inp):
-            net, s_global, s_clients = carry
-            xw, yw, mw, ww, kw, iw, uw = inp
-            sub = gather_stacked(s_clients, iw)
-            new_net, new_global, new_sub, loss = round_fn(
-                net, s_global, sub, xw, yw, mw, ww, kw)
-            s_clients = scatter_stacked(s_clients, iw, new_sub, uw)
-            return (new_net, new_global, s_clients), loss
-
-        s_global, s_clients = extra
-        (net, s_global, s_clients), losses = jax.lax.scan(
-            body, (net, s_global, s_clients),
-            (x, y, mask, weights, keys, idx, umask))
-        return (net, (s_global, s_clients)), losses
-
-    return scan_fn
+    Since the capability-record refactor this is literally
+    ``make_step_window_scan(make_fused_stateful_round_step(...))`` — the
+    scanned body and the fused host round are the SAME function."""
+    return make_step_window_scan(make_fused_stateful_round_step(round_fn))
 
 
 def window_put(mesh, axis: str = "clients"):

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Generate the EXECUTION.md algorithm × tier support matrix from the
+carry capability records (fedml_tpu/algos/capability.py).
+
+The matrix lives between marker comments in docs/EXECUTION.md; this
+script regenerates that region. The drift test
+(tests/test_zoo_windowed.py::test_execution_matrix_matches_records)
+fails whenever the committed table differs from the records — the docs
+CANNOT silently diverge from the guards again.
+
+Usage:
+    python scripts/gen_support_matrix.py           # print the block
+    python scripts/gen_support_matrix.py --write   # rewrite EXECUTION.md
+    python scripts/gen_support_matrix.py --check   # exit 1 on drift
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+DOC = os.path.join(os.path.dirname(__file__), os.pardir, "docs",
+                   "EXECUTION.md")
+
+
+def _split(text):
+    from fedml_tpu.algos.capability import MATRIX_BEGIN, MATRIX_END
+
+    try:
+        head, rest = text.split(MATRIX_BEGIN, 1)
+        _, tail = rest.split(MATRIX_END, 1)
+    except ValueError:
+        raise SystemExit(
+            f"docs/EXECUTION.md is missing the generated-matrix markers "
+            f"({MATRIX_BEGIN!r} ... {MATRIX_END!r})")
+    return head, tail
+
+
+def main(argv):
+    from fedml_tpu.algos.capability import matrix_block
+
+    block = matrix_block()
+    if "--write" in argv:
+        with open(DOC) as f:
+            head, tail = _split(f.read())
+        with open(DOC, "w") as f:
+            f.write(head + block + tail)
+        print(f"wrote generated matrix into {os.path.relpath(DOC)}")
+        return 0
+    if "--check" in argv:
+        with open(DOC) as f:
+            text = f.read()
+        if block not in text:
+            print("docs/EXECUTION.md support matrix DRIFTED from the "
+                  "capability records — regenerate with "
+                  "`python scripts/gen_support_matrix.py --write`",
+                  file=sys.stderr)
+            return 1
+        print("support matrix matches the capability records")
+        return 0
+    print(block)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -82,7 +82,8 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     fake_clock[0] = 1.0
     monkeypatch.setattr(bench, "bench_cifar_resnet56", slow_primary)
     for name in ("bench_femnist_cnn_3400", "bench_store_windowed",
-                 "bench_store_windowed_fedopt", "bench_robust_agg",
+                 "bench_store_windowed_fedopt", "bench_zoo_windowed",
+                 "bench_robust_agg",
                  "bench_chaos", "bench_wire_codec", "bench_ingest_profile",
                  "bench_serving_1m", "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
@@ -111,7 +112,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     # Every section that RAN finished inside the budget: elapsed at its
     # start + the full section cap fit under 300s.
     assert len(ran) * 50 + 100 <= 300
-    assert len(ran) + len(skipped) == 17
+    assert len(ran) + len(skipped) == 18
 
 
 def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
@@ -121,7 +122,8 @@ def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
 
     monkeypatch.setattr(bench, "bench_cifar_resnet56", dead_primary)
     for name in ("bench_femnist_cnn_3400", "bench_store_windowed",
-                 "bench_store_windowed_fedopt", "bench_robust_agg",
+                 "bench_store_windowed_fedopt", "bench_zoo_windowed",
+                 "bench_robust_agg",
                  "bench_chaos", "bench_wire_codec", "bench_ingest_profile",
                  "bench_serving_1m", "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
@@ -202,12 +204,18 @@ def test_headline_tolerates_budget_skipped_submetrics():
                    {"skipped": "wall-clock budget 1350s exhausted"}},
            "tuned_best": None}
     h = json.loads(json.dumps(bench.build_headline(out)))
-    assert h["sub"]["store_windowed_rps"] == 12.5
+    # store_windowed_rps rotated out of the headline in r13 (the full
+    # blob keeps it; the speedup scalar carries the story).
+    assert "store_windowed_rps" not in h["sub"]
     assert h["sub"]["store_windowed_speedup"] == 1.7
     # fedopt_windowed_rps rotated out of the headline in r10 (the full
     # blob keeps it; the speedup scalar carries the story).
     assert "fedopt_windowed_rps" not in h["sub"]
     assert h["sub"]["fedopt_windowed_speedup"] == 1.4
+    # The r13 whole-zoo scalars ride (None when the section was skipped).
+    assert h["sub"]["zoo_windowed_speedup"] is None
+    assert h["sub"]["fedac_acc_delta"] is None
+    assert "fleet_buffered_acc" not in h["sub"]  # rotated out in r13
     assert h["sub"]["flash_speedup_t16384"] is None
     assert h["sub"]["transformer_mfu"] is None
     assert len(json.dumps(h)) < 1024

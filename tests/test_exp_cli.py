@@ -20,7 +20,7 @@ def _args(extra=()):
     return parse_args(base + list(extra))
 
 
-@pytest.mark.parametrize("algo", ["FedAvg", "FedOpt", "FedProx", "FedNova", "FedAvgRobust"])
+@pytest.mark.parametrize("algo", ["FedAvg", "FedOpt", "FedProx", "FedNova", "FedAvgRobust", "FedAc"])
 def test_run_algorithms(algo):
     api, history = run(_args(), algorithm=algo)
     assert len(history) == 3

@@ -150,12 +150,24 @@ def test_train_rounds_on_device_subsampled_runs():
     assert _np.isfinite(_np.asarray(losses)).all()
     assert float(losses[-1]) < float(losses[0])
 
-    # Stateful-server subclasses refuse the scan path.
+    # Stateful-but-PURE server updates now ride the scan through the
+    # carry protocol (the capability-record refactor): FedOpt's server
+    # optimizer state threads between scanned rounds on device.
+    opt_api = FedOptAPI(create_model("lr", input_dim=8, num_classes=4), fed, None, cfg)
+    opt_losses = opt_api.train_rounds_on_device(3)
+    assert _np.isfinite(_np.asarray(opt_losses)).all()
+
+    # Per-round host-computed aux operands (FedNova's τ-normalized
+    # weights) have no slot in the on-device scan — record-derived
+    # refusal.
     import pytest
 
-    opt_api = FedOptAPI(create_model("lr", input_dim=8, num_classes=4), fed, None, cfg)
-    with pytest.raises(NotImplementedError):
-        opt_api.train_rounds_on_device(3)
+    from fedml_tpu.algos import FedNovaAPI
+
+    nova = FedNovaAPI(create_model("lr", input_dim=8, num_classes=4), fed,
+                      None, cfg)
+    with pytest.raises(NotImplementedError, match="aux"):
+        nova.train_rounds_on_device(3)
 
 
 def test_train_rounds_on_device_rejects_custom_round_subclasses():

@@ -28,7 +28,9 @@ def _setup(n_clients=4, per_client=32, batch=8):
 def test_fedgan_round_runs_and_generates():
     fed, cfg = _setup()
     api = FedGanAPI(MNISTGan(), fed, cfg)
-    p0 = jax.tree.leaves(api.net.params)
+    # Host-copy the snapshot: the fused round step DONATES the incoming
+    # net (the train_rounds_on_device caveat, now on every fused tier).
+    p0 = [np.array(l) for l in jax.tree.leaves(api.net.params)]
     m = api.train_one_round(0)
     assert np.isfinite(m["train_loss"])
     p1 = jax.tree.leaves(api.net.params)

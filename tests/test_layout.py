@@ -404,9 +404,14 @@ def test_separate_procedure_holds_two_copies():
 
 
 def test_fused_step_skipped_for_custom_rounds():
-    """Algorithms outside the 'round' protocol keep the separate path
-    (no silent behavior change): SCAFFOLD's custom round, oort."""
+    """Algorithms whose capability record declares no fused step keep
+    the separate path (no silent behavior change): TurboAggregate's
+    host-side MPC aggregation, oort's three-output round. (SCAFFOLD used
+    to belong here — since the capability-record refactor it PUBLISHES a
+    custom fused step instead, pinned bit-equal in test_windowed /
+    test_zoo_windowed.)"""
     from fedml_tpu.algos.scaffold import ScaffoldAPI
+    from fedml_tpu.algos.turboaggregate import TurboAggregateAPI
     from fedml_tpu.models.lr import LogisticRegression
 
     rng = np.random.RandomState(0)
@@ -415,9 +420,11 @@ def test_fused_step_skipped_for_custom_rounds():
     fed = build_federated_arrays(x, y, partition_homo(160, 8), 16)
     cfg = FedConfig(client_num_in_total=8, client_num_per_round=4,
                     comm_round=10, epochs=1, batch_size=16, lr=0.3)
-    sc = ScaffoldAPI(LogisticRegression(num_classes=2),
-                     fed, None, cfg)
-    assert sc._fused_round_step() is None
+    turbo = TurboAggregateAPI(LogisticRegression(num_classes=2),
+                              fed, None, cfg)
+    assert turbo._fused_round_step() is None
+    sc = ScaffoldAPI(LogisticRegression(num_classes=2), fed, None, cfg)
+    assert sc._fused_round_step() is not None  # the refactor's point
 
     api, _ = _lr_setup(client_selection="oort")
     assert api._fused_round_step() is None

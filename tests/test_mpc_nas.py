@@ -158,7 +158,7 @@ def test_fednas_search_moves_alphas_and_weights():
     api = FedNASAPI(_tiny_darts(), fed, test, cfg, arch_lr=3e-3)
     a0 = np.asarray(api.net.params["alphas_normal"]).copy()
     hist = api.train()
-    assert all(np.isfinite(h["search_loss"]) for h in hist)
+    assert all(np.isfinite(h["train_loss"]) for h in hist)
     a1 = np.asarray(api.net.params["alphas_normal"])
     assert not np.allclose(a0, a1)  # architecture actually searched
     g = api.genotype()
@@ -179,7 +179,7 @@ def test_fednas_unrolled_second_order_runs():
     api = FedNASAPI(_tiny_darts(), fed, None, cfg, arch_lr=3e-3,
                     xi=0.05, unrolled=True)
     m = api.train_one_round(0)
-    assert np.isfinite(m["search_loss"])
+    assert np.isfinite(m["train_loss"])
 
 
 def test_darts_odd_spatial_dims():

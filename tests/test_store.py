@@ -256,12 +256,12 @@ def test_incompatible_algorithms_reject_store():
 
     x, y, parts = _classification(8, 32)
     store = FederatedStore(x, y, parts, batch_size=16)
-    # Ditto still gathers training data client-stacked outside run_round.
-    with pytest.raises(NotImplementedError, match="streaming|resident"):
-        DittoAPI(LogisticRegression(num_classes=2), store, None,
-                 _cfg(8, 4, batch=16))
-    # SCAFFOLD streams now (controls stay device-resident; the cohort
-    # rides the shared _cohort path) — construction + a round must work.
+    # Ditto streams since the capability-record conversion (the personal
+    # stack stays device-resident; the cohort rides _cohort) — like
+    # SCAFFOLD before it, construction + a round must work.
+    dt = DittoAPI(LogisticRegression(num_classes=2), store, None,
+                  _cfg(8, 4, batch=16))
+    assert np.isfinite(dt.train_one_round(0)["train_loss"])
     sc = ScaffoldAPI(LogisticRegression(num_classes=2), store, None,
                      _cfg(8, 4, batch=16))
     assert np.isfinite(sc.train_one_round(0)["train_loss"])
@@ -327,17 +327,26 @@ def test_pipelined_rounds_fedopt_subclass():
 
 
 def test_pipelined_rounds_reject_custom_round_subclasses():
-    """Subclasses with their own per-round procedure (SCAFFOLD's control
-    updates) must refuse the pipelined loop instead of silently running
-    plain FedAvg rounds."""
+    """Algorithms whose capability record has no fused step must refuse
+    the pipelined loop instead of silently running plain FedAvg rounds
+    (SCAFFOLD PIPELINES now — its record publishes the fused stateful
+    step; TurboAggregate's host-side MPC round is the real refusal)."""
     from fedml_tpu.algos.scaffold import ScaffoldAPI
+    from fedml_tpu.algos.turboaggregate import TurboAggregateAPI
 
     x, y, parts = _classification(8, 64)
     fed = build_federated_arrays(x, y, parts, batch_size=16)
+    turbo = TurboAggregateAPI(LogisticRegression(num_classes=2), fed,
+                              None, _cfg(8, 8))
+    with pytest.raises(NotImplementedError, match="MPC"):
+        turbo.train_rounds_pipelined(2)
     sc = ScaffoldAPI(LogisticRegression(num_classes=2), fed, None,
                      _cfg(8, 8))
-    with pytest.raises(NotImplementedError, match="customizes the round"):
-        sc.train_rounds_pipelined(2)
+    host = ScaffoldAPI(LogisticRegression(num_classes=2), fed, None,
+                       _cfg(8, 8))
+    la = [host.train_one_round(r)["train_loss"] for r in range(2)]
+    lb = sc.train_rounds_pipelined(2)
+    np.testing.assert_array_equal(la, lb)
 
 
 def test_sharded_scan_repeat_calls_continue_bit_equal():
