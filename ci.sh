@@ -117,6 +117,59 @@ with tempfile.TemporaryDirectory() as td:
 print("sharded directory smoke OK")
 PYEOF
 
+echo "== pod compute plane: host-grouped reduce on a forced 2x4 DCN mesh =="
+python - <<'PYEOF'
+import numpy as np
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.data.batching import build_federated_arrays
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.parallel.multihost import simulated_dcn_mesh
+
+# 16 learnable clients over a SIMULATED 2x4 DCN x ICI mesh (single
+# process, forced factorization): real training, mean bit-equality
+# group_reduce=True vs False (the hierarchical partial-sum program is
+# the mean path either way), median-of-host-medians in the clean
+# ballpark, and the O(G) traffic gauges live.
+rng = np.random.RandomState(0)
+n, per, d = 16, 32, 6
+w_true = rng.randn(d)
+x = rng.randn(n * per, d).astype(np.float32)
+y = (x @ w_true > 0).astype(np.int32)
+parts = {c: np.arange(c * per, (c + 1) * per) for c in range(n)}
+fed = build_federated_arrays(x, y, parts, batch_size=16)
+test = (x.reshape(-1, 16, d), y.reshape(-1, 16),
+        np.ones((n * per // 16, 16), np.float32))
+mesh = simulated_dcn_mesh(2, 4)
+mk = lambda **kw: FedAvgAPI(
+    LogisticRegression(num_classes=2), fed, test,
+    FedConfig(client_num_in_total=n, client_num_per_round=8,
+              comm_round=6, epochs=1, batch_size=16, lr=0.3,
+              frequency_of_the_test=1000, **kw), mesh=mesh)
+flat, grp = mk(), mk(group_reduce=True)
+for r in range(6):
+    flat.train_one_round(r)
+    grp.train_one_round(r)
+import jax
+for a, b in zip(jax.tree.leaves(flat.net.params),
+                jax.tree.leaves(grp.net.params)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+acc = float(np.asarray(grp.evaluate()["accuracy"]))
+med = mk(group_reduce=True, aggregator="coord_median")
+for r in range(6):
+    med.train_one_round(r)
+macc = float(np.asarray(med.evaluate()["accuracy"]))
+assert acc > 0.8, acc
+assert macc > acc - 0.15, (macc, acc)  # median-of-medians clean ballpark
+prof = grp.reduce_profile()
+assert prof["dcn_partials"] == 2  # G = hosts, not the 8-client cohort
+assert prof["dcn_rounds"] == 6
+print(f"pod reduce smoke OK: mean bit-equal, acc {acc:.2f}, "
+      f"median-of-host-medians {macc:.2f}, DCN partials/round "
+      f"{int(prof['dcn_partials'])} (G) vs flat "
+      f"{int(prof['dcn_flat_bytes_per_round'] // (prof['dcn_bytes_per_round'] // 2))} (C)")
+PYEOF
+
 echo "== fused donated round step + lane-fill compute layout =="
 python - <<'PYEOF'
 import jax, numpy as np

@@ -47,6 +47,16 @@ class CentralizedTrainer:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
+            from fedml_tpu.parallel.shard import mesh_dcn_axis
+
+            if mesh_dcn_axis(mesh):
+                # Batch-axis data parallelism has no client groups to
+                # pin per host; a hosts axis here would silently shard
+                # the batch over ICI only.
+                raise NotImplementedError(
+                    "CentralizedTrainer shards the BATCH axis and does "
+                    "not ride a DCN×ICI client mesh; pass a flat "
+                    "client_mesh")
             axis = mesh.axis_names[0]
             n = int(mesh.shape[axis])
             if cfg.batch_size % n:

@@ -53,8 +53,15 @@ class FedConfig:
     # group-composable aggregators (mean, coord_median, trimmed_mean)
     # aggregate shard-locally first, then across the G group partials —
     # the mesh collective shrinks from C client models to G ≪ C group
-    # partials (arXiv:1903.05133 shape). Mean keeps its bit-equal
-    # partial-sum psum fast path; non-composable aggregators (krum,
+    # partials (arXiv:1903.05133 shape). On a DCN×ICI pod mesh
+    # (parallel/multihost.dcn_client_mesh; the mesh carries a "hosts"
+    # axis) client groups are pinned PER HOST: stage 1 runs as an
+    # ICI-axis-only collective with zero DCN traffic and only
+    # G = n_hosts group partials + participation mass cross the DCN
+    # axis — O(G·model) inter-host bytes instead of the flat path's
+    # O(C·model) (docs/PLATFORMS.md "Multi-host"). Mean keeps its
+    # bit-equal partial-sum psum fast path (hierarchically associated
+    # on a pod mesh); non-composable aggregators (krum,
     # geometric_median) refuse this flag loudly and keep the exact
     # all_gather path. docs/EXECUTION.md "Scale tiers".
     group_reduce: bool = False
@@ -116,7 +123,23 @@ class FedConfig:
     # the LOGICAL reference shapes. Exact (fp32-bit-exact for the CIFAR
     # ResNet family, tested); supported model families only (refuses
     # loudly otherwise). A no-op when the policy pads nothing.
+    # "im2col" — conv lane shaping beyond s2d
+    # (parallel/layout.im2col_layout): the 5x5 stem conv is rephrased as
+    # patch extraction + a 1x1 conv, growing the MXU contraction dim
+    # from Cin to 25·Cin (CNNOriginalFedAvg only; ~1-ulp tolerance, the
+    # CNN family's documented class).
     compute_layout: str = "none"
+    # bf16 client-step compute (docs/EXECUTION.md "MFU playbook"):
+    # "fp32" (default), or "bf16" — the jitted client step's layer
+    # compute runs in bfloat16 (flax compute-dtype twin,
+    # parallel/layout.step_dtype_model) while the PARAM TREE, gradients,
+    # optimizer update, aggregation, and server carry all stay fp32.
+    # Eval always runs the fp32 model, so measured accuracy deltas are
+    # the training effect, not an eval artifact. Supported model
+    # families expose a `dtype` compute field; others refuse loudly.
+    # Composes with cfg.compute_layout (the pad-on-entry physical twin
+    # is cloned to the bf16 compute dtype).
+    client_step_dtype: str = "fp32"
     # Example-level DP-SGD on clients (new capability — the reference only
     # has server-side weak DP, robust_aggregation.py:49-53): per-example
     # gradient clipping at this L2 norm (0 disables) and Gaussian noise of

@@ -23,7 +23,14 @@ from fedml_tpu.algos.loop import FederatedLoop, eval_segments
 from fedml_tpu.core.robust_agg import make_aggregator
 from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.obs.sanitizer import planned_transfer
-from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
+from fedml_tpu.parallel.shard import (
+    client_axes,
+    client_axis,
+    client_shards,
+    make_sharded_round,
+    make_vmap_round,
+    mesh_dcn_axis,
+)
 from fedml_tpu.trainer.local import (
     make_client_optimizer,
     make_eval_fn,
@@ -210,7 +217,14 @@ class FedAvgAPI(FederatedLoop):
                 "side corruption drill, which needs adversary wiring "
                 "(per-round adversary masks); use FedAvgRobustAPI — on "
                 f"{type(self).__name__} the flag would be silently inert")
-        self.n_shards = 1 if mesh is None else int(mesh.shape[mesh.axis_names[0]])
+        self.n_shards = client_shards(mesh)
+        # Pod-scale reduction observability (docs/OBSERVABILITY.md): on
+        # a DCN×ICI mesh the O(G)-inter-host-traffic claim is an
+        # OBSERVABLE — per-round ctrl/ gauges of how many model-sized
+        # partials cross the DCN axis — not a comment. 0 = flat mesh /
+        # single device (no emission, no registry).
+        d = mesh_dcn_axis(mesh)
+        self._dcn_groups = int(mesh.shape[d]) if d else 0
         sample_x = (train_fed.example_input() if self._streaming
                     else np.asarray(train_fed.x[0, 0]))
         # Hook for models whose init input is NOT a data batch (FedGAN's
@@ -224,10 +238,10 @@ class FedAvgAPI(FederatedLoop):
         self._layout = None
         layout_cfg = getattr(cfg, "compute_layout", "none") or "none"
         if layout_cfg != "none":
-            if layout_cfg != "auto":
+            if layout_cfg not in ("auto", "im2col"):
                 raise ValueError(
                     f"cfg.compute_layout={layout_cfg!r}: expected "
-                    "'none' or 'auto'")
+                    "'none', 'auto' or 'im2col'")
             if type(self)._build_local_train \
                     is not FedAvgAPI._build_local_train:
                 raise NotImplementedError(
@@ -250,12 +264,43 @@ class FedAvgAPI(FederatedLoop):
                     "draw shapes follow the physical layout, which "
                     "breaks the padded-vs-logical exactness contract — "
                     "run DP-SGD at the logical layout")
-            from fedml_tpu.parallel.layout import compute_layout
+            from fedml_tpu.parallel.layout import (compute_layout,
+                                                   im2col_layout)
 
-            layout = compute_layout(model, sample_x)
+            layout = (im2col_layout(model, sample_x)
+                      if layout_cfg == "im2col"
+                      else compute_layout(model, sample_x))
             if not layout.is_identity:
                 self._layout = layout
                 self._phys_fns = model_fns(layout.physical_model)
+        # bf16 client-step compute (parallel/layout.step_dtype_model):
+        # the TRAINER's apply computes in bf16; params/grads/optimizer/
+        # aggregation/eval all stay fp32. Resolved before set_client_lr
+        # so _build_local_train sees it.
+        self._step_dtype = None
+        sd = getattr(cfg, "client_step_dtype", "fp32") or "fp32"
+        if sd not in ("fp32", "bf16"):
+            raise ValueError(
+                f"cfg.client_step_dtype={sd!r}: expected 'fp32' or 'bf16'")
+        if sd == "bf16":
+            if type(self)._build_local_train \
+                    is not FedAvgAPI._build_local_train:
+                raise NotImplementedError(
+                    f"{type(self).__name__} builds its own local trainer; "
+                    "cfg.client_step_dtype wraps the shared "
+                    "_build_local_train only (the flag would otherwise "
+                    "be silently inert)")
+            from fedml_tpu.parallel.layout import step_dtype_model
+
+            # Refusal happens here (construction), not first trace: the
+            # twin builder raises for families without a compute-dtype
+            # field. Composed with the layout: the PHYSICAL twin is the
+            # one the trainer applies, so it is the one cloned to bf16.
+            base = (self._layout.physical_model if self._layout is not None
+                    else model)
+            self._step_fns = model_fns(
+                step_dtype_model(base, jnp.bfloat16))
+            self._step_dtype = jnp.bfloat16
         self._client_lr = None
         self._fused_step_fn = None
         self.set_client_lr(cfg.lr)
@@ -369,7 +414,7 @@ class FedAvgAPI(FederatedLoop):
 
     def _make_sharded_round(self, local_train, mesh, transform, guard):
         return make_sharded_round(
-            local_train, mesh, mesh.axis_names[0],
+            local_train, mesh, client_axis(mesh),
             client_transform=transform, nan_guard=guard,
             with_client_losses=self.cfg.client_selection == "oort",
             aggregator=self._round_aggregator(),
@@ -394,6 +439,12 @@ class FedAvgAPI(FederatedLoop):
         return None
 
     def _build_local_train(self, optimizer, loss_fn):
+        # bf16 client step: the trainer applies the compute-dtype twin
+        # (of the physical model when a layout is active — the two
+        # levers compose); everything else in this method is unchanged
+        # because the twin's PARAM TREE is the fp32 one.
+        apply = (self._step_fns.apply if self._step_dtype is not None
+                 else None)
         if self._layout is not None:
             # Lane-fill layout: the trainer runs the PHYSICAL twin's
             # apply; the wrapper pads the incoming logical net and
@@ -403,10 +454,11 @@ class FedAvgAPI(FederatedLoop):
             from fedml_tpu.parallel.layout import wrap_local_train
 
             inner = make_local_train_fn_from_cfg(
-                self._phys_fns.apply, optimizer, self.cfg, loss_fn)
+                apply or self._phys_fns.apply, optimizer, self.cfg,
+                loss_fn)
             return wrap_local_train(inner, self._layout)
-        return make_local_train_fn_from_cfg(self.fns.apply, optimizer,
-                                            self.cfg, loss_fn)
+        return make_local_train_fn_from_cfg(apply or self.fns.apply,
+                                            optimizer, self.cfg, loss_fn)
 
     def _server_update(self, old_net, avg_net):
         """FedAvg: the new global model is the client average."""
@@ -732,11 +784,16 @@ class FedAvgAPI(FederatedLoop):
             "compress": (self.cfg.compress
                          if self.cfg.compress != "none" else None),
             # The corrected-SGD algorithms build their trainers outside
-            # _build_local_train, where the lane-fill layout is wired.
+            # _build_local_train, where the lane-fill layout and the
+            # bf16 step dtype are wired.
             "compute_layout": (
                 getattr(self.cfg, "compute_layout", "none")
                 if getattr(self.cfg, "compute_layout", "none") != "none"
                 else None),
+            "client_step_dtype": (
+                getattr(self.cfg, "client_step_dtype", "fp32")
+                if getattr(self.cfg, "client_step_dtype", "fp32")
+                not in ("fp32", "") else None),
         }
         bad = [k for k, v in unsupported.items() if v]
         if self._nan_guard:
@@ -756,6 +813,55 @@ class FedAvgAPI(FederatedLoop):
         from fedml_tpu.data.batching import gather_clients
 
         return gather_clients(self.train_fed, jnp.asarray(idx))
+
+    # --- pod-reduce observability (DCN×ICI mesh only) --------------------
+    def _emit_reduce_obs(self, n_rounds: int = 1) -> None:
+        """Per-round ``ctrl/`` gauges for the inter-host reduction: how
+        many model-sized partials crossed the DCN axis this round
+        (``dcn_partials``) and the byte payload they carry
+        (``dcn_partials × payload_nbytes``). With ``group_reduce`` (or
+        the mean fast path, which is hierarchical by construction) the
+        partial count is G = n_hosts — INDEPENDENT of the cohort size;
+        the flat non-mean ``all_gather`` fallback ships the whole padded
+        cohort, C partials. ``dcn_flat_bytes_per_round`` is the flat
+        fallback's cost for the same round — the ruler the O(G) claim is
+        measured against. Also mirrors the numbers onto the active
+        ``SpanTracer`` as a ``reduce.dcn`` instant event (null-tracer
+        cheap when tracing is off)."""
+        if not self._dcn_groups:
+            return
+        reg = getattr(self, "_reduce_registry", None)
+        if reg is None:
+            from fedml_tpu.obs.registry import (MetricsRegistry,
+                                                payload_nbytes)
+
+            reg = self._reduce_registry = MetricsRegistry()
+            self._reduce_payload = payload_nbytes(self.net)
+            self._g_dcn_parts = reg.gauge("dcn_partials")
+            self._g_dcn_bytes = reg.gauge("dcn_bytes_per_round")
+            self._g_dcn_flat = reg.gauge("dcn_flat_bytes_per_round")
+            self._c_dcn_rounds = reg.counter("dcn_rounds")
+        grouped = (self._aggregator.is_mean or self._group_reduce)
+        cpr = min(self.cfg.client_num_per_round,
+                  self.cfg.client_num_in_total)
+        flat_parts = -(-cpr // self.n_shards) * self.n_shards  # padded C
+        parts = self._dcn_groups if grouped else flat_parts
+        self._g_dcn_parts.set(parts)
+        self._g_dcn_bytes.set(parts * self._reduce_payload)
+        self._g_dcn_flat.set(flat_parts * self._reduce_payload)
+        self._c_dcn_rounds.inc(n_rounds)
+        from fedml_tpu.obs import trace as obs_trace
+
+        obs_trace.active().instant(
+            "reduce.dcn", cat="reduce", partials=parts,
+            nbytes=parts * self._reduce_payload, groups=self._dcn_groups,
+            rounds=n_rounds)
+
+    def reduce_profile(self) -> Dict[str, float]:
+        """Snapshot of the pod-reduce gauges (empty off a DCN mesh, or
+        before the first round emitted)."""
+        reg = getattr(self, "_reduce_registry", None)
+        return reg.snapshot() if reg is not None else {}
 
     # --- capability record (algos/capability.py) ------------------------
     def capability(self):
@@ -875,6 +981,7 @@ class FedAvgAPI(FederatedLoop):
                 self.net, extra, sub.x, sub.y, sub.mask, weights, rnd_rng,
                 *aux)
         self._window_carry_commit(extra)
+        self._emit_reduce_obs()
         return loss
 
     def train_one_round(self, round_idx: int) -> Dict[str, float]:
@@ -891,6 +998,7 @@ class FedAvgAPI(FederatedLoop):
                 refusal(type(self), "train_one_round"))
         avg, loss = self.run_round(round_idx)
         self.net = self._server_update(self.net, avg)
+        self._emit_reduce_obs()
         if self.cfg.client_selection == "oort":
             # Memoized — returns the cohort this round actually trained.
             idx, wmask = self.sample_round(round_idx)
@@ -946,6 +1054,7 @@ class FedAvgAPI(FederatedLoop):
             else:
                 avg, loss = self.run_round(r)
                 self.net = self._server_update(self.net, avg)
+                self._emit_reduce_obs()
                 losses.append(loss)
         return [float(l) for l in losses]
 
@@ -1035,7 +1144,7 @@ class FedAvgAPI(FederatedLoop):
             from fedml_tpu.parallel.shard import window_put
 
             put = self._window_put = window_put(
-                self.mesh, self.mesh.axis_names[0])
+                self.mesh, client_axis(self.mesh))
         return put
 
     def _build_window_scan(self):
@@ -1212,6 +1321,7 @@ class FedAvgAPI(FederatedLoop):
                     elif self.window_protocol == "round":
                         avg, loss = self.run_round(r)
                         self.net = self._server_update(self.net, avg)
+                        self._emit_reduce_obs()
                         losses.append(loss)
                     else:
                         # "custom" without a fused step (scan-only
@@ -1256,6 +1366,7 @@ class FedAvgAPI(FederatedLoop):
             # checkpoint at a window boundary, eval in train_windowed)
             # must read the scanned-out state.
             self._window_carry_commit(extra)
+            self._emit_reduce_obs(n_rounds=length)
             losses.extend(list(span_losses))
         # ONE end-of-loop host sync for the losses — planned by design
         # (train_rounds_pipelined contract), so mark it for sanitized()
@@ -1397,7 +1508,7 @@ class FedAvgAPI(FederatedLoop):
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
 
-                shard = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+                shard = NamedSharding(self.mesh, P(client_axes(self.mesh)))
                 fed = jax.tree.map(lambda a: jax.device_put(a, shard), fed)
                 self.train_fed = self._mesh_pinned_fed = fed
             else:

@@ -1185,6 +1185,20 @@ def build_federation_setup(model, train_fed: FederatedArrays, test_global,
             f"cfg.compute_layout={cfg.compute_layout!r} is a simulator-"
             "tier capability (FedAvgAPI family); the distributed "
             "message-passing tiers do not wire it yet")
+    if getattr(cfg, "client_step_dtype", "fp32") not in ("fp32", ""):
+        # Same convention for the bf16 client step: this tier's local
+        # trainer is built below from the fp32 fns.
+        raise NotImplementedError(
+            f"cfg.client_step_dtype={cfg.client_step_dtype!r} is a "
+            "simulator-tier capability (FedAvgAPI family); the "
+            "distributed message-passing tiers train fp32")
+    if getattr(cfg, "group_reduce", False):
+        # The message-passing servers aggregate on host (per-upload
+        # fold); there is no mesh collective to shrink.
+        raise NotImplementedError(
+            "cfg.group_reduce shrinks the client-MESH collective "
+            "(parallel/shard.py); the message-passing tiers aggregate "
+            "on the server host — drop the flag")
     fns = model_fns(model)
     sample_x = jnp.zeros((1,) + train_fed.x.shape[3:], train_fed.x.dtype)
     net0 = fns.init(jax.random.PRNGKey(cfg.seed), sample_x)

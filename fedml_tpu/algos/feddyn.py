@@ -152,7 +152,8 @@ class FedDynAPI(FedAvgAPI):
 
         from fedml_tpu.parallel.shard import make_stateful_client_round
 
-        axis = None if self.mesh is None else self.mesh.axis_names[0]
+        from fedml_tpu.parallel.shard import client_axis
+        axis = None if self.mesh is None else client_axis(self.mesh)
         round_fn = make_stateful_client_round(
             body, self.mesh, axis or "clients")
         self._feddyn_jit = jax.jit(round_fn)

@@ -108,11 +108,17 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
                             agg, self.net)
 
     def train_one_round(self, round_idx: int):
+        from fedml_tpu.obs import trace as obs_trace
+        from fedml_tpu.obs.registry import payload_nbytes
+
+        tr = obs_trace.active()
+        traced = tr is not obs_trace.NULL
         idx, wmask = self.sample_round(round_idx)
         idx = idx[np.asarray(wmask) > 0]  # grouping handles padding itself
         group_nets, group_weights, losses = [], [], []
         # Sparse: only groups that sampled clients this round train and
         # enter the global reduction.
+        ck = obs_trace.corr(round=round_idx)
         for g in np.unique(self.group_ids[idx]):
             g_idx = idx[self.group_ids[idx] == g]
             # Pad to a power-of-two multiple of n_shards: bounds the number
@@ -125,12 +131,22 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
             sub = self._group_cohort(g_idx_p)
             weights = sub.counts.astype(jnp.float32) * jnp.asarray(g_mask)
             net_g = self.net
-            for _ in range(self.cfg.group_comm_round):
-                # fedlint: disable=R1(deliberate round-order chain: group sub-rounds consume the same stream the flat host loop would, in round order; prefix-stable in the round count)
-                self.rng, rnd_rng = jax.random.split(self.rng)
-                net_g, loss = self.round_fn(
-                    net_g, sub.x, sub.y, sub.mask, weights, weights, rnd_rng
-                )
+            # Stage-1 span: this group's within-group training +
+            # aggregation — the host-side twin of the mesh tier's
+            # ICI-local stage. Only fenced (block_until_ready) when a
+            # tracer is installed: honest span ends cost a device sync
+            # that the traced-off path must not pay.
+            with tr.span("reduce.stage1", cat="reduce", corr=ck,
+                         group=int(g), clients=int(len(g_idx))):
+                for _ in range(self.cfg.group_comm_round):
+                    # fedlint: disable=R1(deliberate round-order chain: group sub-rounds consume the same stream the flat host loop would, in round order; prefix-stable in the round count)
+                    self.rng, rnd_rng = jax.random.split(self.rng)
+                    net_g, loss = self.round_fn(
+                        net_g, sub.x, sub.y, sub.mask, weights, weights,
+                        rnd_rng
+                    )
+                if traced:
+                    jax.block_until_ready(net_g)
             group_nets.append(net_g)
             group_weights.append(float(np.asarray(weights).sum()))
             losses.append(float(loss))
@@ -139,6 +155,15 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
             # keep the previous global model (a zero-total reduction
             # would zero or inf-poison the params).
             return {"round": round_idx, "train_loss": 0.0}
-        self.net = self._global_reduce(group_nets, group_weights)
+        # Stage-2 span: the sparse global step over the round's G group
+        # partials — the bytes that would cross DCN in a pod deployment
+        # (G × payload, the O(G)-traffic observable).
+        with tr.span("reduce.stage2", cat="reduce", corr=ck,
+                     groups=len(group_nets),
+                     nbytes=(len(group_nets) * payload_nbytes(self.net)
+                             if traced else 0)):
+            self.net = self._global_reduce(group_nets, group_weights)
+            if traced:
+                jax.block_until_ready(self.net)
         w = np.asarray(group_weights) / max(sum(group_weights), 1e-12)
         return {"round": round_idx, "train_loss": float((w * np.asarray(losses)).sum())}

@@ -38,7 +38,8 @@ from jax.sharding import PartitionSpec as P
 from fedml_tpu.parallel.compat import shard_map
 
 from fedml_tpu.algos.fedavg import FedAvgAPI
-from fedml_tpu.parallel.shard import client_rngs, run_clients_guarded
+from fedml_tpu.parallel.shard import (client_axis, client_rngs,
+                                      run_clients_guarded)
 from fedml_tpu.trainer.local import NetState
 
 
@@ -158,21 +159,26 @@ def make_qffl_sharded_round(local_train, q: float, lr: float, apply_fn,
     reductions (Σ h_k) and the per-leaf numerators (Σ F_k^q Δ_k) become
     psums over ICI, so the fair update is exact regardless of how clients
     land on shards (mirrors make_sharded_round's weighted mean)."""
+    from fedml_tpu.parallel.shard import _psum_hier, client_axes
+
     body = _make_qffl_body(local_train, q, 1.0 / lr, apply_fn, loss_fn,
                            client_transform, nan_guard)
+    axes = client_axes(mesh, axis)
+    cs = P(axes)
+    idx_ax = axes if len(axes) > 1 else axis
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+        in_specs=(P(), cs, cs, cs, cs, cs, P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
     def round_fn(net, x, y, mask, weights, loss_weights, rng):
-        shard_idx = jax.lax.axis_index(axis)
+        shard_idx = jax.lax.axis_index(idx_ax)
         rngs = client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
         return body(net, x, y, mask, weights, loss_weights, rngs,
-                    cross=partial(jax.lax.psum, axis_name=axis))
+                    cross=lambda v: _psum_hier(v, axes))
 
     return round_fn
 
@@ -198,5 +204,5 @@ class QFedAvgAPI(FedAvgAPI):
     def _make_sharded_round(self, local_train, mesh, transform, guard):
         return make_qffl_sharded_round(
             local_train, self.q, self._client_lr, self.fns.apply,
-            self._loss_fn, mesh, mesh.axis_names[0],
+            self._loss_fn, mesh, client_axis(mesh),
             client_transform=transform, nan_guard=guard)

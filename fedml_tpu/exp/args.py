@@ -161,7 +161,30 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "none | auto (pad channel dims to MXU lane/"
                         "sublane multiples inside the jitted step; "
                         "logical shapes everywhere else — "
-                        "docs/EXECUTION.md MFU playbook)")
+                        "docs/EXECUTION.md MFU playbook) | im2col "
+                        "(rephrase the 5x5 stem conv as patches + a 1x1 "
+                        "conv — conv lane shaping beyond s2d, "
+                        "CNNOriginalFedAvg only)")
+    p.add_argument("--client_step_dtype", type=str, default="fp32",
+                   help="client-step COMPUTE dtype: fp32 (default) | "
+                        "bf16 — layer compute in bfloat16 inside the "
+                        "jitted client step; params, gradients, "
+                        "optimizer, aggregation and server carry stay "
+                        "fp32 (docs/EXECUTION.md MFU playbook)")
+    p.add_argument("--group_reduce", action="store_true",
+                   help="hierarchical sparse reduction on a client mesh "
+                        "(cfg.group_reduce): group-composable "
+                        "aggregators aggregate per shard — per HOST on "
+                        "a --dcn_hosts pod mesh, ICI-only stage 1 — "
+                        "then across the G group partials; "
+                        "non-composable aggregators refuse loudly")
+    p.add_argument("--dcn_hosts", type=int, default=0,
+                   help="shard clients over a DCN×ICI pod mesh: "
+                        "num_devices splits as dcn_hosts × "
+                        "(num_devices/dcn_hosts) with client groups "
+                        "pinned per host (hierarchical group reduction, "
+                        "docs/PLATFORMS.md Multi-host; single-process "
+                        "runs force the factorization). 0 = flat mesh")
     p.add_argument("--eval_on_clients", action="store_true",
                    help="per-client eval of the global model each eval "
                         "round (reference _local_test_on_all_clients "
@@ -246,6 +269,28 @@ def reject_async_tier_flags(args, algorithm: str, *,
             "main_extra) — the flag would be silently inert here")
 
 
+def reject_pod_plane_flags(args, algorithm: str) -> None:
+    """Refuse the pod-compute-plane knobs for runners that never read
+    them (the PR 4 flag-rejection convention): the bf16 client step and
+    the DCN×ICI group reduction ride the FedAvg family's shared round
+    builders (exp/run.py); a specialty loop that silently trains fp32
+    under ``--client_step_dtype bf16``, or flat under ``--group_reduce``,
+    would report the baseline as the optimized arm."""
+    bad = []
+    if getattr(args, "client_step_dtype", "fp32") not in ("fp32", ""):
+        bad.append(f"--client_step_dtype {args.client_step_dtype}")
+    if getattr(args, "group_reduce", False):
+        bad.append("--group_reduce")
+    if getattr(args, "dcn_hosts", 0):
+        bad.append(f"--dcn_hosts {args.dcn_hosts}")
+    if bad:
+        raise SystemExit(
+            f"{algorithm} does not support {', '.join(bad)}: the pod "
+            "compute plane (bf16 client step, DCN×ICI group reduction) "
+            "rides the FedAvg family's shared rounds only (the flag "
+            "would be silently inert here)")
+
+
 def reject_ingest_pool_flag(args, algorithm: str) -> None:
     """Refuse ``--ingest_workers`` for runners with no message-passing
     server dispatch thread to parallelize (the PR 4/6 flag-rejection
@@ -315,6 +360,8 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         dp_clip=args.dp_clip,
         dp_noise_multiplier=args.dp_noise_multiplier,
         compute_layout=args.compute_layout,
+        client_step_dtype=args.client_step_dtype,
+        group_reduce=bool(getattr(args, "group_reduce", False)),
         client_selection=args.client_selection,
         pow_d_candidates=args.pow_d_candidates,
         oort_epsilon=args.oort_epsilon,

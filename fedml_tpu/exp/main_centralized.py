@@ -25,8 +25,14 @@ def run_centralized(args):
     from functools import partial
 
     from fedml_tpu.algos.centralized import CentralizedTrainer
-    from fedml_tpu.exp.args import config_from_args
+    from fedml_tpu.exp.args import config_from_args, reject_pod_plane_flags
     from fedml_tpu.exp.run import SEQ_DATASETS
+
+    # The pooled baseline has no client step and no client axis — every
+    # pod compute-plane knob (bf16 client step, DCN group reduce, the
+    # mesh factorization) would be silently inert here, skewing any A/B
+    # that uses this anchor.
+    reject_pod_plane_flags(args, "the centralized baseline")
     from fedml_tpu.exp.setup import (
         build_mesh,
         create_model_for,

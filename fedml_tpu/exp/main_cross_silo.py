@@ -100,7 +100,8 @@ def main(argv=None):
             "feature; the cross-silo server samples uniformly (it has no "
             "access to silo-local losses before assignment)")
     from fedml_tpu.exp.args import (reject_async_tier_flags,
-                                    reject_fedavg_family_flags)
+                                    reject_fedavg_family_flags,
+                                    reject_pod_plane_flags)
 
     # The cross-silo server reduces with FedAVGAggregator-parity math —
     # the simulator's pluggable aggregator/corruption drill would be
@@ -108,6 +109,11 @@ def main(argv=None):
     # stream for the async-tier knobs to act on.
     reject_fedavg_family_flags(args, "the cross-silo pipeline")
     reject_async_tier_flags(args, "the cross-silo pipeline")
+    # Silos shard by RANK, not by mesh (need_mesh=False below), and the
+    # silo trainers are built directly from fns.apply — none of the pod
+    # compute-plane knobs (bf16 client step, DCN group reduce, the mesh
+    # factorization) reach this path.
+    reject_pod_plane_flags(args, "the cross-silo pipeline")
 
     logging.basicConfig(
         level=logging.INFO,

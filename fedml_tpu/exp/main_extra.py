@@ -20,7 +20,8 @@ import numpy as np
 from fedml_tpu.exp.args import (add_args, config_from_args,
                                 reject_async_tier_flags,
                                 reject_fedavg_family_flags,
-                                reject_ingest_pool_flag)
+                                reject_ingest_pool_flag,
+                                reject_pod_plane_flags)
 from fedml_tpu.exp.setup import global_test_batches, load_data
 from fedml_tpu.data.loaders import to_federated_arrays
 
@@ -269,6 +270,21 @@ def main(argv=None):
         # The parallel ingest pool likewise rides only the message-
         # passing server tiers (FedAsync/FedBuff here; cross-silo CLI).
         reject_ingest_pool_flag(args, args.algorithm)
+    # The pod compute plane (bf16 client step, DCN group reduction)
+    # rides the FedAvg family's shared rounds; every specialty loop
+    # refuses here. FedAsync/FedBuff refuse client_step_dtype /
+    # group_reduce via the shared distributed-setup CFG guard, but
+    # --dcn_hosts never reaches a cfg field (it is consumed by the
+    # mesh-building setup these runners skip — the same hole
+    # main_cross_silo special-cases), so it must refuse at the driver.
+    if args.algorithm in ("FedAsync", "FedBuff"):
+        if getattr(args, "dcn_hosts", 0):
+            raise SystemExit(
+                f"{args.algorithm} does not support --dcn_hosts "
+                f"{args.dcn_hosts}: the async tiers shard by rank, not "
+                "over a device mesh (the flag would be silently inert)")
+    else:
+        reject_pod_plane_flags(args, args.algorithm)
     logging.basicConfig(level=logging.INFO,
                         format=f"[{args.algorithm} %(asctime)s] %(message)s")
     history = RUNNERS[args.algorithm](args)

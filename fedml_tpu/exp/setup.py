@@ -78,9 +78,21 @@ def global_train_batches(fed: FederatedDataset, batch_size: int):
     return _pooled_batches(fed.train_data_global, batch_size)
 
 
-def build_mesh(num_devices: int):
+def build_mesh(num_devices: int, dcn_hosts: int = 0):
     if not num_devices:
+        if dcn_hosts:
+            raise ValueError(
+                "--dcn_hosts needs --num_devices (the pod mesh factors "
+                "num_devices as dcn_hosts x chips-per-host)")
         return None
+    if dcn_hosts:
+        if num_devices % dcn_hosts:
+            raise ValueError(
+                f"--num_devices {num_devices} does not factor over "
+                f"--dcn_hosts {dcn_hosts}")
+        from fedml_tpu.parallel.multihost import dcn_client_mesh
+
+        return dcn_client_mesh(dcn_hosts, num_devices // dcn_hosts)
     from fedml_tpu.parallel.mesh import client_mesh
 
     return client_mesh(num_devices)
@@ -103,5 +115,6 @@ def setup_standard(args, need_test: bool = True, need_mesh: bool = True):
     # FedAVGAggregator.py:92).
     cfg.client_num_per_round = min(cfg.client_num_per_round, fed.client_num)
     cfg.client_num_in_total = fed.client_num
-    mesh = build_mesh(args.num_devices) if need_mesh else None
+    mesh = (build_mesh(args.num_devices, getattr(args, "dcn_hosts", 0))
+            if need_mesh else None)
     return fed, arrays, test, model, cfg, mesh

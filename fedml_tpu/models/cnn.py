@@ -41,20 +41,37 @@ class CNNOriginalFedAvg(nn.Module):
     stem: str = "conv"  # "conv" (reference) | "s2d" (lane-fill variant)
     widths: Any = None  # Optional[(c1, c2)] conv-width override
     hidden: int = 512
+    dtype: Any = None  # compute dtype (params stay float32)
+    #: im2col-rephrased stem (parallel/layout.im2col_layout builds this
+    #: physical twin): the 5x5 stem conv becomes patch extraction + a
+    #: 1x1 conv whose contraction dim is k²·Cin (25 on the reference
+    #: stem) — the MXU sees one dense GEMM instead of a 1-channel conv.
+    #: Algebraically the SAME dot per output position; the Conv_0 kernel
+    #: is the (c, kh, kw)-flattened reshape of the logical 5x5 kernel.
+    im2col: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = _stem(x, self.stem)
         c1, c2 = self.widths or (32, 64)
-        x = nn.Conv(c1, (5, 5), padding="SAME")(x)
+        if self.im2col:
+            from jax import lax
+
+            x = lax.conv_general_dilated_patches(
+                x.astype(self.dtype or x.dtype), (5, 5), (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = nn.Conv(c1, (1, 1), dtype=self.dtype)(x)
+        else:
+            x = nn.Conv(c1, (5, 5), padding="SAME", dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(c2, (5, 5), padding="SAME")(x)
+        x = nn.Conv(c2, (5, 5), padding="SAME", dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(self.hidden)(x))
-        return nn.Dense(10 if self.only_digits else self.num_classes)(x)
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        return nn.Dense(10 if self.only_digits else self.num_classes,
+                        dtype=self.dtype)(x)
 
 
 class CNNDropOut(nn.Module):
@@ -62,19 +79,23 @@ class CNNDropOut(nn.Module):
     only_digits: bool = False
     stem: str = "conv"
     widths: Any = None  # Optional[(c1, c2)]
+    dtype: Any = None  # compute dtype (params stay float32)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = _stem(x, self.stem)
         c1, c2 = self.widths or (32, 64)
-        x = nn.relu(nn.Conv(c1, (3, 3), padding="VALID")(x))
-        x = nn.relu(nn.Conv(c2, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(c1, (3, 3), padding="VALID",
+                            dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(c2, (3, 3), padding="VALID",
+                            dtype=self.dtype)(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.Dropout(0.25, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(128)(x))
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
         x = nn.Dropout(0.5, deterministic=not train)(x)
-        return nn.Dense(10 if self.only_digits else self.num_classes)(x)
+        return nn.Dense(10 if self.only_digits else self.num_classes,
+                        dtype=self.dtype)(x)
 
 
 @register_model("cnn")

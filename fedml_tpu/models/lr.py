@@ -6,6 +6,8 @@ logits and the loss applies softmax, which is the numerically sane form)."""
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 
 from fedml_tpu.models.registry import register_model
@@ -13,11 +15,13 @@ from fedml_tpu.models.registry import register_model
 
 class LogisticRegression(nn.Module):
     num_classes: int = 10
+    dtype: Any = None  # compute dtype (params stay float32)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.reshape((x.shape[0], -1))
-        return nn.Dense(self.num_classes, name="linear")(x)
+        return nn.Dense(self.num_classes, name="linear",
+                        dtype=self.dtype)(x)
 
 
 @register_model("lr")

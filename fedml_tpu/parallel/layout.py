@@ -311,6 +311,77 @@ def compute_layout(model, sample_x, *, lane: int = 128, sublane: int = 8,
     return layout
 
 
+def step_dtype_model(model, dtype):
+    """COMPUTE-dtype twin for the bf16 client step
+    (``cfg.client_step_dtype="bf16"``): a clone of ``model`` whose
+    layers compute in ``dtype`` while the PARAM TREE stays float32
+    (flax's ``dtype=`` casts inputs and params at each layer's compute;
+    ``param_dtype`` is untouched) — so the jitted client step's matmuls
+    run at bf16 MXU rate while gradients, the optimizer update, the
+    aggregation, and the server carry all stay fp32. The param tree is
+    structurally identical to the logical model's, so everything above
+    the client step (checkpoints, the wire, robust aggregators, the
+    compute-layout pad/unpad) is untouched.
+
+    Requires the model family to expose a ``dtype`` compute field
+    (CifarResNet, CNNOriginalFedAvg/CNNDropOut, LogisticRegression);
+    refuses loudly otherwise — silently training fp32 under a bf16 flag
+    is exactly the drift the loud-refusal convention exists for."""
+    fields = getattr(type(model), "__dataclass_fields__", {})
+    if "dtype" not in fields:
+        raise NotImplementedError(
+            f"client_step_dtype: {type(model).__name__} has no compute-"
+            "dtype field; supported families expose `dtype` "
+            "(CifarResNet, CNNOriginalFedAvg, CNNDropOut, "
+            "LogisticRegression)")
+    return model.clone(dtype=dtype)
+
+
+def im2col_layout(model, sample_x):
+    """Conv lane shaping beyond s2d (docs/EXECUTION.md "MFU playbook"):
+    a :class:`ComputeLayout` whose physical twin rephrases the 5x5 STEM
+    conv as patch extraction + a 1x1 conv — the MXU contraction dim
+    grows from Cin (1, or 4 under s2d) to k²·Cin (25/100), one dense
+    GEMM instead of a thin-channel conv. Algebraically the same dot per
+    output position (the kernel mapping is a pure transpose+reshape in
+    ``conv_general_dilated_patches``'s (c, kh, kw) channel order, exact
+    both ways); XLA may associate the 25-element reduction differently
+    than the conv lowering, so the step carries the CNN family's
+    documented ~1-ulp tolerance rather than the ResNet family's
+    bit-exactness. Widths are NOT padded here — compose measurement-wise
+    with ``compute_layout`` via the bench A/B, not structurally.
+
+    Supported: ``CNNOriginalFedAvg`` (stem "conv" or "s2d"). Dropout
+    models refuse for the usual mask-shape reason; other families have
+    no 5x5 stem to rephrase."""
+    from fedml_tpu.models.cnn import CNNOriginalFedAvg
+
+    if not isinstance(model, CNNOriginalFedAvg):
+        raise NotImplementedError(
+            f"im2col_layout has no stem-rephrasing twin for "
+            f"{type(model).__name__}; supported: CNNOriginalFedAvg")
+    if model.im2col:
+        raise ValueError("model is already an im2col physical twin")
+    c1 = (model.widths or (32, 64))[0]
+    cin = 4 if model.stem == "s2d" else 1
+    k = 5
+
+    def pad_stem(leaf):  # [5, 5, cin, c1] -> [1, 1, cin*25, c1]
+        return jnp.transpose(leaf, (2, 0, 1, 3)).reshape(
+            1, 1, cin * k * k, c1)
+
+    def unpad_stem(leaf):
+        return jnp.transpose(
+            leaf.reshape(cin, k, k, c1), (1, 2, 0, 3))
+
+    twin = model.clone(im2col=True)
+    layout = ComputeLayout(
+        logical_model=model, physical_model=twin,
+        overrides={".params/Conv_0/kernel": (pad_stem, unpad_stem)})
+    layout._build_specs(sample_x)
+    return layout
+
+
 def wrap_local_train(local_train, layout: ComputeLayout):
     """Wrap a PHYSICAL-model local trainer into the logical-shape
     contract: ``wrapped(net_logical, x, y, mask, rng) -> (net_logical',

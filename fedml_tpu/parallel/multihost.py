@@ -86,6 +86,45 @@ def hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int] = (),
     return Mesh(devices, axis_names)
 
 
+def dcn_client_mesh(n_hosts: int, per_host: int,
+                    axis: str = "clients") -> Mesh:
+    """The pod-scale CLIENT mesh: a ``("hosts", axis)`` DCN×ICI mesh
+    whose ``"hosts"`` axis (the :data:`fedml_tpu.parallel.shard.DCN_AXIS`
+    convention) is the inter-host DCN dimension and whose client axis
+    rides ICI within each host. Round builders that see this mesh pin
+    client groups per host: stage-1 aggregation runs as an ICI-axis-only
+    collective and only G = ``n_hosts`` group partials cross DCN
+    (``make_sharded_round``'s hierarchical reduction, docs/PLATFORMS.md
+    "Multi-host").
+
+    Under ``jax.distributed`` this is ``hybrid_mesh`` with the DCN
+    factor on the hosts axis; in a SINGLE process it degrades to
+    :func:`simulated_dcn_mesh` — the forced factorization the tests and
+    the ci smoke drive, where the "hosts" boundary is simulated but the
+    reduction runs the exact pod program."""
+    if jax.process_count() > 1:
+        return hybrid_mesh((1, per_host), (n_hosts, 1), ("hosts", axis))
+    return simulated_dcn_mesh(n_hosts, per_host, axis)
+
+
+def simulated_dcn_mesh(n_hosts: int, per_host: int,
+                       axis: str = "clients") -> Mesh:
+    """Single-process FORCED DCN×ICI factorization: ``n_hosts × per_host``
+    local devices reshaped into a ``("hosts", axis)`` mesh. No process
+    boundary exists — the point is that the compiled reduction is the
+    pod-shaped program (ICI-axis stage 1, G-partial stage 2), so its
+    semantics (bit-equality, group statistics, refusals) are testable on
+    one box."""
+    n = n_hosts * per_host
+    devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(
+            f"simulated_dcn_mesh({n_hosts}x{per_host}) needs {n} devices, "
+            f"have {len(devices)}")
+    return Mesh(np.array(devices[:n]).reshape(n_hosts, per_host),
+                ("hosts", axis))
+
+
 def process_local_client_slice(n_clients: int) -> slice:
     """Which contiguous client range this host owns when client data is
     loaded per-host (each host loads only its shard — unlike the reference,

@@ -16,10 +16,72 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 from fedml_tpu.parallel.compat import shard_map
 
 from fedml_tpu.core.tree import tree_weighted_mean
+
+#: Mesh-axis naming convention for the pod-scale compute plane
+#: (parallel/multihost.py builds these meshes): a mesh whose FIRST axis
+#: is named ``"hosts"`` carries a DCN×ICI factorization — that axis is
+#: the slow inter-host (DCN) dimension and the client axis that follows
+#: is intra-host ICI. The client dimension of every round operand is
+#: then sharded over BOTH axes (hosts-major, so global client-slot order
+#: is host order), and the reductions below keep their collectives on
+#: the ICI axis wherever the math allows, crossing DCN only with
+#: host-level partials (arXiv:1903.05133's sparse global reduction).
+DCN_AXIS = "hosts"
+
+
+def mesh_dcn_axis(mesh):
+    """The mesh's DCN (inter-host) axis name, or ``None`` for a flat
+    single-host mesh."""
+    if mesh is not None and DCN_AXIS in mesh.axis_names:
+        return DCN_AXIS
+    return None
+
+
+def client_axis(mesh):
+    """The ICI client axis — the axis round builders vmap/shard clients
+    over. On a flat mesh this is ``axis_names[0]`` (the historical
+    contract); on a DCN×ICI mesh it is the first non-DCN axis."""
+    for a in mesh.axis_names:
+        if a != DCN_AXIS:
+            return a
+    raise ValueError(f"mesh {mesh.axis_names} has no client axis")
+
+
+def client_axes(mesh, axis=None):
+    """The mesh axes the CLIENT dimension is sharded over, DCN-major —
+    ``("hosts", axis)`` on a hierarchical mesh, ``(axis,)`` otherwise.
+    ``P(client_axes(mesh))`` is the partition spec of every
+    client-stacked round operand."""
+    if axis is None:
+        axis = client_axis(mesh)
+    d = mesh_dcn_axis(mesh)
+    return (d, axis) if d else (axis,)
+
+
+def client_shards(mesh, axis=None) -> int:
+    """Total client shards = the product over the client axes (what the
+    sampled cohort is padded to a multiple of)."""
+    if mesh is None:
+        return 1
+    return int(np.prod([mesh.shape[a] for a in client_axes(mesh, axis)]))
+
+
+def _psum_hier(v, axes):
+    """``psum`` over the client axes, ICI first: on a flat mesh this is
+    exactly the historical single-axis ``psum`` (bit-compatible with
+    every existing pin); on a DCN×ICI mesh the ICI reduction completes
+    HOST-LOCALLY and only the per-host partial crosses the DCN axis —
+    the mean path's hierarchical reduction IS this association (one
+    O(model) host partial per host on DCN instead of a flat all-reduce
+    over every shard)."""
+    for a in reversed(axes):
+        v = jax.lax.psum(v, a)
+    return v
 
 
 def client_finite_mask(client_params) -> jnp.ndarray:
@@ -205,21 +267,30 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
 
     ``group_reduce`` — the HIERARCHICAL SPARSE REDUCTION (group-level
     partial aggregation + sparse global step, the arXiv:1903.05133
-    shape) for ``group_composable`` aggregators, with each mesh shard a
-    group: stage 1 runs the aggregator SHARD-LOCALLY over the shard's
-    own clients (no communication); stage 2 ``all_gather``s only the G
-    group partials + participation weights and applies the same
+    shape) for ``group_composable`` aggregators. On a flat mesh each
+    shard is a group: stage 1 runs the aggregator SHARD-LOCALLY over the
+    shard's own clients (no communication); stage 2 ``all_gather``s only
+    the G group partials + participation weights and applies the same
     aggregator across groups (a group whose clients were all excluded
     carries weight 0 and drops out — the "sparse" in sparse global
     reduction; the collective shrinks from C client models to G ≪ C
-    group partials). Mean is already this reduction EXACTLY (per-shard
-    partial sums + ``psum``) and keeps its bit-equal fast path; the
-    coordinate-wise statistics compose as median-of-medians /
+    group partials). On a DCN×ICI mesh (``multihost.py``; the mesh
+    carries a ``"hosts"`` axis) client groups are PINNED PER HOST:
+    stage 1 gathers the host's own client stack over the ICI axis only —
+    zero DCN traffic — and applies the aggregator per host; stage 2
+    crosses the DCN axis with exactly G = n_hosts group partials +
+    participation mass, O(G·model) inter-host bytes instead of the flat
+    path's O(C·model) client-stack ``all_gather``. Mean is already this
+    reduction EXACTLY (per-shard partial sums + the hierarchical
+    ``psum`` — ICI first, one host partial across DCN — which the mean
+    path runs with or without the flag) and keeps its bit-equal fast
+    path; the coordinate-wise statistics compose as median-of-medians /
     trim-of-trims — the hierarchical robust construction, semantically
-    distinct from the flat statistic by design. Non-composable
-    aggregators (krum, geometric_median) refuse ``group_reduce``
-    LOUDLY here: their exact semantics need the full client-stacked
-    ``all_gather`` fallback (``group_reduce=False``).
+    distinct from the flat statistic by design (and on a DCN mesh the
+    group is the HOST, not the shard). Non-composable aggregators
+    (krum, geometric_median) refuse ``group_reduce`` LOUDLY here: their
+    exact semantics need the full client-stacked ``all_gather`` fallback
+    (``group_reduce=False``).
 
     ``corruptor`` as in :func:`make_vmap_round`: the round grows a
     trailing client-sharded ``adv`` operand."""
@@ -236,9 +307,16 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
             "(mean/coord_median/trimmed_mean) for the hierarchical "
             "sparse reduction")
 
+    axes = client_axes(mesh, axis)
+    dcn = axes[0] if len(axes) > 1 else None
+    gather_ax = axes if dcn else axis  # collective name(s) spanning C
+
     def body(params, x, y, mask, weights, loss_weights, rng, adv):
-        # Same global-slot-keyed streams as the vmap path.
-        shard_idx = jax.lax.axis_index(axis)
+        # Same global-slot-keyed streams as the vmap path. On a DCN×ICI
+        # mesh the flattened (hosts-major) axis index IS the global
+        # shard slot — exactly the order P(("hosts", axis)) lays the
+        # client dimension out in.
+        shard_idx = jax.lax.axis_index(gather_ax)
         rngs = client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
         client_params, losses, finite = run_clients_guarded(
             local_train, client_transform, nan_guard,
@@ -247,12 +325,12 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
         loss_weights = loss_weights * finite
         w = weights.astype(jnp.float32)
         if aggregator is None:
-            total = jax.lax.psum(jnp.sum(w), axis)
+            total = _psum_hier(jnp.sum(w), axes)
             wn = w / jnp.maximum(total, 1e-12)
             avg = jax.tree.map(
-                lambda p: jax.lax.psum(
-                    jnp.einsum("c,c...->...", wn, p.astype(jnp.float32)), axis
-                ).astype(p.dtype),
+                lambda p: _psum_hier(
+                    jnp.einsum("c,c...->...", wn, p.astype(jnp.float32)),
+                    axes).astype(p.dtype),
                 client_params,
             )
             if nan_guard:
@@ -260,34 +338,50 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
                 avg = jax.tree.map(
                     lambda a, p: jnp.where(total > 0, a, p), avg, params)
         elif group_reduce:
-            # Hierarchical sparse reduction: shard-local robust partial
-            # (stage 1, zero communication), then a G-sized gather of
-            # group partials + participation mass for the cross-group
-            # statistic (stage 2). An all-excluded shard's partial may
-            # carry the aggregator's ±inf exclusion sentinels — its zero
-            # participation weight gates it out of stage 2, exactly the
-            # client-level weight semantics lifted one level up.
-            part = aggregator(client_params, w)
-            pw = jnp.sum(jnp.maximum(w, 0.0))
+            # Hierarchical sparse reduction. Stage 1's group is the
+            # SHARD on a flat mesh (shard-local, zero communication) and
+            # the HOST on a DCN×ICI mesh (the host's client stack
+            # gathered over the ICI axis only — zero DCN traffic).
+            # Stage 2 crosses the remaining axes with exactly G group
+            # partials + participation mass. An all-excluded group's
+            # partial may carry the aggregator's ±inf exclusion
+            # sentinels — its zero participation weight gates it out of
+            # stage 2, exactly the client-level weight semantics lifted
+            # one level up.
+            if dcn:
+                g_params = jax.tree.map(
+                    lambda p: jax.lax.all_gather(p, axis, axis=0,
+                                                 tiled=True),
+                    client_params)
+                g_w = jax.lax.all_gather(w, axis, axis=0, tiled=True)
+                part = aggregator(g_params, g_w)
+                pw = jnp.sum(jnp.maximum(g_w, 0.0))
+                stage2 = dcn
+            else:
+                part = aggregator(client_params, w)
+                pw = jnp.sum(jnp.maximum(w, 0.0))
+                stage2 = axis
             parts = jax.tree.map(
-                lambda p: jax.lax.all_gather(p, axis), part)  # [G, ...]
-            pws = jax.lax.all_gather(pw, axis)  # [G]
+                lambda p: jax.lax.all_gather(p, stage2), part)  # [G, ...]
+            pws = jax.lax.all_gather(pw, stage2)  # [G]
             avg = _robust_avg(aggregator, parts, pws, params)
         else:
             full = jax.tree.map(
-                lambda p: jax.lax.all_gather(p, axis, axis=0, tiled=True),
+                lambda p: jax.lax.all_gather(p, gather_ax, axis=0,
+                                             tiled=True),
                 client_params)
-            w_full = jax.lax.all_gather(w, axis, axis=0, tiled=True)
+            w_full = jax.lax.all_gather(w, gather_ax, axis=0, tiled=True)
             avg = _robust_avg(aggregator, full, w_full, params)
         lw = loss_weights.astype(jnp.float32)
-        lw = lw / jnp.maximum(jax.lax.psum(jnp.sum(lw), axis), 1e-12)
-        loss = jax.lax.psum(jnp.sum(losses * lw), axis)
+        lw = lw / jnp.maximum(_psum_hier(jnp.sum(lw), axes), 1e-12)
+        loss = _psum_hier(jnp.sum(losses * lw), axes)
         if with_client_losses:
             return avg, loss, losses
         return avg, loss
 
-    specs = (P(), P(axis), P(axis), P(axis), P(axis), P(axis), P())
-    out_specs = ((P(), P(), P(axis)) if with_client_losses
+    cs = P(axes)  # client-stacked operands: DCN-major on a hybrid mesh
+    specs = (P(), cs, cs, cs, cs, cs, P())
+    out_specs = ((P(), P(), cs) if with_client_losses
                  else (P(), P()))
     if corruptor is None:
         @partial(shard_map, mesh=mesh, in_specs=specs,
@@ -295,7 +389,7 @@ def make_sharded_round(local_train, mesh, axis: str = "clients",
         def round_fn(params, x, y, mask, weights, loss_weights, rng):
             return body(params, x, y, mask, weights, loss_weights, rng, None)
     else:
-        @partial(shard_map, mesh=mesh, in_specs=specs + (P(axis),),
+        @partial(shard_map, mesh=mesh, in_specs=specs + (cs,),
                  out_specs=out_specs, check_vma=False)
         def round_fn(params, x, y, mask, weights, loss_weights, rng, adv):
             return body(params, x, y, mask, weights, loss_weights, rng, adv)
@@ -468,8 +562,11 @@ def make_stateful_window_scan(round_fn):
 def window_put(mesh, axis: str = "clients"):
     """``put`` callable for ``FederatedStore.gather_window`` on a client
     mesh: lays each ``[W, C, ...]`` superbatch field out with the client
-    axis (dim 1) sharded over ``mesh[axis]`` and the window axis
-    replicated, so every scanned round slice arrives already
+    axis (dim 1) sharded over ``mesh[axis]`` — over ``("hosts", axis)``
+    on a DCN×ICI mesh, so each host's H2D gather lands HOST-LOCAL and
+    the ``WindowPrefetcher`` overlaps the next window's host-local
+    gather + transfer against the current window's compute — and the
+    window axis replicated, so every scanned round slice arrives already
     client-sharded for the shard_map round.
 
     The ``np.array`` copy is load-bearing: ``device_put`` of a large
@@ -481,10 +578,9 @@ def window_put(mesh, axis: str = "clients"):
     refill silently corrupt this window's in-flight superbatch. Aliasing
     the fresh copy instead is fine: nobody ever mutates it, and jax
     keeps it alive for the device array's lifetime."""
-    import numpy as np
     from jax.sharding import NamedSharding
 
-    sharding = NamedSharding(mesh, P(None, axis))
+    sharding = NamedSharding(mesh, P(None, client_axes(mesh, axis)))
 
     def put(a):
         return jax.device_put(np.array(a), sharding)
@@ -504,8 +600,10 @@ def make_stateful_client_round(body, mesh, axis: str = "clients"):
     -> (net', s_global', s_clients', loss)`` is written ONCE by the
     algorithm; this wrapper supplies the per-client rng streams and the
     cross-shard reduction — identity on a single device, psum under
-    shard_map — so the vmap and sharded paths cannot drift (the same
-    shared-body discipline as make_vmap_round/make_sharded_round)."""
+    shard_map (the hierarchical ICI-then-DCN association on a DCN×ICI
+    mesh, like the mean round's reduction) — so the vmap and sharded
+    paths cannot drift (the same shared-body discipline as
+    make_vmap_round/make_sharded_round)."""
     if mesh is None:
         def round_fn(net, s_global, s_clients, x, y, mask, weights, rng):
             rngs = client_rngs(rng, x.shape[0], 0)
@@ -513,18 +611,21 @@ def make_stateful_client_round(body, mesh, axis: str = "clients"):
                         rngs, cross=lambda v: v)
         return round_fn
 
+    axes = client_axes(mesh, axis)
+    cs = P(axes)
+    idx_ax = axes if len(axes) > 1 else axis
+
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis), P(axis),
-                  P()),
-        out_specs=(P(), P(), P(axis), P()),
+        in_specs=(P(), P(), cs, cs, cs, cs, cs, P()),
+        out_specs=(P(), P(), cs, P()),
         check_vma=False,
     )
     def round_fn(net, s_global, s_clients, x, y, mask, weights, rng):
-        shard_idx = jax.lax.axis_index(axis)
+        shard_idx = jax.lax.axis_index(idx_ax)
         rngs = client_rngs(rng, x.shape[0], shard_idx * x.shape[0])
         return body(net, s_global, s_clients, x, y, mask, weights, rngs,
-                    cross=partial(jax.lax.psum, axis_name=axis))
+                    cross=lambda v: _psum_hier(v, axes))
 
     return round_fn

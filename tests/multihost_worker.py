@@ -159,6 +159,63 @@ def run_store_rounds(mesh, to_global_local, client_range, n_rounds=3):
     return leaves, losses
 
 
+def dyadic_reduce_inputs():
+    """Association-proof round inputs shared by the 2-process
+    host-grouped drill and its in-process reference (see
+    tests/test_pod_reduce.py::_dyadic_round_inputs): dyadic values +
+    power-of-two weight total make every float sum exact, so bitwise
+    equality holds across ANY reduction association — including the
+    cross-process gloo all-reduce, which associates f32 sums differently
+    than the in-process collective (the documented 1-ulp caveat of the
+    resident-array SPMD test does not apply here)."""
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    c, d = 8, 5
+    x = (rng.randint(-256, 256, size=(c, 1, 2, d)) / 32.0).astype(
+        np.float32)
+    y = np.zeros((c, 1, 2), np.int32)
+    mask = np.ones((c, 1, 2), np.float32)
+    w = np.array([1, 2, 1, 4, 2, 2, 2, 2], np.float32)
+    return x, y, mask, w
+
+
+def run_group_reduce_round(mesh, to_global):
+    """One host-grouped hierarchical reduce on a ``("hosts", clients)``
+    DCN×ICI mesh: stage-1 host-local (ICI collective only), stage-2 a
+    G-partial gather across the hosts axis — the mean arm and the
+    median-of-host-medians arm. Returns the two reduced vectors as host
+    numpy."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from fedml_tpu.core import robust_agg
+    from fedml_tpu.parallel.shard import client_axes, make_sharded_round
+
+    def _delta_train(net, x, y, mask, rng):
+        return jax.tree.map(lambda w_: w_ + x[0, 0], net), jnp.float32(0.0)
+
+    x, y, mask, w = dyadic_reduce_inputs()
+    net = {"w": np.zeros((5,), np.float32)}
+    cs = P(client_axes(mesh))
+    args = (
+        jax.tree.map(lambda p: to_global(p, P()), net),
+        to_global(x, cs), to_global(y, cs), to_global(mask, cs),
+        to_global(w, cs), to_global(w, cs),
+        to_global(np.asarray(jax.random.PRNGKey(0)), P()),
+    )
+    mean_avg, _ = jax.jit(make_sharded_round(
+        _delta_train, mesh, aggregator=robust_agg.mean(),
+        group_reduce=True))(*args)
+    med_avg, _ = jax.jit(make_sharded_round(
+        _delta_train, mesh, aggregator=robust_agg.coord_median(),
+        group_reduce=True))(*args)
+    return (np.asarray(mean_avg["w"].addressable_data(0)),
+            np.asarray(med_avg["w"].addressable_data(0)))
+
+
 def main():
     pid, nprocs, port, out = (int(sys.argv[1]), int(sys.argv[2]),
                               sys.argv[3], sys.argv[4])
@@ -176,6 +233,26 @@ def main():
     assert jax.local_device_count() == local_devices, (
         jax.local_device_count())
     mesh = hybrid_mesh((local_devices,), (nprocs,), ("clients",))
+
+    if mode == "group":
+        # Host-grouped drill: the hosts axis IS the process boundary
+        # (one DCN granule per process on CPU), clients ride the
+        # process-local devices.
+        gmesh = hybrid_mesh((1, local_devices), (nprocs, 1),
+                            ("hosts", "clients"))
+
+        def to_global_g(v, pspec):
+            if pspec == jax.sharding.PartitionSpec(("hosts", "clients")):
+                per = v.shape[0] // nprocs
+                v = v[pid * per:(pid + 1) * per]
+            return multihost_utils.host_local_array_to_global_array(
+                v, gmesh, pspec)
+
+        mean_avg, med_avg = run_group_reduce_round(gmesh, to_global_g)
+        if pid == 0:
+            np.savez(out, mean=mean_avg, med=med_avg)
+        multihost_utils.sync_global_devices("done")
+        return
 
     if mode == "store":
         def to_global_local(v, pspec):

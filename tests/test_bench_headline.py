@@ -88,7 +88,8 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
                  "bench_serving_1m", "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
                  "bench_vit",
-                 "bench_layout_fused_round", "bench_resnet56_s2d",
+                 "bench_layout_fused_round", "bench_pod_reduce",
+                 "bench_cnn_mfu_levers", "bench_resnet56_s2d",
                  "bench_sharded_path", "bench_flash_attention_sweep",
                  "bench_transformer_fed_mfu"):
         monkeypatch.setattr(bench, name, quick_section)
@@ -112,7 +113,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     # Every section that RAN finished inside the budget: elapsed at its
     # start + the full section cap fit under 300s.
     assert len(ran) * 50 + 100 <= 300
-    assert len(ran) + len(skipped) == 18
+    assert len(ran) + len(skipped) == 20
 
 
 def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
@@ -128,7 +129,8 @@ def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
                  "bench_serving_1m", "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
                  "bench_vit",
-                 "bench_layout_fused_round", "bench_resnet56_s2d",
+                 "bench_layout_fused_round", "bench_pod_reduce",
+                 "bench_cnn_mfu_levers", "bench_resnet56_s2d",
                  "bench_sharded_path", "bench_flash_attention_sweep",
                  "bench_transformer_fed_mfu"):
         monkeypatch.setattr(bench, name, lambda: {"ok": 1.0})
@@ -188,6 +190,39 @@ def test_bench_layout_fused_round_machinery_toy_scale():
     assert out["layout_samples_per_sec"] > 0 and out["layout_pad_ratio"] > 0
 
 
+@pytest.mark.slow  # three CNN-arm compiles on the 2-core box (~2-4 min)
+def test_bench_cnn_mfu_levers_machinery_toy_scale():
+    """The r14 MFU-lever section's machinery end-to-end at toy scale:
+    fp32/bf16/im2col arms each land samples/s + delivered_tflops +
+    accuracy, and the delta fields populate — the real section runs the
+    FEMNIST-CNN defaults."""
+    out = bench.bench_cnn_mfu_levers(n_clients=4, per_client=8, batch=4,
+                                     cpr=4, acc_rounds=2, min_s=0.2,
+                                     reps=2)
+    for prefix in ("", "bf16_", "im2col_"):
+        assert out[f"{prefix}samples_per_sec"] > 0
+        assert out[f"{prefix}delivered_tflops"] is not None
+        assert 0.0 <= out[f"{prefix}accuracy"] <= 1.0
+    assert out["bf16_speedup"] > 0 and out["im2col_speedup"] > 0
+    assert out["bf16_acc_delta"] is not None
+    assert out["bf16_loss_delta"] is not None
+
+
+@pytest.mark.slow  # LR mesh compiles x3 arms (~1 min)
+def test_bench_pod_reduce_machinery_toy_scale():
+    """The r14 pod-reduce section's machinery at toy scale: three arms
+    on the simulated 2×4 DCN×ICI mesh, byte gauges read from the live
+    reduce_profile — the DCN-vs-flat ratio is C(padded)/G exactly."""
+    out = bench.bench_pod_reduce(n_clients=8, per_client=16, batch=8,
+                                 cpr=4, min_s=0.2, reps=2)
+    for arm in ("mean", "flat", "grouped"):
+        assert out[f"{arm}_rounds_per_sec"] > 0
+    assert out["dcn_partials_grouped"] == 2  # G = hosts
+    assert out["dcn_partials_flat"] == 8  # cpr=4 padded to the 8 shards
+    assert out["dcn_bytes_ratio"] == 4.0
+    assert out["grouped_vs_flat_rps"] > 0
+
+
 def test_headline_tolerates_budget_skipped_submetrics():
     """Sections the wall-clock budget skips land as {"skipped": ...} in
     the blob; the headline must still build, carry None scalars for
@@ -208,10 +243,15 @@ def test_headline_tolerates_budget_skipped_submetrics():
     # blob keeps it; the speedup scalar carries the story).
     assert "store_windowed_rps" not in h["sub"]
     assert h["sub"]["store_windowed_speedup"] == 1.7
-    # fedopt_windowed_rps rotated out of the headline in r10 (the full
-    # blob keeps it; the speedup scalar carries the story).
+    # fedopt_windowed_rps rotated out of the headline in r10, the
+    # speedup in r14 (zoo_windowed_speedup carries the carry-protocol
+    # story; the full blob keeps both).
     assert "fedopt_windowed_rps" not in h["sub"]
-    assert h["sub"]["fedopt_windowed_speedup"] == 1.4
+    assert "fedopt_windowed_speedup" not in h["sub"]
+    # The r14 pod-plane scalars ride (None when skipped).
+    assert h["sub"]["pod_dcn_bytes_ratio"] is None
+    assert h["sub"]["bf16_step_speedup"] is None
+    assert "robust_agg_overhead" not in h["sub"]  # rotated out in r14
     # The r13 whole-zoo scalars ride (None when the section was skipped).
     assert h["sub"]["zoo_windowed_speedup"] is None
     assert h["sub"]["fedac_acc_delta"] is None

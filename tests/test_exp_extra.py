@@ -17,6 +17,44 @@ def _base(algo, extra=()):
     ] + list(extra))
 
 
+def test_pod_plane_flags_refused():
+    """The pod-compute-plane knobs ride the FedAvg family's shared
+    rounds only (r14): specialty loops refuse them wholesale, and the
+    async tiers — whose cfg guard covers client_step_dtype /
+    group_reduce — must still refuse --dcn_hosts at the driver (it
+    never reaches a cfg field; the review-pass hole)."""
+    with pytest.raises(SystemExit, match="client_step_dtype"):
+        _base("SplitNN", ("--client_step_dtype", "bf16"))
+    with pytest.raises(SystemExit, match="group_reduce"):
+        _base("BaseFramework", ("--group_reduce",))
+    with pytest.raises(SystemExit, match="dcn_hosts"):
+        _base("FedAsync", ("--dcn_hosts", "2"))
+    with pytest.raises(SystemExit, match="dcn_hosts"):
+        _base("FedBuff", ("--dcn_hosts", "2"))
+
+
+def test_pod_plane_flags_refused_cross_silo_and_centralized():
+    """The two drivers that bypass the shared federation setup — the
+    cross-silo pipeline (silo trainers built from plain fns.apply) and
+    the centralized baseline (no client step at all) — refuse the pod
+    plane flags at the driver instead of silently training the
+    baseline arm (second review-pass hole)."""
+    from fedml_tpu.exp.main_centralized import main as central_main
+    from fedml_tpu.exp.main_cross_silo import main as silo_main
+
+    base = ["--dataset", "cifar10", "--model", "resnet56",
+            "--client_num_in_total", "4", "--client_num_per_round", "4",
+            "--batch_size", "8", "--comm_round", "1", "--epochs", "1",
+            "--ci", "1", "--synthetic_samples", "96"]
+    silo = base + ["--rank", "0", "--size", "2", "--backend", "TCP"]
+    for extra in (["--client_step_dtype", "bf16"], ["--group_reduce"],
+                  ["--dcn_hosts", "2"]):
+        with pytest.raises(SystemExit, match="cross-silo"):
+            silo_main(silo + extra)
+        with pytest.raises(SystemExit, match="centralized"):
+            central_main(base + extra)
+
+
 def test_main_base_framework():
     hist = _base("BaseFramework")
     # sum over workers of (round+1): round 0 → 4, round 1 → 8

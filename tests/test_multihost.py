@@ -185,6 +185,74 @@ def test_two_process_store_rounds_match_single_process():
     _run_store_workers(2, 4, ref_leaves, ref_losses)
 
 
+def test_two_process_host_grouped_reduce_bit_equal_flat():
+    """The pod-scale reduction across a REAL process boundary (ISSUE 14):
+    2 processes × 4 virtual devices build the ``("hosts", "clients")``
+    DCN×ICI mesh with one DCN granule per process, and run the
+    host-grouped reduce — stage-1 host-local over ICI, stage-2 a
+    G=2-partial gather across the (gloo) hosts axis. The mean arm must
+    be BIT-EQUAL to the single-host flat client-stack reduce (the vmap
+    round), and the median-of-host-medians arm bit-equal to the
+    single-process ``simulated_dcn_mesh`` program — exact equality is
+    honest here because the drill's dyadic inputs make every float sum
+    association-proof (see ``multihost_worker.dyadic_reduce_inputs``)."""
+    _require_multihost()
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.core import robust_agg
+    from fedml_tpu.parallel.multihost import simulated_dcn_mesh
+    from fedml_tpu.parallel.shard import make_sharded_round, make_vmap_round
+    from multihost_worker import dyadic_reduce_inputs
+
+    def _delta_train(net, x, y, mask, rng):
+        return jax.tree.map(lambda w_: w_ + x[0, 0], net), jnp.float32(0.0)
+
+    x, y, mask, w = dyadic_reduce_inputs()
+    net = {"w": np.zeros((5,), np.float32)}
+    args = (net, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
+            jnp.asarray(w), jnp.asarray(w), jax.random.PRNGKey(0))
+    # Flat client-stack reference (single chip), and the simulated-DCN
+    # twin of the exact two-stage program the workers compile.
+    ref_mean, _ = jax.jit(make_vmap_round(_delta_train))(*args)
+    ref_med, _ = jax.jit(make_sharded_round(
+        _delta_train, simulated_dcn_mesh(2, 4),
+        aggregator=robust_agg.coord_median(), group_reduce=True))(*args)
+
+    worker = Path(__file__).parent / "multihost_worker.py"
+    out = Path(os.environ.get("TMPDIR", "/tmp")) / (
+        f"mh_group_{os.getpid()}.npz")
+    port = 20000 + (os.getpid() + 29) % 10000
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+           "PALLAS_AXON_POOL_IPS": "",
+           "JAX_COMPILATION_CACHE_DIR": "/tmp/jaxcache",
+           "PYTHONPATH": os.pathsep.join(
+               [str(Path(__file__).parent.parent),
+                os.environ.get("PYTHONPATH", "")])}
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), "2", str(port), str(out),
+         "group", "4"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+        for pid in range(2)]
+    logs = _reap_workers(procs)
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"worker failed:\n{log[-3000:]}"
+
+    got = np.load(out)
+    try:
+        np.testing.assert_array_equal(got["mean"],
+                                      np.asarray(ref_mean["w"]))
+        np.testing.assert_array_equal(got["med"],
+                                      np.asarray(ref_med["w"]))
+    finally:
+        out.unlink(missing_ok=True)
+
+
 def test_two_process_spmd_round_matches_single_process():
     """Spawn 2 OS processes × 4 virtual CPU devices each, initialize
     ``jax.distributed`` against a localhost coordinator, build

@@ -1,0 +1,502 @@
+"""Pod-scale compute plane: host-grouped hierarchical reduction over the
+DCN×ICI mesh + the bf16 / im2col client-step levers.
+
+Reduction pins (``parallel/shard.make_sharded_round`` on a
+``simulated_dcn_mesh`` — single process, FORCED 2×4 DCN×ICI
+factorization, so the compiled program is the pod-shaped one):
+
+- mean through the hierarchical association is BIT-EQUAL to the flat
+  client-stack reduce. The cross-topology comparisons (vmap stack, flat
+  8-device mesh, DCN mesh, DCN+group_reduce) use DYADIC test vectors —
+  values k/32 and weights summing to a power of two, so every float sum
+  is exact and the equality pins "same mathematical reduction" rather
+  than one backend's association luck; the same-mesh group-vs-flat
+  comparison additionally runs on arbitrary floats (same program by
+  construction, like the flat-mesh mean pin in test_directory).
+- composable robust aggregators run median-of-HOST-medians (the group is
+  the host, not the shard) and match a numpy two-stage reference,
+  including an all-excluded host;
+- non-composable aggregators refuse loudly, flat non-mean still matches
+  the flat mesh bitwise (full client-stack gather in global slot order);
+- the windowed tier rides the DCN mesh unchanged (host-loop bit-equality
+  through ``window_put``'s hosts-major sharding);
+- the O(G)-traffic claim is an OBSERVABLE: ``FedAvgAPI.reduce_profile``
+  gauges scale with G (hosts), not C (cohort), and the hierarchical
+  host-side two-stage emits ``reduce.stage1``/``reduce.stage2`` spans.
+
+MFU-lever pins: bf16 client-step compute keeps the param tree (and
+aggregation/eval) fp32 and composes with the lane-fill layout; the
+im2col stem twin is forward-exact with a bitwise pad/unpad roundtrip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg import FedAvgAPI
+from fedml_tpu.core import robust_agg
+from fedml_tpu.data.batching import build_federated_arrays
+from fedml_tpu.data.store import FederatedStore
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.parallel.mesh import client_mesh
+from fedml_tpu.parallel.multihost import dcn_client_mesh, simulated_dcn_mesh
+from fedml_tpu.parallel.shard import (
+    client_axes,
+    client_axis,
+    client_shards,
+    make_sharded_round,
+    make_vmap_round,
+    mesh_dcn_axis,
+)
+
+
+def _assert_tree_equal(a, b):
+    for lhs, rhs in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(lhs), np.asarray(rhs))
+
+
+def _delta_train(net, x, y, mask, rng):
+    """Deterministic 'training': client's model = global + its first
+    sample, so the aggregation inputs are known exactly."""
+    return jax.tree.map(lambda w: w + x[0, 0], net), jnp.float32(0.0)
+
+
+def _dyadic_round_inputs():
+    """Association-proof round inputs: client updates are k/32 (exact in
+    f32, sums of ≤64 of them exact), weights sum to 16 = 2^4 so the
+    normalized weights and every weighted partial product are dyadic —
+    ANY reduction association yields bit-identical results, so bitwise
+    equality across topologies pins the mathematical reduction itself.
+    The ONE set of inputs shared with the 2-process gloo drill — the
+    cross-file "same mathematical reduction" story holds because both
+    sides literally draw the same vectors."""
+    from multihost_worker import dyadic_reduce_inputs
+
+    return tuple(jnp.asarray(v) for v in dyadic_reduce_inputs())
+
+
+def _float_round_inputs(c=8, d=5, seed=1):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(c, 1, 2, d).astype(np.float32)
+    y = np.zeros((c, 1, 2), np.int32)
+    mask = np.ones((c, 1, 2), np.float32)
+    w = jnp.asarray(rng.rand(c).astype(np.float32) + 0.5)
+    return jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask), w
+
+
+def _cfg(n, cpr, rounds=3, batch=16, **kw):
+    kw.setdefault("lr", 0.3)
+    return FedConfig(client_num_in_total=n, client_num_per_round=cpr,
+                     comm_round=rounds, epochs=1, batch_size=batch,
+                     frequency_of_the_test=1000, **kw)
+
+
+def _equal_counts(n_clients=8, per=64, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d)
+    x = rng.randn(n_clients * per, d).astype(np.float32)
+    y = (x @ w > 0).astype(np.int32)
+    parts = {c: np.arange(c * per, (c + 1) * per) for c in range(n_clients)}
+    return x, y, parts
+
+
+# ---------------- mesh helpers ----------------------------------------
+
+def test_dcn_mesh_helpers():
+    dcn = simulated_dcn_mesh(2, 4)
+    assert mesh_dcn_axis(dcn) == "hosts"
+    assert client_axis(dcn) == "clients"
+    assert client_axes(dcn) == ("hosts", "clients")
+    assert client_shards(dcn) == 8
+    flat = client_mesh(8)
+    assert mesh_dcn_axis(flat) is None
+    assert client_axes(flat) == ("clients",)
+    assert client_shards(flat) == 8
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        simulated_dcn_mesh(4, 4)
+    # Single-process dcn_client_mesh degrades to the forced
+    # factorization (this environment has one process).
+    m = dcn_client_mesh(2, 4)
+    assert m.shape == {"hosts": 2, "clients": 4}
+
+
+# ---------------- hierarchical mean: bit-equal to the flat stack ------
+
+def test_dcn_mean_bit_equal_flat_client_stack():
+    """The acceptance pin: host-grouped reduction on a simulated DCN×ICI
+    mesh is bit-equal (mean) to the flat client-stack reduce — the vmap
+    round's single-chip stack, the flat 8-device mesh, AND the grouped
+    arm, all on association-proof dyadic inputs."""
+    x, y, mask, w = _dyadic_round_inputs()
+    net = {"w": jnp.zeros((5,), jnp.float32)}
+    key = jax.random.PRNGKey(0)
+    args = (net, x, y, mask, w, w, key)
+
+    vm = jax.jit(make_vmap_round(_delta_train))(*args)
+    fl = jax.jit(make_sharded_round(_delta_train, client_mesh(8)))(*args)
+    dcn = simulated_dcn_mesh(2, 4)
+    hi = jax.jit(make_sharded_round(_delta_train, dcn))(*args)
+    hg = jax.jit(make_sharded_round(
+        _delta_train, dcn, aggregator=robust_agg.mean(),
+        group_reduce=True))(*args)
+    _assert_tree_equal(vm[0], fl[0])
+    _assert_tree_equal(vm[0], hi[0])
+    _assert_tree_equal(vm[0], hg[0])
+    assert float(vm[1]) == float(hi[1])
+
+
+def test_dcn_group_vs_flat_mean_same_mesh_arbitrary_floats():
+    """On the SAME DCN mesh, group_reduce mean IS the hierarchical
+    partial-sum fast path (the test_directory flat-mesh convention) —
+    bit-equal on arbitrary float inputs, no dyadic engineering."""
+    x, y, mask, w = _float_round_inputs()
+    net = {"w": jnp.zeros((5,), jnp.float32)}
+    key = jax.random.PRNGKey(0)
+    dcn = simulated_dcn_mesh(2, 4)
+    a = jax.jit(make_sharded_round(_delta_train, dcn))(
+        net, x, y, mask, w, w, key)
+    b = jax.jit(make_sharded_round(
+        _delta_train, dcn, aggregator=robust_agg.mean(),
+        group_reduce=True))(net, x, y, mask, w, w, key)
+    _assert_tree_equal(a[0], b[0])
+
+
+# ---------------- host-grouped robust: median of HOST medians ---------
+
+def test_dcn_group_reduce_median_of_host_medians_matches_numpy():
+    """Groups are HOSTS on a DCN mesh (4 clients each on 2×4), not
+    shards — including an all-excluded host whose ±inf-sentinel partial
+    must be gated out by its zero participation mass."""
+    c, d = 8, 5
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(c, 1, 2, d).astype(np.float32))
+    y = jnp.zeros((c, 1, 2), jnp.int32)
+    mask = jnp.ones((c, 1, 2), jnp.float32)
+    w = jnp.asarray([0, 0, 0, 0, 2, 1, 1, 3], jnp.float32)  # host 0 out
+    net = {"w": jnp.zeros((d,), jnp.float32)}
+    dcn = simulated_dcn_mesh(2, 4)
+    fn = jax.jit(make_sharded_round(
+        _delta_train, dcn, aggregator=robust_agg.coord_median(),
+        group_reduce=True))
+    avg, _ = fn(net, x, y, mask, w, w, jax.random.PRNGKey(0))
+
+    def np_median(v, valid):
+        m = int(valid.sum())
+        vv = np.where(valid[:, None], v, np.inf).astype(np.float32)
+        s = np.sort(vv, axis=0)
+        return ((s[max((m - 1) // 2, 0)] + s[max(m // 2, 0)])
+                * np.float32(0.5))
+
+    cw, cx = np.asarray(w), np.asarray(x)[:, 0, 0]
+    parts, pws = [], []
+    for g in range(2):  # G = 2 hosts, 4 clients each
+        sl = slice(g * 4, g * 4 + 4)
+        parts.append(np_median(cx[sl], cw[sl] > 0))
+        pws.append(np.maximum(cw[sl], 0).sum())
+    ref = np_median(np.stack(parts), np.asarray(pws) > 0)
+    np.testing.assert_allclose(np.asarray(avg["w"]), ref, rtol=1e-6)
+
+
+def test_dcn_group_differs_from_shard_group_statistic():
+    """The host-grouped statistic (2 groups of 4) is a DIFFERENT
+    (coarser) composition than the flat mesh's shard-grouped one
+    (8 groups of 1, which degenerates to the flat median) — pinning that
+    the DCN path actually groups per host."""
+    x, y, mask, _ = _float_round_inputs(seed=5)
+    w = jnp.ones((8,), jnp.float32)
+    net = {"w": jnp.zeros((5,), jnp.float32)}
+    key = jax.random.PRNGKey(0)
+    dcn = simulated_dcn_mesh(2, 4)
+    host_grouped, _ = jax.jit(make_sharded_round(
+        _delta_train, dcn, aggregator=robust_agg.coord_median(),
+        group_reduce=True))(net, x, y, mask, w, w, key)
+    flat, _ = jax.jit(make_sharded_round(
+        _delta_train, dcn, aggregator=robust_agg.coord_median()))(
+        net, x, y, mask, w, w, key)
+    assert not np.allclose(np.asarray(host_grouped["w"]),
+                           np.asarray(flat["w"]))
+
+
+def test_dcn_flat_non_mean_matches_flat_mesh_bitwise():
+    """group_reduce=False non-mean on a DCN mesh still gathers the FULL
+    client stack in global slot order — bit-identical statistic to the
+    flat single-axis mesh (the exactness escape hatch)."""
+    x, y, mask, w = _float_round_inputs(seed=7)
+    net = {"w": jnp.zeros((5,), jnp.float32)}
+    key = jax.random.PRNGKey(0)
+    a = jax.jit(make_sharded_round(
+        _delta_train, simulated_dcn_mesh(2, 4),
+        aggregator=robust_agg.coord_median()))(net, x, y, mask, w, w, key)
+    b = jax.jit(make_sharded_round(
+        _delta_train, client_mesh(8),
+        aggregator=robust_agg.coord_median()))(net, x, y, mask, w, w, key)
+    _assert_tree_equal(a[0], b[0])
+
+
+def test_dcn_non_composable_refuses_loudly():
+    dcn = simulated_dcn_mesh(2, 4)
+    for agg in (robust_agg.krum(1), robust_agg.geometric_median(4)):
+        with pytest.raises(ValueError, match="compose group-wise"):
+            make_sharded_round(_delta_train, dcn, aggregator=agg,
+                               group_reduce=True)
+
+
+# ---------------- FedAvgAPI end to end on the DCN mesh ----------------
+
+def test_fedavg_api_dcn_mesh_end_to_end():
+    """cfg.group_reduce rides FedAvgAPI on a DCN mesh: n_shards spans
+    both axes (cohort padding right), group-vs-flat mean bit-equal on
+    the same mesh, DCN-vs-flat-mesh within float tolerance (association
+    differs by design), krum still refused."""
+    x, y, parts = _equal_counts(n_clients=16, per=32)
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+    dcn = simulated_dcn_mesh(2, 4)
+    model = lambda: LogisticRegression(num_classes=2)  # noqa: E731
+    a = FedAvgAPI(model(), fed, None, _cfg(16, 8), mesh=dcn)
+    assert a.n_shards == 8
+    b = FedAvgAPI(model(), fed, None, _cfg(16, 8, group_reduce=True),
+                  mesh=dcn)
+    flat = FedAvgAPI(model(), fed, None, _cfg(16, 8),
+                     mesh=client_mesh(8))
+    for r in range(2):
+        a.train_one_round(r)
+        b.train_one_round(r)
+        flat.train_one_round(r)
+    _assert_tree_equal(a.net.params, b.net.params)
+    for lhs, rhs in zip(jax.tree.leaves(a.net.params),
+                        jax.tree.leaves(flat.net.params)):
+        np.testing.assert_allclose(np.asarray(lhs), np.asarray(rhs),
+                                   rtol=2e-6, atol=1e-7)
+    # Composable robust constructs and trains; non-composable refuses.
+    c = FedAvgAPI(model(), fed, None,
+                  _cfg(16, 8, group_reduce=True,
+                       aggregator="coord_median"), mesh=dcn)
+    assert np.isfinite(c.train_one_round(0)["train_loss"])
+    with pytest.raises(NotImplementedError, match="compose group-wise"):
+        FedAvgAPI(model(), fed, None,
+                  _cfg(16, 8, group_reduce=True, aggregator="krum"),
+                  mesh=dcn)
+
+
+def test_windowed_rides_dcn_mesh_bit_equal_host_loop():
+    """The windowed tier (window superbatch through ``window_put``'s
+    hosts-major sharding, scan carry, remainder rounds) rides the DCN
+    mesh unchanged: bit-equal training trajectory vs the per-round host
+    loop on the same mesh, at a non-dividing window."""
+    x, y, parts = _equal_counts(n_clients=12, per=32)
+    dcn = simulated_dcn_mesh(2, 4)
+    host = FedAvgAPI(LogisticRegression(num_classes=2),
+                     FederatedStore(x, y, parts, batch_size=16), None,
+                     _cfg(12, 8, rounds=5), mesh=dcn)
+    win = FedAvgAPI(LogisticRegression(num_classes=2),
+                    FederatedStore(x, y, parts, batch_size=16), None,
+                    _cfg(12, 8, rounds=5, group_reduce=True), mesh=dcn)
+    la = [host.train_one_round(r)["train_loss"] for r in range(5)]
+    lb = win.train_rounds_windowed(5, window=2)
+    _assert_tree_equal(host.net.params, win.net.params)
+    np.testing.assert_allclose(la, lb, rtol=1e-6)
+
+
+# ---------------- the O(G)-traffic observable -------------------------
+
+def test_reduce_obs_gauges_scale_with_hosts_not_cohort():
+    x, y, parts = _equal_counts(n_clients=16, per=32)
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+    dcn = simulated_dcn_mesh(2, 4)
+    grouped = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                        _cfg(16, 16, group_reduce=True,
+                             aggregator="coord_median"), mesh=dcn)
+    flat = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                     _cfg(16, 16, aggregator="coord_median"), mesh=dcn)
+    grouped.train_one_round(0)
+    flat.train_one_round(0)
+    gp, fp = grouped.reduce_profile(), flat.reduce_profile()
+    assert gp["dcn_partials"] == 2  # G = hosts, NOT the 16-client cohort
+    assert fp["dcn_partials"] == 16  # flat all_gather ships the cohort
+    assert gp["dcn_bytes_per_round"] == 2 * fp["dcn_bytes_per_round"] / 16
+    assert gp["dcn_flat_bytes_per_round"] == fp["dcn_bytes_per_round"]
+    assert gp["dcn_rounds"] == 1
+    # Mean is hierarchical by construction: G partials either way.
+    mean = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                     _cfg(16, 16), mesh=dcn)
+    mean.train_one_round(0)
+    assert mean.reduce_profile()["dcn_partials"] == 2
+    # Off a DCN mesh: no registry, empty profile.
+    off = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                    _cfg(16, 16), mesh=client_mesh(8))
+    off.train_one_round(0)
+    assert off.reduce_profile() == {}
+
+
+def test_hierarchical_host_two_stage_emits_reduce_spans():
+    """The host-side hierarchical algorithm's two real stages land on
+    the installed SpanTracer: one reduce.stage1 span per trained group,
+    one reduce.stage2 span carrying the G×payload byte observable."""
+    from fedml_tpu.algos.hierarchical import HierarchicalFedAvgAPI
+    from fedml_tpu.obs import trace as obs_trace
+    from fedml_tpu.obs.registry import payload_nbytes
+
+    x, y, parts = _equal_counts(n_clients=8, per=32)
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+    api = HierarchicalFedAvgAPI(
+        LogisticRegression(num_classes=2), fed, None, _cfg(8, 8),
+        group_ids=[0, 0, 1, 1, 2, 2, 3, 3])
+    tracer = obs_trace.SpanTracer()
+    with obs_trace.using(tracer):
+        api.train_one_round(0)
+    ev = tracer.events()
+    s1 = [e for e in ev if e["name"] == "reduce.stage1"]
+    s2 = [e for e in ev if e["name"] == "reduce.stage2"]
+    assert len(s1) == 4 and len(s2) == 1  # 4 groups sampled, one reduce
+    assert all(e["ph"] == "X" for e in s1 + s2)
+    assert s2[0]["args"]["groups"] == 4
+    assert s2[0]["args"]["nbytes"] == 4 * payload_nbytes(api.net)
+    # Traced-off: the same round emits nothing and pays no fence.
+    api2 = HierarchicalFedAvgAPI(
+        LogisticRegression(num_classes=2), fed, None, _cfg(8, 8),
+        group_ids=[0, 0, 1, 1, 2, 2, 3, 3])
+    assert api2.train_one_round(0)["train_loss"] is not None
+
+
+# ---------------- bf16 client-step compute ----------------------------
+
+def test_bf16_client_step_params_stay_fp32_and_track_fp32_run():
+    """cfg.client_step_dtype="bf16": layer compute in bf16, but the
+    param tree, gradients/optimizer, aggregation and eval all stay fp32
+    — trained params are fp32 dtype and within bf16 rounding of the
+    fp32 run."""
+    x, y, parts = _equal_counts(n_clients=8, per=32)
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+    a = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                  _cfg(8, 8, lr=0.1))
+    b = FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                  _cfg(8, 8, lr=0.1, client_step_dtype="bf16"))
+    for r in range(2):
+        a.train_one_round(r)
+        b.train_one_round(r)
+    for pa, pb in zip(jax.tree.leaves(a.net.params),
+                      jax.tree.leaves(b.net.params)):
+        assert pb.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   atol=0.02)
+    # Different compute dtype must actually change the step (bf16 is not
+    # silently fp32).
+    assert any(
+        not np.array_equal(np.asarray(pa), np.asarray(pb))
+        for pa, pb in zip(jax.tree.leaves(a.net.params),
+                          jax.tree.leaves(b.net.params)))
+    # Eval runs the fp32 model either way.
+    assert b.eval_fn is not None
+
+
+def test_bf16_client_step_refusals():
+    x, y, parts = _equal_counts(n_clients=8, per=32)
+    fed = build_federated_arrays(x, y, parts, batch_size=16)
+    with pytest.raises(ValueError, match="client_step_dtype"):
+        FedAvgAPI(LogisticRegression(num_classes=2), fed, None,
+                  _cfg(8, 8, client_step_dtype="fp16"))
+    # Corrected-SGD algorithms build their trainers outside
+    # _build_local_train — the knob must refuse, not silently no-op.
+    from fedml_tpu.algos.scaffold import ScaffoldAPI
+
+    with pytest.raises(ValueError, match="client_step_dtype"):
+        ScaffoldAPI(LogisticRegression(num_classes=2), fed, None,
+                    _cfg(8, 8, client_step_dtype="bf16"))
+    # Models without a compute-dtype field refuse at construction.
+    from flax import linen as nn
+
+    class NoDtype(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(2)(x.reshape((x.shape[0], -1)))
+
+    with pytest.raises(NotImplementedError, match="compute-dtype"):
+        FedAvgAPI(NoDtype(), fed, None,
+                  _cfg(8, 8, client_step_dtype="bf16"))
+
+
+def test_bf16_composes_with_compute_layout():
+    """The two MFU levers stack: the lane-padded PHYSICAL twin is the
+    one cloned to the bf16 compute dtype; logical fp32 shapes hold
+    everywhere above the step."""
+    from fedml_tpu.models.cnn import CNNOriginalFedAvg
+
+    rng = np.random.RandomState(0)
+    n, per = 4, 8
+    x = rng.randn(n * per, 12, 12, 1).astype(np.float32)
+    y = rng.randint(0, 3, n * per).astype(np.int32)
+    parts = {c: np.arange(c * per, (c + 1) * per) for c in range(n)}
+    fed = build_federated_arrays(x, y, parts, batch_size=4)
+    api = FedAvgAPI(
+        CNNOriginalFedAvg(num_classes=3, widths=(12, 20), hidden=16),
+        fed, None,
+        _cfg(n, n, batch=4, lr=0.05, compute_layout="auto",
+             client_step_dtype="bf16"))
+    assert api._layout is not None and api._step_dtype is not None
+    loss = api.train_one_round(0)["train_loss"]
+    assert np.isfinite(loss)
+    for leaf in jax.tree.leaves(api.net.params):
+        assert leaf.dtype == jnp.float32  # logical fp32 tree, unpadded
+    assert api.net.params["Conv_0"]["kernel"].shape[-1] == 12
+
+
+# ---------------- im2col conv lane shaping ----------------------------
+
+def test_im2col_layout_exact_and_roundtrip():
+    from fedml_tpu.models.cnn import CNNOriginalFedAvg
+    from fedml_tpu.parallel.layout import im2col_layout
+    from fedml_tpu.trainer.local import model_fns
+
+    x = np.random.RandomState(0).randn(4, 28, 28, 1).astype(np.float32)
+    m = CNNOriginalFedAvg(num_classes=10)
+    lay = im2col_layout(m, x)
+    assert not lay.is_identity
+    fns, pfns = model_fns(m), model_fns(lay.physical_model)
+    net = fns.init(jax.random.PRNGKey(0), x)
+    pnet = lay.pad(net)
+    # Physical stem kernel is the (c, kh, kw)-flattened 1x1 GEMM form.
+    assert pnet.params["Conv_0"]["kernel"].shape == (1, 1, 25, 32)
+    la, _ = fns.apply(net, x)
+    pa, _ = pfns.apply(pnet, x)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(pa),
+                               rtol=1e-5, atol=1e-5)
+    # pad/unpad are exact inverses (pure transpose+reshape) — bitwise.
+    _assert_tree_equal(net, lay.unpad(pnet))
+
+
+def test_im2col_refusals():
+    from fedml_tpu.models.resnet import CifarResNet
+    from fedml_tpu.parallel.layout import im2col_layout
+
+    x = np.zeros((2, 32, 32, 3), np.float32)
+    with pytest.raises(NotImplementedError, match="im2col"):
+        im2col_layout(CifarResNet(layers=(1, 1, 1), num_classes=10), x)
+
+
+def test_cfg_compute_layout_im2col_end_to_end():
+    """cfg.compute_layout="im2col" trains with logical shapes at every
+    boundary above the step — and the wrapped step tracks the plain run
+    within the CNN family's documented ~1-ulp class."""
+    from fedml_tpu.models.cnn import CNNOriginalFedAvg
+
+    rng = np.random.RandomState(0)
+    n, per = 4, 8
+    x = rng.randn(n * per, 12, 12, 1).astype(np.float32)
+    y = rng.randint(0, 3, n * per).astype(np.int32)
+    parts = {c: np.arange(c * per, (c + 1) * per) for c in range(n)}
+    fed = build_federated_arrays(x, y, parts, batch_size=4)
+    mk = lambda lay: FedAvgAPI(  # noqa: E731
+        CNNOriginalFedAvg(num_classes=3, widths=(8, 12), hidden=16),
+        fed, None, _cfg(n, n, batch=4, lr=0.05, compute_layout=lay))
+    plain, im = mk("none"), mk("im2col")
+    for r in range(2):
+        plain.train_one_round(r)
+        im.train_one_round(r)
+    for pa, pb in zip(jax.tree.leaves(plain.net.params),
+                      jax.tree.leaves(im.net.params)):
+        assert pa.shape == pb.shape  # logical shapes everywhere
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=2e-4, atol=2e-5)
+
