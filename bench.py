@@ -1356,9 +1356,91 @@ def bench_serving_1m(C=1_048_576, G=64, n_devices=32, features=32,
         u0 = sat.get("workers_0", {}).get("uploads_per_sec")
         out["ingest_speedup_4v0"] = (round(u4 / u0, 2)
                                      if u0 and u4 else None)
+        # -- adapter arm (PR 15): the same churn × codec × pool × chaos
+        # composition shipping ADAPTER-only topk+int8 EF deltas from a
+        # frozen-base transformer. Degraded to an error record instead
+        # of discarding the measured scalars above (the PR 7
+        # gather_probe_error discipline).
+        try:
+            out["adapter_arm"] = _serving_adapter_arm()
+        except Exception as e:
+            out["adapter_arm"] = {"error": f"{type(e).__name__}: {e}"[:200]}
         return out
     finally:
         shutil.rmtree(spill, ignore_errors=True)
+
+
+def _serving_adapter_arm(n_devices=8, horizon_s=600.0, rank=8,
+                         d_model=64, vocab=2004, seq_len=20):
+    """serving_1m's adapter arm: a diurnal-churn FedBuff fleet of
+    frozen-base transformers shipping adapter-only ``topk0.05+int8`` EF
+    deltas through the 2-worker ingest pool over the SIM tensor wire
+    under ChaosTransport — the million-client drill's composition with
+    the upload shrunk by the rank ratio BEFORE the codec runs."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.comm.resilience import ChaosSpec
+    from fedml_tpu.data.batching import build_federated_arrays
+    from fedml_tpu.data.synthetic import make_stackoverflow_nwp
+    from fedml_tpu.models import create_model
+    from fedml_tpu.models.adapter import param_count
+    from fedml_tpu.sim import FleetSimulator, FleetSpec, make_fleet_trace
+    from fedml_tpu.trainer.local import model_fns, seq_softmax_ce
+
+    _check_section_deadline()
+    model = create_model("transformer_lm", vocab_size=vocab,
+                         d_model=d_model, n_heads=4, n_layers=2,
+                         max_len=seq_len, adapter_rank=rank)
+    x, y, parts = make_stackoverflow_nwp(64, seq_len=seq_len, vocab=vocab,
+                                         seed=3)
+    fed = build_federated_arrays(x, y, parts, 2)
+    cfg = FedConfig(client_num_in_total=64, client_num_per_round=n_devices,
+                    comm_round=10 ** 9, epochs=1, batch_size=2, lr=0.05,
+                    frequency_of_the_test=10 ** 9, adapter_rank=rank,
+                    ingest_workers=2)
+    spec = FleetSpec(n_devices=n_devices, seed=11, horizon_s=horizon_s,
+                     mean_online=0.8, base_round_s=30.0, slot_s=120.0,
+                     speed_alpha=1.5, diurnal_amplitude=0.4,
+                     diurnal_period_s=2400.0, arrival_spread_s=60.0)
+    sim = FleetSimulator(model, fed, None, cfg, make_fleet_trace(spec),
+                         mode="fedbuff", buffer_k=4,
+                         wire_codec="topk0.05+int8", sim_wire="tensor",
+                         chaos=ChaosSpec(seed=11, dup_p=0.05, delay_p=0.05),
+                         loss_fn=partial(seq_softmax_ce, pad_id=0))
+    jax.block_until_ready(sim.local_train(
+        sim.net0, fed.x[0], fed.y[0], fed.mask[0],
+        jax.random.PRNGKey(0))[0])  # jit warm, outside the timed window
+    t0 = time.perf_counter()
+    res = sim.run()
+    dt = time.perf_counter() - t0
+    uploads = len(res.arrival_log)
+    h = sim.server.health()
+    s = res.summary()
+    adapter_params = param_count(sim.net0.params)
+    dense_params = param_count(model_fns(
+        create_model("transformer_lm", vocab_size=vocab, d_model=d_model,
+                     n_heads=4, n_layers=2, max_len=seq_len)).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, seq_len), jnp.int32)).params)
+    bpu = h["bytes_rx"] / max(uploads, 1)
+    return {
+        "devices": n_devices, "rank": rank,
+        "adapter_params": adapter_params, "dense_params": dense_params,
+        "codec": "topk0.05+int8", "ingest_workers": 2,
+        "uploads": uploads, "wall_s": round(dt, 2),
+        "updates": res.updates,
+        "bytes_per_upload": round(bpu, 1),
+        "bytes_vs_dense_wire": round(4.0 * dense_params / max(bpu, 1e-9),
+                                     1),
+        "staleness_p95": s.get("staleness_p95"),
+        "evictions": s["evictions"],
+        "churn_killed_uploads": s["churn_killed_uploads"],
+        "codec_refusals": h["codec_refusals"],
+        "host_rss_mb": s["host_rss_mb"],
+    }
 
 
 def bench_fleet_sim():
@@ -2172,14 +2254,19 @@ def _token_fed(n_clients, per_client, batch, t, vocab, seed=0):
 
 
 def _lm_scan_bench(model, n_clients, per_client, batch, cpr, t, vocab,
-                   lr=0.1, rounds=3, min_call_s=None):
+                   lr=0.1, rounds=3, min_call_s=None, api_cls=None,
+                   api_kw=None):
     """Median seqs/sec of the whole-run scan for a token LM federation.
 
     With ``min_call_s`` set, the scan length is grown until a measured
     warm call exceeds it (the 0.4 s device-work floor of r3 VERDICT #1,
     with headroom for the tunnel's ~0.1 s dispatch RTT) — each growth
     recompiles once (scan length is static), so the loop converges in
-    one or two steps. Returns (seqs/sec, rounds, call_s) then."""
+    one or two steps. Returns (seqs/sec, rounds, call_s) then.
+
+    ``api_cls``/``api_kw`` swap the algorithm (default FedAvgAPI) —
+    the fed_adapter section measures FedAdapterAPI on the identical
+    harness so the adapter-vs-dense tokens/s A/B shares every knob."""
     from functools import partial
 
     import jax
@@ -2191,8 +2278,9 @@ def _lm_scan_bench(model, n_clients, per_client, batch, cpr, t, vocab,
     fed = _token_fed(n_clients, per_client, batch, t, vocab)
     cfg = FedConfig(client_num_in_total=n_clients, client_num_per_round=cpr,
                     comm_round=1, epochs=1, batch_size=batch, lr=lr)
-    api = FedAvgAPI(model, fed, None, cfg,
-                    loss_fn=partial(seq_softmax_ce, pad_id=0))
+    api = (api_cls or FedAvgAPI)(model, fed, None, cfg,
+                                 loss_fn=partial(seq_softmax_ce, pad_id=0),
+                                 **(api_kw or {}))
     api.train_rounds_on_device(rounds)  # warmup/compile
     jax.block_until_ready(api.net.params)
     if min_call_s is None:
@@ -2243,6 +2331,229 @@ def bench_transformer_fed_mfu():
             "d_model": 512, "seq_len": t,
             "delivered_tflops": round(delivered, 3),
             "mfu": (round(delivered / peak, 4) if peak else None)}
+
+
+def _pretrain_dense_lm(x, y, vocab, seq_len, d_model, n_heads, n_layers,
+                       steps=500, batch=32, lr=3e-3, seed=0):
+    """Adam-pretrain a dense transformer_lm on the pooled token set —
+    the 'shared pretrained LM' every fed_adapter arm finetunes FROM
+    (LoRA is a finetuning method; a random frozen base has nothing for
+    rank-r adapters to steer). Returns the host param tree."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from fedml_tpu.models import create_model
+    from fedml_tpu.trainer.local import NetState, model_fns, seq_softmax_ce
+
+    fns = model_fns(create_model("transformer_lm", vocab_size=vocab,
+                                 d_model=d_model, n_heads=n_heads,
+                                 n_layers=n_layers, max_len=seq_len))
+    net = fns.init(jax.random.PRNGKey(seed),
+                   jnp.zeros((1, seq_len), jnp.int32))
+    opt = optax.adam(lr)
+    loss_fn = partial(seq_softmax_ce, pad_id=0)
+
+    def loss(params, xb, yb):
+        logits, _ = fns.apply(NetState(params, net.model_state), xb)
+        return loss_fn(logits, yb).mean()
+
+    @jax.jit
+    def step(params, ost, xb, yb):
+        l, g = jax.value_and_grad(loss)(params, xb, yb)
+        u, ost = opt.update(g, ost)
+        return optax.apply_updates(params, u), ost, l
+
+    params, ost = net.params, opt.init(net.params)
+    rng = np.random.RandomState(seed)
+    xs, ys = jnp.asarray(x), jnp.asarray(y)
+    for it in range(steps):
+        if it % 50 == 0:
+            _check_section_deadline()
+        idx = rng.randint(0, len(x), batch)
+        params, ost, l = step(params, ost, xs[idx], ys[idx])
+    return jax.tree.map(np.asarray, params), float(l)
+
+
+def bench_fed_adapter(n_clients=24, seq_len=8, vocab=1004, d_model=64,
+                      n_heads=2, n_layers=2, rank=8, kgroup=8,
+                      active_tokens=32, count_scale=8, pretrain_steps=500,
+                      agg_rounds=12, buffer_k=2, batch=8, fed_rounds=8,
+                      personal_passes=4, codec="topk0.1+int8",
+                      mfu_rank=16):
+    """Parameter-efficient federated finetuning, measured end to end
+    (ROADMAP item 3; FedNLP arXiv:2104.08815, low-rank updates
+    arXiv:2108.06098).
+
+    **Wire story** — three FedBuff arms on the loopback tensor wire
+    under ChaosTransport (dup+delay), all finetuning the SAME adam-
+    pretrained dense base on the StackOverflow-NWP dialect law
+    (data/synthetic.make_stackoverflow_shard ``law="dialect"``):
+    ``dense_wire`` ships uncompressed dense deltas (the wire ruler),
+    ``dense_codec`` ships topk+int8 EF dense deltas (the PR 10 codec
+    point), ``adapter_codec`` ships topk+int8 EF ADAPTER-only deltas
+    (cfg.adapter_rank — the upload shrinks by the rank ratio BEFORE the
+    codec runs). ``adapter_bytes_ratio`` = dense_codec / adapter_codec
+    bytes-per-upload (the ≥8x acceptance); ``adapter_vs_dense_wire`` the
+    ≥~100x ruler; ``adapter_acc_delta`` the held-out NWP accuracy gap
+    between the codec arms (≈0 = the bytes win is free).
+
+    **Personalization story** — FedAdapterAPI on the same law: federated
+    adapter rounds, then ditto-style per-client personalization passes
+    into the PersonalAdapterStore; ``personalized_delta`` is the
+    held-out personalized-vs-global accuracy gap (positive = the
+    per-client adapter stacks beat one global adapter set).
+
+    **Throughput story** — tokens/s + MFU (vs LOGICAL FLOPs of the
+    injected model) for the federated ADAPTER round at the
+    transformer_fed_mfu scale (d_model=512), A/B'd against the dense
+    round on the identical ``_lm_scan_bench`` harness; guarded so a
+    compile-bound box records an honest hole without discarding the
+    wire/personalization numbers."""
+    import dataclasses
+    from functools import partial
+
+    import jax
+
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedadapter import FedAdapterAPI
+    from fedml_tpu.algos.fedbuff import FedML_FedBuff_distributed
+    from fedml_tpu.comm.resilience import ChaosSpec
+    from fedml_tpu.data.batching import batch_global, build_federated_arrays
+    from fedml_tpu.data.synthetic import make_stackoverflow_nwp
+    from fedml_tpu.models import create_model
+    from fedml_tpu.models.adapter import param_count
+    from fedml_tpu.obs.flops import model_cost
+    from fedml_tpu.trainer.local import seq_softmax_ce
+
+    loss_fn = partial(seq_softmax_ce, pad_id=0)
+    law = dict(seq_len=seq_len, vocab=vocab, law="dialect", kgroup=kgroup,
+               active_tokens=active_tokens, count_scale=count_scale)
+    x, y, parts = make_stackoverflow_nwp(n_clients, seed=0, **law)
+    xh, yh, parts_h = make_stackoverflow_nwp(n_clients, seed=1, **law)
+    fed = build_federated_arrays(x, y, parts, batch)
+    test = batch_global(xh, yh, batch)
+
+    _check_section_deadline()
+    base, pre_loss = _pretrain_dense_lm(x, y, vocab, seq_len, d_model,
+                                        n_heads, n_layers,
+                                        steps=pretrain_steps)
+
+    def mk_model(r, scope="attn"):
+        # Wire arms: "attn" scope — the steepest rank ratio (the MLP
+        # pair dominates adapter bytes at small d_model). The
+        # personalization arm uses "all" (more steering capacity; its
+        # own profile is reported).
+        return create_model("transformer_lm", vocab_size=vocab,
+                            d_model=d_model, n_heads=n_heads,
+                            n_layers=n_layers, max_len=seq_len,
+                            adapter_rank=r, adapter_scope=scope)
+
+    cfg0 = FedConfig(client_num_in_total=n_clients, client_num_per_round=8,
+                     comm_round=agg_rounds, epochs=2, batch_size=batch,
+                     lr=0.1, seed=0, frequency_of_the_test=10 ** 9)
+    chaos = ChaosSpec(seed=11, dup_p=0.1, delay_p=0.1)
+
+    def arm(wire_codec, adapter):
+        _check_section_deadline()
+        cfg = (dataclasses.replace(cfg0, adapter_rank=rank) if adapter
+               else cfg0)
+        srv = FedML_FedBuff_distributed(
+            mk_model(rank if adapter else 0), fed, test, cfg,
+            wire_codec=wire_codec, loopback_wire="tensor",
+            buffer_k=buffer_k, chaos=chaos, idle_timeout_s=15.0,
+            loss_fn=loss_fn, pretrained_params=base)
+        h = srv.final_health
+        uploads = len(srv.arrival_log)
+        acc = ((srv.test_history[-1] if srv.test_history else {})
+               .get("accuracy"))
+        return {"codec": wire_codec, "uploads": uploads,
+                "bytes_per_upload": round(h["bytes_rx"] / max(uploads, 1),
+                                          1),
+                "codec_refusals": h["codec_refusals"],
+                "heldout_accuracy": (round(float(acc), 4)
+                                     if acc is not None else None)}
+
+    arms = {"dense_wire": arm("none", False),
+            "dense_codec": arm(codec, False),
+            "adapter_codec": arm(codec, True)}
+    dense_params = param_count(base)
+    out = {
+        "law": {k: v for k, v in law.items()},
+        "pretrain": {"steps": pretrain_steps, "final_loss":
+                     round(pre_loss, 4)},
+        "dense_params": dense_params,
+        "chaos": "dup_p=0.1 delay_p=0.1", "wire": "tensor",
+        "buffer_k": buffer_k, "rank": rank,
+        "arms": arms,
+    }
+    d, a = (arms["dense_codec"]["bytes_per_upload"],
+            arms["adapter_codec"]["bytes_per_upload"])
+    w = arms["dense_wire"]["bytes_per_upload"]
+    out["adapter_bytes_ratio"] = round(d / a, 2) if a else None
+    out["adapter_vs_dense_wire"] = round(w / a, 2) if a else None
+    acc_d = arms["dense_codec"]["heldout_accuracy"]
+    acc_a = arms["adapter_codec"]["heldout_accuracy"]
+    out["adapter_acc_delta"] = (round(acc_a - acc_d, 4)
+                                if None not in (acc_a, acc_d) else None)
+
+    # -- personalization: per-client adapter stacks vs the global set --
+    _check_section_deadline()
+    papi = FedAdapterAPI(mk_model(rank, "all"), fed, None,
+                         dataclasses.replace(cfg0, lr=0.3,
+                                             comm_round=fed_rounds),
+                         loss_fn=loss_fn, base_params=base,
+                         personal_interp=1.0)
+    papi.train()
+    fedh = build_federated_arrays(xh, yh, parts_h, batch)
+    # personal_interp=1.0 restarts every pass from the GLOBAL adapters,
+    # so only the last pass's state survives the store scatter — run
+    # that pass directly (bit-identical to looping personal_passes
+    # times, at 1/personal_passes the compute).
+    _check_section_deadline()
+    papi.personalize_cohort(np.arange(n_clients), seed=personal_passes - 1)
+    pm = papi.evaluate_personalized(fedh)
+    out["personalization"] = {k: round(float(v), 4) for k, v in pm.items()}
+    out["personalized_delta"] = round(float(pm["personalized_delta"]), 4)
+    out["adapter_profile"] = {k: (round(v, 5) if isinstance(v, float)
+                                  else v)
+                              for k, v in papi.adapter_profile().items()}
+
+    # -- tokens/s + MFU at the transformer_fed_mfu scale (guarded) -----
+    try:
+        _check_section_deadline()
+        t, mv, mb = 512, 10004, 8
+        mk_big = lambda r: create_model(
+            "transformer_lm", vocab_size=mv, d_model=512, n_heads=8,
+            n_layers=4, max_len=t, dtype="bf16", adapter_rank=r,
+            adapter_scope="attn")
+        kw = dict(n_clients=16, per_client=32, batch=mb, cpr=8, t=t,
+                  vocab=mv)
+        a_sps = _lm_scan_bench(mk_big(mfu_rank), api_cls=FedAdapterAPI,
+                               **kw)
+        d_sps = _lm_scan_bench(mk_big(0), **kw)
+        fwd = model_cost(mk_big(mfu_rank), np.ones((mb, t), np.int32),
+                         train=False)
+        delivered = 3.0 * fwd["flops"] / mb * a_sps / 1e12
+        peak = _chip_peak(jax.devices()[0].device_kind)
+        out["throughput"] = {
+            "adapter_seqs_per_sec": round(a_sps, 2),
+            "adapter_tokens_per_sec": round(a_sps * t, 0),
+            "dense_seqs_per_sec": round(d_sps, 2),
+            "adapter_vs_dense_step": round(a_sps / d_sps, 3),
+            "d_model": 512, "seq_len": t, "adapter_rank": mfu_rank,
+            "delivered_tflops": round(delivered, 3),
+            "mfu": (round(delivered / peak, 4) if peak else None)}
+        out["adapter_tokens_per_sec"] = out["throughput"][
+            "adapter_tokens_per_sec"]
+    except _SectionTimeout as e:
+        # Keep the measured wire/personalization numbers — the MFU A/B
+        # is the TPU round's axis; a compile-bound box records the hole.
+        out["throughput"] = {"timeout": str(e)}
+        out["adapter_tokens_per_sec"] = None
+    return out
 
 
 def bench_transformer_flash_e2e():
@@ -2338,6 +2649,7 @@ def main():
                 ("robust_agg", bench_robust_agg),
                 ("chaos", bench_chaos),
                 ("wire_codec", bench_wire_codec),
+                ("fed_adapter", bench_fed_adapter),
                 ("ingest_profile", bench_ingest_profile),
                 ("serving_1m", bench_serving_1m),
                 ("fleet_sim", bench_fleet_sim),
@@ -2532,13 +2844,24 @@ def build_headline(out, full_path="docs/bench_local.json"):
             # run UNDER chaos now; the full blob keeps it) to fund
             # ingest_occupancy under the <1KB tail budget.
             "wire_bytes_ratio": _scalar("wire_codec", "wire_bytes_ratio"),
-            "codec_acc_delta": _scalar("wire_codec", "codec_acc_delta"),
-            # The server-ingest-wall baseline (r11): dispatch-thread
-            # occupancy on the loopback topk+int8 chaos drill — the
-            # before/after ruler for ROADMAP item 1's parallel-ingest
-            # attack (decode/fold p50/p95 live in the full blob).
-            "ingest_occupancy": _scalar("ingest_profile",
-                                        "ingest_occupancy"),
+            # codec_acc_delta rotated out in r15 (measured 0.0 since
+            # r10, and the fed_adapter section re-measures the
+            # accuracy-under-codec story as adapter_acc_delta in the
+            # blob); ingest_occupancy rotated out in r15 too (the r12
+            # serving pair uploads_per_sec/ingest_speedup_4v1 carries
+            # the ingest story; the blob keeps both) — funding the
+            # adapter scalars under the <1KB tail budget.
+            # The r15 adapter finetune: bytes-per-upload ratio of
+            # adapter-only topk+int8 EF deltas over the dense-delta
+            # codec point (both under ChaosTransport; the ~100x
+            # vs-uncompressed ruler + held-out accuracy deltas +
+            # personalized-vs-global live in the blob), and tokens/s of
+            # the federated adapter round at the transformer_fed_mfu
+            # scale.
+            "adapter_bytes_ratio": _scalar("fed_adapter",
+                                           "adapter_bytes_ratio"),
+            "adapter_tokens_per_sec": _scalar("fed_adapter",
+                                              "adapter_tokens_per_sec"),
             # The r12 serving headline: the composed 1M-device drill's
             # ingest-saturation curve — uploads/s at 4 pool workers and
             # its ratio over the 1-worker serial pool (the server-ingest

@@ -2,6 +2,7 @@ from fedml_tpu.algos.config import FedConfig
 from fedml_tpu.algos.centralized import CentralizedTrainer
 from fedml_tpu.algos.decentralized import DecentralizedAPI
 from fedml_tpu.algos.fedac import FedAcAPI, ServerAvgAPI
+from fedml_tpu.algos.fedadapter import FedAdapterAPI
 from fedml_tpu.algos.fedavg import FedAvgAPI
 from fedml_tpu.algos.fedgan import FedGanAPI
 from fedml_tpu.algos.fedgkt import FedGKTAPI
@@ -25,6 +26,7 @@ from fedml_tpu.algos.vertical_fl import VflAPI
 
 __all__ = [
     "FedAcAPI",
+    "FedAdapterAPI",
     "ServerAvgAPI",
     "DittoAPI",
     "FedBNAPI",

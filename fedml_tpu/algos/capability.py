@@ -65,6 +65,7 @@ ZOO = (
     ("SCAFFOLD", "scaffold", "ScaffoldAPI"),
     ("FedDyn", "feddyn", "FedDynAPI"),
     ("Ditto", "ditto", "DittoAPI"),
+    ("FedAdapter", "fedadapter", "FedAdapterAPI"),
     ("FedBN", "fedbn", "FedBNAPI"),
     ("FedGAN", "fedgan", "FedGanAPI"),
     ("FedNAS", "fednas", "FedNASAPI"),

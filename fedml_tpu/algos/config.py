@@ -140,6 +140,20 @@ class FedConfig:
     # Composes with cfg.compute_layout (the pad-on-entry physical twin
     # is cloned to the bf16 compute dtype).
     client_step_dtype: str = "fp32"
+    # Frozen-base adapter finetuning (models/adapter.py +
+    # algos/fedadapter.py, --adapter_rank/--adapter_scope): rank of the
+    # LoRA pairs injected next to the transformer's scoped projections
+    # (0 = dense training, the default). With rank > 0 the federated
+    # net IS the adapter tree — the base is frozen (fp32
+    # bitwise-invariant, test-pinned) and uploads carry adapter-only
+    # deltas that ride the negotiated delta+codec wire path
+    # (comm/codec.py DELTA_OK_KEY). Read by FedAdapterAPI (simulator
+    # tiers) and build_federation_setup (message-passing tiers); every
+    # other driver refuses the flags loudly (exp/args.py
+    # reject_adapter_flags, the PR 4/14 convention). adapter_scope:
+    # "attn" (qkv + attention out), "mlp", or "all".
+    adapter_rank: int = 0
+    adapter_scope: str = "attn"
     # Example-level DP-SGD on clients (new capability — the reference only
     # has server-side weak DP, robust_aggregation.py:49-53): per-example
     # gradient clipping at this L2 norm (0 disables) and Gaussian noise of

@@ -81,6 +81,17 @@ class FedAsyncServerManager(ServerManager):
     version counts server updates (the async analogue of the round index).
     """
 
+    #: Negotiated delta capability (comm/codec.py DELTA_OK_KEY): whether
+    #: this server's ``_ingest`` folds uploads as DELTAS against the
+    #: model the client pulled. The pure-async mix consumes FULL models
+    #: (``net <- (1-w)·net + w·upload``); the buffered subclass
+    #: (fedbuff.py) consumes deltas. Advertised on every init/assignment
+    #: handshake, and a stamped upload whose framing mismatches is
+    #: REFUSED (evict-and-release) instead of mis-folded — a delta mixed
+    #: as a full model (or vice versa) corrupts the global with no error
+    #: anywhere.
+    _accepts_delta_frames = False
+
     def __init__(self, args, net, cfg: FedConfig, size: int,
                  backend: str = "LOOPBACK", alpha: float = 0.6,
                  staleness_exp: float = 0.5, eval_fn=None, test_data=None,
@@ -439,6 +450,7 @@ class FedAsyncServerManager(ServerManager):
             msg.add(MSG_ARG_KEY_MODEL_VERSION, 0)
             msg.add(MSG_ARG_KEY_TASK_SEQ, self._next_task(worker))
             msg.add(wire_codec.OFFER_KEY, wire_codec.codec_offer())
+            msg.add(wire_codec.DELTA_OK_KEY, self._accepts_delta_frames)
             self._last_progress[worker] = self._clock()
             try:
                 self.send_message(msg)
@@ -458,6 +470,7 @@ class FedAsyncServerManager(ServerManager):
         out.add(MSG_ARG_KEY_MODEL_VERSION, self.version)
         out.add(MSG_ARG_KEY_TASK_SEQ, self._next_task(worker))
         out.add(wire_codec.OFFER_KEY, wire_codec.codec_offer())
+        out.add(wire_codec.DELTA_OK_KEY, self._accepts_delta_frames)
         if recovery:
             # Stalled-worker recovery: tell the client which TASK we
             # last ACCEPTED from it, so a worker that is merely SLOW (its
@@ -505,6 +518,22 @@ class FedAsyncServerManager(ServerManager):
                                    task_seq=task)
                 return
             self._last_upload_task[worker] = task
+        # Negotiated delta capability (PR 15): a STAMPED upload whose
+        # framing mismatches what this tier's _ingest consumes would be
+        # silently mis-folded (a delta mixed as a full model, or a full
+        # model buffered as a delta) — refuse it like a corrupt frame.
+        # Unstamped (legacy / hand-built protocol-test) messages keep
+        # the tier's historical interpretation.
+        stamped_delta = msg.get(wire_codec.DELTA_KEY)
+        if (stamped_delta is not None
+                and bool(stamped_delta) != self._accepts_delta_frames):
+            self._refuse_upload(worker, ValueError(
+                f"upload framed {'delta' if stamped_delta else 'full-model'}"
+                f" but this server ingests "
+                f"{'deltas' if self._accepts_delta_frames else 'full models'}"
+                " — negotiate the delta capability (DELTA_OK_KEY) or run "
+                "the matching tier"), task_seq=task)
+            return
         tr = obs_trace.active()
         ck = obs_trace.corr(round=self.version, sender=worker,
                             task_seq=task)
@@ -699,6 +728,13 @@ class FedAsyncClientManager(ClientManager):
             self._codec = wire_codec.negotiated_codec(
                 self._codec_requested, msg.get(wire_codec.OFFER_KEY),
                 peer="server")
+            if self._payload_is_delta:
+                # Delta capability (PR 15): this client's uploads are
+                # deltas against the pulled model — a server that never
+                # advertised delta acceptance would mix them as full
+                # models. No safe fallback exists; refuse loudly.
+                wire_codec.require_delta_peer(
+                    msg.get(wire_codec.DELTA_OK_KEY), peer="server")
         rng = jax.random.fold_in(
             jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), self.steps),
             self.rank)
@@ -719,6 +755,9 @@ class FedAsyncClientManager(ClientManager):
                 wire_codec.frame_seed(self.cfg.seed, self.rank, task))
             out.add(wire_codec.CODEC_KEY, self._codec.name)
         out.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
+        # Self-describing framing (PR 15): the server refuses a stamp
+        # that mismatches its ingest instead of mis-folding it.
+        out.add(wire_codec.DELTA_KEY, self._payload_is_delta)
         out.add(MSG_ARG_KEY_NUM_SAMPLES, int(self.train_fed.counts[c]))
         out.add(MSG_ARG_KEY_MODEL_VERSION, version)
         out.add(MSG_ARG_KEY_TASK_SEQ, task)
@@ -751,6 +790,7 @@ def FedML_FedAsync_distributed(
     idle_timeout_s: float = 0.0,
     metrics=None,
     trace_dir: Optional[str] = None,
+    pretrained_params=None,
 ):
     """Run the async federation: ``cfg.comm_round`` server model updates
     (arrivals, not barrier rounds) across ``cfg.client_num_per_round``
@@ -765,7 +805,7 @@ def FedML_FedAsync_distributed(
     the sync tier (obs/trace.py)."""
     size, net0, local_train, eval_fn, args = build_federation_setup(
         model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos,
-        loopback_wire=loopback_wire)
+        loopback_wire=loopback_wire, pretrained_params=pretrained_params)
     server = FedAsyncServerManager(args, net0, cfg, size, backend=backend,
                                    alpha=alpha, staleness_exp=staleness_exp,
                                    eval_fn=eval_fn, test_data=test_global,
@@ -780,4 +820,5 @@ def FedML_FedAsync_distributed(
     with obs_trace.tracing_to(trace_dir):
         run_workers([server.run] + [c.run for c in clients])
     server.final_health = server.health()
+    server.adapter_holder = args.adapter_holder
     return server

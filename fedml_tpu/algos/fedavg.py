@@ -117,7 +117,18 @@ class FedAvgAPI(FederatedLoop):
         self.mesh = mesh
         self.train_fed = train_fed
         self.test_global = test_global
-        self.fns = model_fns(model)
+        if getattr(cfg, "adapter_rank", 0) and not self._consumes_adapter_cfg:
+            # PR 4 convention: cfg.adapter_rank configures the frozen-
+            # base adapter finetune (FedAdapterAPI on the simulator
+            # tiers; the message-passing setups read it directly) — on
+            # any other class the flag would silently train the DENSE
+            # arm while the user believes adapters are on.
+            raise NotImplementedError(
+                f"cfg.adapter_rank={cfg.adapter_rank} configures frozen-"
+                "base adapter finetuning; use FedAdapterAPI (algos/"
+                f"fedadapter.py) — on {type(self).__name__} the flag "
+                "would be silently inert")
+        self.fns = self._model_fns(model)
         self._streaming = isinstance(train_fed, FederatedStore)
         if self._streaming and not type(self).supports_streaming:
             raise NotImplementedError(
@@ -387,6 +398,19 @@ class FedAvgAPI(FederatedLoop):
         self.round_fn = jax.jit(round_fn)
 
     # --- hooks subclasses override (FedOpt/FedProx/...) -------------------
+    #: Set True by the one subclass that READS cfg.adapter_rank
+    #: (FedAdapterAPI); everyone else refuses the flag at construction.
+    _consumes_adapter_cfg = False
+
+    def _model_fns(self, model):
+        """The functional model interface every round/eval builder uses.
+        FedAdapterAPI overrides this to return the adapter-level fns
+        (``init`` → the trainable ADAPTER tree, ``apply`` → frozen base
+        merged with the adapters per call), so the whole FedAvg
+        machinery — aggregation, codecs, checkpoints, the scan tiers —
+        operates on the adapter tree without modification."""
+        return model_fns(model)
+
     def _net_init_input(self, sample_x):
         """The array handed to ``fns.init`` (and the compute layout).
         Defaults to a sample data batch; models initialized from a

@@ -67,6 +67,7 @@ from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.obs import trace as obs_trace
 from fedml_tpu.obs.registry import MetricsRegistry, payload_nbytes
 from fedml_tpu.trainer.local import (
+    NetState,
     make_client_optimizer,
     make_eval_fn,
     make_local_train_fn_from_cfg,
@@ -435,6 +436,9 @@ class FedAVGServerManager(ServerManager):
             msg.add("round", self.round_idx)
             msg.add("epoch", self.epoch)
             msg.add(wire_codec.OFFER_KEY, wire_codec.codec_offer())
+            # Negotiated delta capability (PR 15): this server decodes
+            # delta-framed uploads against the round's broadcast anchor.
+            msg.add(wire_codec.DELTA_OK_KEY, True)
             self._safe_send(msg, worker)
 
     def register_message_receive_handlers(self) -> None:
@@ -546,6 +550,7 @@ class FedAVGServerManager(ServerManager):
         # Negotiation rides every assignment (not just init): a worker
         # re-admitted after the init was lost still learns the offer.
         out.add(wire_codec.OFFER_KEY, wire_codec.codec_offer())
+        out.add(wire_codec.DELTA_OK_KEY, True)
         if resend:
             # Re-admission: the worker's upload (or our assignment) was
             # lost — a client that already trained this round should
@@ -728,6 +733,12 @@ class FedAVGServerManager(ServerManager):
         payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         codec = msg.get("compression")
         wcodec = msg.get(wire_codec.CODEC_KEY)
+        # The negotiated delta capability (PR 15): a stamped upload
+        # self-describes whether its payload is a delta against this
+        # round's broadcast anchor. Legacy/unstamped frames keep the
+        # historical contract (codec frames are deltas, raw frames full
+        # models).
+        is_delta = bool(msg.get(wire_codec.DELTA_KEY))
         tr = obs_trace.active()
         ck = obs_trace.corr(epoch=self.epoch, round=t, sender=sender)
         self._h_bytes.record(payload_nbytes(payload))
@@ -744,7 +755,8 @@ class FedAVGServerManager(ServerManager):
             # barrier and evict-and-released there (_settle_pool).
             self._g_pool_queue.set(self._pool.queue_depth())
             self._submit_ingest(sender, t, payload, codec, wcodec,
-                                float(msg.get(MSG_ARG_KEY_NUM_SAMPLES)), ck)
+                                float(msg.get(MSG_ARG_KEY_NUM_SAMPLES)), ck,
+                                is_delta=is_delta)
             with self._lock:
                 self._arrived.add(sender)
                 ready = len(self._arrived) >= self._k_effective()
@@ -810,6 +822,16 @@ class FedAVGServerManager(ServerManager):
                     self._complete_round()
                 return
             self._h_decode.record((time.perf_counter() - t0) * 1e3)
+        elif is_delta:
+            # Raw tensor-framed delta (the negotiated capability without
+            # a codec — e.g. an adapter client on the plain tensor
+            # wire): reconstruct against the round's broadcast anchor,
+            # same discipline as the codec paths above.
+            t0 = time.perf_counter()
+            with tr.span("ingest.decode", cat="ingest", corr=ck,
+                         codec="delta"):
+                payload = tree_add(self._broadcast_net, payload)
+            self._h_decode.record((time.perf_counter() - t0) * 1e3)
         t0 = time.perf_counter()
         with tr.span("ingest.fold", cat="ingest", corr=ck):
             self.aggregator.add_local_trained_result(
@@ -823,11 +845,13 @@ class FedAVGServerManager(ServerManager):
             self._complete_round()
 
     def _submit_ingest(self, sender: int, round_idx: int, payload, codec,
-                       wcodec, weight: float, ck) -> None:
+                       wcodec, weight: float, ck, *,
+                       is_delta: bool = False) -> None:
         """Build one upload's decode+fold task and hand it to the pool.
         The closure snapshots this round's broadcast anchor (compressed
-        uploads are deltas against it) so a late-running task cannot
-        reconstruct against the NEXT round's net."""
+        uploads — and raw frames stamped delta — are deltas against it)
+        so a late-running task cannot reconstruct against the NEXT
+        round's net."""
         anchor = self._broadcast_net
         spec = self._spec
 
@@ -838,6 +862,8 @@ class FedAVGServerManager(ServerManager):
                 delta = self._decoders[codec].decode(payload, spec)
             elif wcodec:
                 delta = self._wire_decoders.decode(wcodec, payload, spec)
+            elif is_delta:
+                delta = payload  # raw tensor-framed delta (PR 15)
             else:
                 delta = None
             if delta is None:
@@ -976,6 +1002,7 @@ class FedAVGClientManager(ClientManager):
         wire_codec.make_wire_codec(wire_codec_spec)
         self._codec_requested = wire_codec_spec or "none"
         self._codec = None  # set by negotiation on the first assignment
+        self._delta_ok = False  # ditto (PR 15 delta capability)
         # The last upload message, kept until the NEXT round's assignment
         # arrives: a RESEND-flagged re-assignment of the round we already
         # trained means our upload was lost in transit (the server flags
@@ -1092,6 +1119,14 @@ class FedAVGClientManager(ClientManager):
             self._codec = wire_codec.negotiated_codec(
                 self._codec_requested, msg.get(wire_codec.OFFER_KEY),
                 peer="server")
+            # Delta capability (PR 15): compressed/codec uploads ship
+            # DELTAS against the broadcast anchor — a server that never
+            # advertised delta acceptance would mis-fold them as full
+            # models, so REFUSE loudly instead of corrupting the global.
+            self._delta_ok = bool(msg.get(wire_codec.DELTA_OK_KEY))
+            if (self._compressor.name != "none"
+                    or self._codec.name != "none"):
+                wire_codec.require_delta_peer(self._delta_ok, peer="server")
         self._train(msg.get(MSG_ARG_KEY_MODEL_PARAMS), msg.get(MSG_ARG_KEY_CLIENT_INDEX))
 
     def _train(self, global_net, client_index: int) -> None:
@@ -1142,8 +1177,10 @@ class FedAVGClientManager(ClientManager):
                 out.add("compression", self._compressor.name)
             self._ef_state = (self.round_idx, c, residual)
             out.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
+            out.add(wire_codec.DELTA_KEY, True)
         else:
             out.add(MSG_ARG_KEY_MODEL_PARAMS, jax.device_get(net))
+            out.add(wire_codec.DELTA_KEY, False)
         if tr.enabled:
             # delta + encode (or the plain device_get) — the client half
             # of the upload lifecycle, correlated with the server's
@@ -1165,7 +1202,8 @@ class FedAVGClientManager(ClientManager):
 def build_federation_setup(model, train_fed: FederatedArrays, test_global,
                            cfg: FedConfig, backend: str, loss_fn,
                            chaos: Optional[ChaosSpec] = None,
-                           loopback_wire: str = "none"):
+                           loopback_wire: str = "none",
+                           pretrained_params=None):
     """Shared worker-process scaffolding for the message-passing
     federations (sync FedAvg here, async in fedasync.py): model fns +
     initial net, jitted local trainer / eval, and the backend ``args``
@@ -1174,6 +1212,12 @@ def build_federation_setup(model, train_fed: FederatedArrays, test_global,
     through that real wire format — bytes in the inboxes, ByteLedger
     counters live — so single-host drills measure bytes-on-wire and
     exercise the full serialize path).
+
+    ``pretrained_params`` warm-starts the federation from a dense
+    checkpoint's param tree (the finetuning story): dense mode replaces
+    ``net0.params`` (structure-checked); adapter mode
+    (``cfg.adapter_rank > 0``) freezes it as the BASE while the
+    adapters keep their exact-identity init.
     Returns ``(size, net0, local_train, eval_fn, args)``."""
     size = cfg.client_num_per_round + 1
     if getattr(cfg, "compute_layout", "none") not in ("none", ""):
@@ -1199,9 +1243,41 @@ def build_federation_setup(model, train_fed: FederatedArrays, test_global,
             "cfg.group_reduce shrinks the client-MESH collective "
             "(parallel/shard.py); the message-passing tiers aggregate "
             "on the server host — drop the flag")
-    fns = model_fns(model)
+    adapter_holder = None
+    if int(getattr(cfg, "adapter_rank", 0) or 0):
+        # Frozen-base adapter finetuning (PR 15, models/adapter.py): the
+        # federation's net — on the wire, in the server accumulator, in
+        # the codecs' tree_spec — is the ADAPTER tree alone. The base is
+        # initialized deterministically once per process and captured by
+        # jit as device constants; it never crosses the wire, so
+        # bytes/upload shrink by the rank ratio BEFORE any codec runs.
+        # adapter_model_fns refuses a dense model loudly (an adapter
+        # config silently training the dense arm is the drift the
+        # reject_adapter_flags convention exists to prevent).
+        from fedml_tpu.models.adapter import adapter_model_fns
+
+        adapter_holder = {}
+        fns = adapter_model_fns(model, holder=adapter_holder,
+                                base_params=pretrained_params)
+    else:
+        fns = model_fns(model)
     sample_x = jnp.zeros((1,) + train_fed.x.shape[3:], train_fed.x.dtype)
     net0 = fns.init(jax.random.PRNGKey(cfg.seed), sample_x)
+    if pretrained_params is not None and adapter_holder is None:
+        # Dense warm start: swap the checkpoint's params in for the
+        # fresh init's (same structure or refuse — a silently reshaped
+        # warm start would train the wrong geometry).
+        want = jax.tree.structure(net0.params)
+        got = jax.tree.structure(pretrained_params)
+        if want != got:
+            raise ValueError(
+                f"pretrained_params structure {got} does not match the "
+                f"model's param tree {want}")
+        net0 = NetState(jax.tree.map(jnp.asarray, pretrained_params),
+                        net0.model_state)
+    # Exposed for adapter drills (frozen-base invariance pins): the
+    # holder's "base" entry is the device-resident frozen tree.
+    args_adapter_holder = adapter_holder
     optimizer = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
     local_train = jax.jit(
         make_local_train_fn_from_cfg(fns.apply, optimizer, cfg, loss_fn=loss_fn)
@@ -1213,6 +1289,10 @@ def build_federation_setup(model, train_fed: FederatedArrays, test_global,
 
     args = Args()
     args.chaos = chaos
+    # None for dense federations; adapter mode's {"base": frozen tree}
+    # — drills pin the base's bitwise invariance through it, and the
+    # runners stamp it onto the returned server/aggregator.
+    args.adapter_holder = args_adapter_holder
     if backend == "LOOPBACK":
         args.network = LoopbackNetwork(size, wire=loopback_wire)
     elif backend == "SIM":
@@ -1247,6 +1327,7 @@ def FedML_FedAvg_distributed(
     metrics=None,
     idle_timeout_s: float = 0.0,
     trace_dir: Optional[str] = None,
+    pretrained_params=None,
 ):
     """Build server + ``client_num_per_round`` workers on the chosen backend
     and run the full federation (FedAvgAPI.py:20 analogue). Returns the
@@ -1285,7 +1366,7 @@ def FedML_FedAvg_distributed(
     abort / codec refusal. ``None`` (the default) is the no-op path."""
     size, net0, local_train, eval_fn, args = build_federation_setup(
         model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos,
-        loopback_wire=loopback_wire)
+        loopback_wire=loopback_wire, pretrained_params=pretrained_params)
     agg = FedAVGAggregator(net0, size - 1, cfg, eval_fn, test_global,
                            aggregator=aggregator)
     server = FedAVGServerManager(args, agg, cfg, size, backend=backend,
@@ -1307,4 +1388,5 @@ def FedML_FedAvg_distributed(
     # the final snapshots onto the returned aggregator.
     agg.final_health = server.health()
     agg.ingest_profile = server.ingest_profile()
+    agg.adapter_holder = args.adapter_holder
     return agg

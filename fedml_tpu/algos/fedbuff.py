@@ -98,6 +98,12 @@ class FedBuffServerManager(FedAsyncServerManager):
     ``mean`` keeps the O(model) accumulate-on-arrival fast path.
     """
 
+    #: The buffered tier folds DELTAS (client ships net − pulled model);
+    #: advertised via the negotiated delta capability (PR 15) — a
+    #: full-model-stamped upload is refused instead of buffered as a
+    #: delta.
+    _accepts_delta_frames = True
+
     def __init__(self, args, net, cfg: FedConfig, size: int,
                  backend: str = "LOOPBACK", alpha: float = 1.0,
                  staleness_exp: float = 0.5, buffer_k: int = 2,
@@ -341,6 +347,7 @@ def FedML_FedBuff_distributed(
     corruptor=None,
     metrics=None,
     trace_dir=None,
+    pretrained_params=None,
 ):
     """Run the buffered federation: ``cfg.comm_round`` server
     AGGREGATIONS (each consuming ``buffer_k`` arrivals) across
@@ -352,7 +359,7 @@ def FedML_FedBuff_distributed(
     ``trace_dir`` arms the flight recorder + span tracer (obs/trace.py)."""
     size, net0, local_train, eval_fn, args = build_federation_setup(
         model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos,
-        loopback_wire=loopback_wire)
+        loopback_wire=loopback_wire, pretrained_params=pretrained_params)
     server = FedBuffServerManager(
         args, net0, cfg, size, backend=backend, alpha=alpha,
         staleness_exp=staleness_exp, buffer_k=buffer_k,
@@ -370,4 +377,5 @@ def FedML_FedBuff_distributed(
     with obs_trace.tracing_to(trace_dir):
         run_workers([server.run] + [c.run for c in clients])
     server.final_health = server.health()
+    server.adapter_holder = args.adapter_holder
     return server

@@ -70,6 +70,20 @@ def tree_spec(tree) -> "TreeSpec":
 CODEC_KEY = "wire_codec"
 #: Handshake key: the peer's advertised stage names (negotiation offer).
 OFFER_KEY = "codec_offer"
+#: Handshake key: the receiver ACCEPTS delta-framed uploads (sync tier:
+#: anchor-based reconstruction against the round's broadcast net; async
+#: tiers: the additive staleness-discounted fold). Promoted from a
+#: FedBuff-only client-class attribute into a negotiated per-connection
+#: capability (PR 15) so sync + async + fedbuff all accept delta frames
+#: — and a delta sender REFUSES loudly against a delta-ignorant peer
+#: (:func:`require_delta_peer`) instead of letting it mis-fold the delta
+#: as a full model.
+DELTA_OK_KEY = "delta_frames_ok"
+#: Upload message key: True = the payload is a DELTA against the model
+#: the sender pulled; False = a full model. Absent = a legacy peer —
+#: each tier keeps its historical interpretation (sync/async full,
+#: fedbuff delta) for hand-built protocol-test messages.
+DELTA_KEY = "payload_is_delta"
 
 #: Stage names this build implements — the negotiation offer.
 SUPPORTED_STAGES = ("bf16", "fp16", "int8", "topk", "randmask")
@@ -520,6 +534,22 @@ def frame_seed(*vals: int) -> int:
 def codec_offer() -> List[str]:
     """What a peer advertises in the handshake (``OFFER_KEY``)."""
     return list(SUPPORTED_STAGES)
+
+
+def require_delta_peer(offer_flag, *, peer: str = "peer") -> None:
+    """Loud refusal of delta uploads against a delta-ignorant peer: a
+    receiver that never advertised ``DELTA_OK_KEY`` would fold the delta
+    frame AS a full model (or buffer a full model as a delta) —
+    silently corrupting the global with no error anywhere. Unlike codec
+    negotiation there is no safe fallback to degrade to: the sender's
+    protocol (FedBuff's delta uploads, an adapter federation) REQUIRES
+    delta semantics, so the connection must refuse, not limp."""
+    if not offer_flag:
+        raise ValueError(
+            f"delta uploads required but the {peer} is delta-ignorant "
+            f"(no {DELTA_OK_KEY!r} in its handshake): it would mis-fold "
+            "a delta frame as a full model — upgrade the peer or run a "
+            "full-model tier")
 
 
 def stage_names_of(spec: str) -> List[str]:

@@ -111,6 +111,13 @@ def make_stackoverflow_shard(
     seq_len: int = 20,
     vocab: int = 10004,
     seed: int = 0,
+    law: str = "uniform",
+    kgroup: int = 8,
+    active_tokens: int = 64,
+    peak: float = 0.9,
+    dialect_seed: int = 0,
+    group_offset: int = 0,
+    count_scale: int = 1,
 ):
     """ONE shard's worth of the StackOverflow-NWP law — ``(x, y,
     counts)`` with pareto per-client sentence counts and next-token
@@ -119,12 +126,68 @@ def make_stackoverflow_shard(
     federation from it, and ``bench.py``'s million-client
     ``synthetic_1m`` section feeds it per shard to
     ``ShardedFederatedStore.from_shard_builder`` — the 342k and 1M
-    scale points can never drift apart in law."""
+    scale points can never drift apart in law.
+
+    ``law`` picks the TOKEN law (the count law is shared, so the two
+    laws emit identical per-client sizes at one ``seed``):
+
+    - ``"uniform"`` (default, stream-identical to the pre-PR-15 code):
+      i.i.d. tokens over [1, vocab) — the throughput/scale shape, no
+      learnable signal.
+    - ``"dialect"``: the LEARNABLE personalization law the adapter
+      finetune measures against (transformer-consumable next-word
+      prediction). All clients share one ``active_tokens``-sized
+      vocabulary subset, but client ``c`` follows dialect ``(c +
+      group_offset) % kgroup``'s OWN successor permutation over it
+      (with prob ``peak``; else a uniform jump within the subset) — the
+      same token has ``kgroup`` plausible successors, so a GLOBAL model
+      is capped near ``peak/kgroup`` plus whatever in-context dialect
+      inference it learns, while a client-personalized model can reach
+      ``peak``. Dialect tables draw from ``dialect_seed`` (independent
+      of ``seed``), so a held-out split (different ``seed``) shares the
+      dialects; ``group_offset`` keeps per-shard builders' dialect
+      assignment keyed on GLOBAL client ids.
+
+    ``count_scale`` multiplies the pareto per-client sentence counts
+    (same SHAPE, more mass — the personalization drills need enough
+    per-client transitions to cover a dialect table); 1 (default) keeps
+    the count stream bit-identical to the pre-PR-15 law."""
     rng = np.random.RandomState(seed)
     counts = 1 + (rng.pareto(1.5, n_clients) * 4).astype(np.int64).clip(0, 63)
+    if count_scale != 1:
+        counts = counts * int(count_scale)
     tot = int(counts.sum())
-    x = rng.randint(1, vocab, (tot, seq_len)).astype(np.int32)
-    y = np.roll(x, -1, axis=1)
+    if law == "uniform":
+        x = rng.randint(1, vocab, (tot, seq_len)).astype(np.int32)
+        y = np.roll(x, -1, axis=1)
+        return x, y, counts
+    if law != "dialect":
+        raise ValueError(f"unknown token law {law!r}: expected "
+                         "uniform | dialect")
+    if not 1 <= active_tokens <= vocab - 1:
+        raise ValueError(
+            f"active_tokens={active_tokens} must fit in [1, vocab) "
+            f"(vocab={vocab})")
+    trng = np.random.RandomState((dialect_seed * 0x9E3779B1 + 0xD1A7)
+                                 % (2 ** 31))
+    subset = trng.choice(np.arange(1, vocab, dtype=np.int64),
+                         size=active_tokens, replace=False)
+    perms = np.stack([trng.permutation(active_tokens)
+                      for _ in range(kgroup)])
+    seq_group = np.repeat(
+        (group_offset + np.arange(n_clients, dtype=np.int64)) % kgroup,
+        counts)
+    toks = np.empty((tot, seq_len + 1), np.int64)
+    cur = rng.randint(0, active_tokens, tot)
+    toks[:, 0] = cur
+    for t in range(1, seq_len + 1):
+        follow = rng.rand(tot) < peak
+        jump = rng.randint(0, active_tokens, tot)
+        cur = np.where(follow, perms[seq_group, cur], jump)
+        toks[:, t] = cur
+    seqs = subset[toks]
+    x = seqs[:, :seq_len].astype(np.int32)
+    y = seqs[:, 1:].astype(np.int32)
     return x, y, counts
 
 
@@ -133,6 +196,7 @@ def make_stackoverflow_nwp(
     seq_len: int = 20,
     vocab: int = 10004,
     seed: int = 0,
+    **law_kw,
 ):
     """StackOverflow-NWP-shaped synthetic federation at any client count
     (the real set enumerates 342,477 users — reference
@@ -140,8 +204,11 @@ def make_stackoverflow_nwp(
     next-token targets, tokens drawn from [1, vocab) so pad_id=0 never
     collides. Returns ``(x, y, client_indices)`` for FederatedStore /
     build_federated_arrays. Shared by the full-scale store test and the
-    bench submetric so the two can never drift."""
-    x, y, counts = make_stackoverflow_shard(n_clients, seq_len, vocab, seed)
+    bench submetric so the two can never drift. ``law_kw`` forwards the
+    token-law knobs (``law="dialect"`` + friends) to
+    :func:`make_stackoverflow_shard`."""
+    x, y, counts = make_stackoverflow_shard(n_clients, seq_len, vocab, seed,
+                                            **law_kw)
     edges = np.concatenate([[0], np.cumsum(counts)])
     parts = {c: np.arange(edges[c], edges[c + 1]) for c in range(n_clients)}
     return x, y, parts

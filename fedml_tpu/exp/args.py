@@ -205,6 +205,18 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="server-averaging mix β toward the running mean "
                         "of past globals (0 = FedAvg; --algorithm "
                         "ServerAvg)")
+    p.add_argument("--adapter_rank", type=int, default=0,
+                   help="frozen-base adapter finetuning (FedAdapter / "
+                        "the async tiers' adapter-delta uploads): rank "
+                        "of the LoRA pairs injected next to the "
+                        "transformer's scoped dense projections; 0 "
+                        "(default) trains the dense model. Drivers that "
+                        "never read it refuse loudly "
+                        "(reject_adapter_flags)")
+    p.add_argument("--adapter_scope", type=str, default="attn",
+                   choices=["attn", "mlp", "all"],
+                   help="which projections get adapter pairs: attention "
+                        "qkv+out, the MLP pair, or both")
     p.add_argument("--dp_clip", type=float, default=0.0,
                    help="example-level DP-SGD: per-example grad L2 clip "
                         "(0 disables DP)")
@@ -291,6 +303,27 @@ def reject_pod_plane_flags(args, algorithm: str) -> None:
             "would be silently inert here)")
 
 
+def reject_adapter_flags(args, algorithm: str) -> None:
+    """Refuse the frozen-base adapter knobs for drivers that never read
+    them (the PR 4/14 flag-rejection convention): ``--adapter_rank`` /
+    ``--adapter_scope`` configure the LoRA finetune (``FedAdapter`` in
+    exp/run.py; the FedAsync/FedBuff runners' adapter-delta uploads via
+    ``cfg.adapter_rank``). A specialty driver that silently trained the
+    DENSE arm under them would report the wrong experiment — the exact
+    baseline-as-treated-arm drift this convention exists to refuse."""
+    bad = []
+    if getattr(args, "adapter_rank", 0):
+        bad.append(f"--adapter_rank {args.adapter_rank}")
+    if getattr(args, "adapter_scope", "attn") != "attn":
+        bad.append(f"--adapter_scope {args.adapter_scope}")
+    if bad:
+        raise SystemExit(
+            f"{algorithm} does not support {', '.join(bad)}: frozen-base "
+            "adapter finetuning rides FedAdapter (exp/run.py) and the "
+            "FedAsync/FedBuff adapter-delta uploads only — the flag "
+            "would silently train the dense arm here")
+
+
 def reject_ingest_pool_flag(args, algorithm: str) -> None:
     """Refuse ``--ingest_workers`` for runners with no message-passing
     server dispatch thread to parallelize (the PR 4/6 flag-rejection
@@ -361,6 +394,8 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         dp_noise_multiplier=args.dp_noise_multiplier,
         compute_layout=args.compute_layout,
         client_step_dtype=args.client_step_dtype,
+        adapter_rank=int(getattr(args, "adapter_rank", 0) or 0),
+        adapter_scope=getattr(args, "adapter_scope", "attn"),
         group_reduce=bool(getattr(args, "group_reduce", False)),
         client_selection=args.client_selection,
         pow_d_candidates=args.pow_d_candidates,

@@ -25,7 +25,8 @@ def run_centralized(args):
     from functools import partial
 
     from fedml_tpu.algos.centralized import CentralizedTrainer
-    from fedml_tpu.exp.args import config_from_args, reject_pod_plane_flags
+    from fedml_tpu.exp.args import (config_from_args, reject_adapter_flags,
+                                    reject_pod_plane_flags)
     from fedml_tpu.exp.run import SEQ_DATASETS
 
     # The pooled baseline has no client step and no client axis — every
@@ -33,6 +34,10 @@ def run_centralized(args):
     # mesh factorization) would be silently inert here, skewing any A/B
     # that uses this anchor.
     reject_pod_plane_flags(args, "the centralized baseline")
+    # The frozen-base adapter finetune is a FEDERATED wire/perf story;
+    # the pooled baseline trains every param — --adapter_rank here
+    # would report an "adapter" anchor that actually trained dense.
+    reject_adapter_flags(args, "the centralized baseline")
     from fedml_tpu.exp.setup import (
         build_mesh,
         create_model_for,

@@ -99,7 +99,8 @@ def main(argv=None):
             f"--client_selection {args.client_selection} is a simulator "
             "feature; the cross-silo server samples uniformly (it has no "
             "access to silo-local losses before assignment)")
-    from fedml_tpu.exp.args import (reject_async_tier_flags,
+    from fedml_tpu.exp.args import (reject_adapter_flags,
+                                    reject_async_tier_flags,
                                     reject_fedavg_family_flags,
                                     reject_pod_plane_flags)
 
@@ -114,6 +115,10 @@ def main(argv=None):
     # compute-plane knobs (bf16 client step, DCN group reduce, the mesh
     # factorization) reach this path.
     reject_pod_plane_flags(args, "the cross-silo pipeline")
+    # Ditto the frozen-base adapter knobs: the silo trainer below is
+    # built from plain model_fns, so --adapter_rank would silently
+    # train the dense arm while reporting the adapter experiment.
+    reject_adapter_flags(args, "the cross-silo pipeline")
 
     logging.basicConfig(
         level=logging.INFO,

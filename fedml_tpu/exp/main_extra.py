@@ -18,6 +18,7 @@ import logging
 import numpy as np
 
 from fedml_tpu.exp.args import (add_args, config_from_args,
+                                reject_adapter_flags,
                                 reject_async_tier_flags,
                                 reject_fedavg_family_flags,
                                 reject_ingest_pool_flag,
@@ -283,8 +284,21 @@ def main(argv=None):
                 f"{args.algorithm} does not support --dcn_hosts "
                 f"{args.dcn_hosts}: the async tiers shard by rank, not "
                 "over a device mesh (the flag would be silently inert)")
+        # The async tiers DO run the frozen-base adapter finetune
+        # (cfg.adapter_rank via build_federation_setup), but only a
+        # transformer model has injection sites — any other model would
+        # refuse deep inside adapter_model_fns; name the fix here.
+        if (getattr(args, "adapter_rank", 0)
+                and args.model != "transformer_lm"):
+            raise SystemExit(
+                f"--adapter_rank {args.adapter_rank} needs --model "
+                f"transformer_lm (got {args.model!r}): adapter "
+                "injection lives in models/transformer.py")
     else:
         reject_pod_plane_flags(args, args.algorithm)
+        # Non-async specialty loops never read the adapter knobs — the
+        # PR 4/14 convention: refuse, don't silently train dense.
+        reject_adapter_flags(args, args.algorithm)
     logging.basicConfig(level=logging.INFO,
                         format=f"[{args.algorithm} %(asctime)s] %(message)s")
     history = RUNNERS[args.algorithm](args)

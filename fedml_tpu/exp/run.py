@@ -54,6 +54,7 @@ def make_api(algorithm: str, args, model, arrays, test, cfg, mesh,
         common["pad_id"] = pad_id
     table = {
         "FedAvg": algos.FedAvgAPI,
+        "FedAdapter": algos.FedAdapterAPI,
         "FedAc": algos.FedAcAPI,
         "ServerAvg": algos.ServerAvgAPI,
         "FedOpt": algos.FedOptAPI,
@@ -73,6 +74,22 @@ def make_api(algorithm: str, args, model, arrays, test, cfg, mesh,
         common["q"] = args.qffl_q
     elif algorithm == "FedDyn":
         common["alpha"] = args.feddyn_alpha
+    elif algorithm == "FedAdapter":
+        if not int(getattr(args, "adapter_rank", 0) or 0):
+            raise SystemExit(
+                "FedAdapter needs --adapter_rank > 0 (the rank of the "
+                "LoRA pairs injected into the transformer; 0 would "
+                "silently train nothing)")
+        if args.model != "transformer_lm":
+            raise SystemExit(
+                f"FedAdapter needs --model transformer_lm (got "
+                f"{args.model!r}): adapter injection lives in "
+                "models/transformer.py")
+        if args.dataset not in SEQ_DATASETS:
+            raise SystemExit(
+                f"FedAdapter finetunes a token LM; --dataset "
+                f"{args.dataset!r} is not a sequence dataset "
+                f"(expected one of {sorted(SEQ_DATASETS)})")
     elif algorithm == "FedAc":
         common["gamma"] = getattr(args, "fedac_gamma", 2.0)
     elif algorithm == "ServerAvg":
@@ -118,11 +135,18 @@ def run(args, algorithm: str = "FedAvg"):
     # FedAsync/FedBuff runners and must refuse, not no-op. Same for the
     # parallel ingest pool: the simulator aggregates inside the jitted
     # round, there is no server dispatch thread to unblock.
-    from fedml_tpu.exp.args import (reject_async_tier_flags,
+    from fedml_tpu.exp.args import (reject_adapter_flags,
+                                    reject_async_tier_flags,
                                     reject_ingest_pool_flag)
 
     reject_async_tier_flags(args, algorithm)
     reject_ingest_pool_flag(args, algorithm)
+    if algorithm != "FedAdapter":
+        # Frozen-base adapter knobs configure FedAdapter only on this
+        # tier — on any other algorithm they would silently train the
+        # DENSE arm (the PR 4/14 convention; the FedAvgAPI constructor
+        # backstops cfg.adapter_rank the same way).
+        reject_adapter_flags(args, algorithm)
     fed, arrays, test, model, cfg, mesh = setup_standard(args)
     api = make_api(algorithm, args, model, arrays, test, cfg, mesh,
                    class_num=fed.class_num)

@@ -54,6 +54,16 @@ def create_model_for(args, fed: FederatedDataset):
         return create_model("rnn", vocab_size=ncls)
     if name == "cnn":
         return create_model("cnn", num_classes=ncls, only_digits=(ds == "mnist"))
+    if name == "transformer_lm":
+        # The adapter-finetune model (PR 15): vocab from the dataset,
+        # max_len from the loaded sequences, LoRA pairs injected when
+        # --adapter_rank is on (rank 0 leaves the param tree identical
+        # to the dense transformer).
+        return create_model(
+            "transformer_lm", vocab_size=ncls,
+            max_len=max(int(np.asarray(x0).shape[-1]), 32),
+            adapter_rank=int(getattr(args, "adapter_rank", 0) or 0),
+            adapter_scope=getattr(args, "adapter_scope", "attn"))
     return create_model(name, num_classes=ncls)
 
 

@@ -84,7 +84,8 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     for name in ("bench_femnist_cnn_3400", "bench_store_windowed",
                  "bench_store_windowed_fedopt", "bench_zoo_windowed",
                  "bench_robust_agg",
-                 "bench_chaos", "bench_wire_codec", "bench_ingest_profile",
+                 "bench_chaos", "bench_wire_codec", "bench_fed_adapter",
+                 "bench_ingest_profile",
                  "bench_serving_1m", "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
                  "bench_vit",
@@ -113,7 +114,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     # Every section that RAN finished inside the budget: elapsed at its
     # start + the full section cap fit under 300s.
     assert len(ran) * 50 + 100 <= 300
-    assert len(ran) + len(skipped) == 20
+    assert len(ran) + len(skipped) == 21
 
 
 def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
@@ -125,7 +126,8 @@ def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
     for name in ("bench_femnist_cnn_3400", "bench_store_windowed",
                  "bench_store_windowed_fedopt", "bench_zoo_windowed",
                  "bench_robust_agg",
-                 "bench_chaos", "bench_wire_codec", "bench_ingest_profile",
+                 "bench_chaos", "bench_wire_codec", "bench_fed_adapter",
+                 "bench_ingest_profile",
                  "bench_serving_1m", "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
                  "bench_vit",

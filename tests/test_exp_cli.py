@@ -33,6 +33,20 @@ def test_run_hierarchical():
     assert np.isfinite(history[-1]["train_loss"])
 
 
+def test_run_fedadapter():
+    """The adapter finetune CLI (PR 15): transformer + NWP + LoRA rank —
+    the frozen-base federation trains end to end from exp/run.py."""
+    args = parse_args([
+        "--model", "transformer_lm", "--dataset", "stackoverflow_nwp",
+        "--adapter_rank", "4", "--client_num_in_total", "8",
+        "--client_num_per_round", "4", "--batch_size", "4",
+        "--comm_round", "2", "--epochs", "1", "--lr", "0.1", "--ci", "1"])
+    api, history = run(args, algorithm="FedAdapter")
+    assert np.isfinite(history[-1]["train_loss"])
+    prof = api.adapter_profile()
+    assert 0 < prof["adapter_ratio"] < 0.5
+
+
 @pytest.mark.slow  # >20 s on the 2-core 870 s tier-1 budget box (r6 audit)
 
 def test_run_sequence_dataset():

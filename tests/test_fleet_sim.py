@@ -34,6 +34,7 @@ from fedml_tpu.algos.fedbuff import (
     FedBuffServerManager,
     FedML_FedBuff_distributed,
 )
+from fedml_tpu.comm import codec as wire_codec
 from fedml_tpu.comm.loopback import LoopbackNetwork
 from fedml_tpu.comm.message import Message
 from fedml_tpu.data.batching import batch_global, build_federated_arrays
@@ -289,6 +290,10 @@ def test_fedbuff_client_trains_same_version_new_task():
         m.add(MSG_ARG_KEY_MODEL_PARAMS, {"w": np.zeros(2, np.float32)})
         m.add(MSG_ARG_KEY_MODEL_VERSION, version)
         m.add(MSG_ARG_KEY_TASK_SEQ, task)
+        # The real server always advertises the delta capability (PR
+        # 15); a delta-shipping client refuses a delta-ignorant peer at
+        # negotiation (tests/test_fedadapter.py pins that refusal).
+        m.add(wire_codec.DELTA_OK_KEY, True)
         cm.handle_model(m)
 
     assign(0, 0)
