@@ -377,6 +377,82 @@ with tempfile.TemporaryDirectory() as td:
 print(f"ingest pool smoke OK: bit-equal nets, pool spans {per_worker}")
 PYEOF
 
+echo "== sharded aggregation plane: M=2 bit-equal to M=1 + forced eviction =="
+python - <<'PYEOF'
+import json, os, tempfile
+import numpy as np, jax
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedavg_distributed import (FedAVGAggregator,
+                                                FedML_FedAvg_distributed)
+from fedml_tpu.comm.loopback import LoopbackNetwork
+from fedml_tpu.comm.shardplane import (AggregatorShardManager,
+                                       ShardedFedAVGServerManager)
+from fedml_tpu.data.batching import batch_global, build_federated_arrays
+from fedml_tpu.data.partition import partition_homo
+from fedml_tpu.data.synthetic import make_classification
+from fedml_tpu.models.lr import LogisticRegression
+
+x, y = make_classification(240, n_features=16, n_classes=4, seed=1)
+fed = build_federated_arrays(x, y, partition_homo(len(x), 4), batch_size=16)
+test = batch_global(x[:64], y[:64], 16)
+
+def run(m):
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=2, epochs=2, batch_size=16, lr=0.3,
+                    frequency_of_the_test=1)
+    return FedML_FedAvg_distributed(
+        LogisticRegression(num_classes=4), fed, test, cfg,
+        wire_codec="topk0.25+int8", loopback_wire="tensor", agg_shards=m)
+
+a1, a2 = run(1), run(2)
+# The coordinator wire-merges the shards' int64 partials through the
+# same division site as the in-process pool: any M lands the
+# bit-identical net for the same arrivals.
+for l1, l2 in zip(jax.tree.leaves(a1.net), jax.tree.leaves(a2.net)):
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+h = a2.final_health
+assert h["shards"] == 2 and h["shard_evictions"] == 0, h
+assert h["bytes_rx"] > 0, h  # per-shard ByteLedger totals rolled up
+
+# Forced shard eviction (fake-clock protocol drive): shard 2 goes
+# silent past the heartbeat deadline — the coordinator evicts it and
+# the flight recorder persists the postmortem event.
+with tempfile.TemporaryDirectory() as td:
+    t = [0.0]
+    class A: pass
+    a = A(); a.network = LoopbackNetwork(7)
+    scfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                     comm_round=2, frequency_of_the_test=1000)
+    sagg = FedAVGAggregator({"w": np.zeros(8, np.float32)}, 4, scfg)
+    srv = ShardedFedAVGServerManager(a, sagg, scfg, 7, 2,
+                                     round_timeout_s=10.0,
+                                     clock=lambda: t[0], flight_dir=td)
+    shards = {r: AggregatorShardManager(a, r, 7, scfg,
+                                        {"w": np.zeros(8, np.float32)},
+                                        beat_interval_s=0.0,
+                                        clock=lambda: t[0])
+              for r in (1, 2)}
+    for mgr in [srv, *shards.values()]:
+        mgr.register_message_receive_handlers()
+    srv.send_init_msg()
+    t[0] = 99.0
+    srv.shard_heartbeat.beat(1)
+    srv._post_shard_tick([2])
+    for rank, mgr in [(0, srv), (1, shards[1]), (2, shards[2])]:
+        q = a.network.inbox(rank)
+        while not q.empty():
+            msg = q.get()
+            if hasattr(msg, "get_type"):
+                mgr.receive_message(msg.get_type(), msg)
+    assert srv.shard_evictions == 1 and srv.health()["shards"] == 1
+    fr = [json.loads(l)
+          for l in open(os.path.join(td, "flight_recorder.jsonl"))]
+    assert any(e["kind"] == "shard_eviction" for e in fr)
+print(f"shard plane smoke OK: M=2 bit-equal to M=1 "
+      f"(rx={h['bytes_rx']}B over {h['shards']} shards), forced "
+      "eviction flight-recorded")
+PYEOF
+
 echo "== obs smoke: flight recorder + span trace + ingest histograms =="
 python - <<'PYEOF'
 import json, os, tempfile

@@ -186,6 +186,16 @@ class FedConfig:
     # simulator tiers refuse the flag loudly (their rounds have no
     # dispatch thread to unblock).
     ingest_workers: int = 0
+    # Sharded aggregation plane (comm/shardplane.py, --agg_shards M):
+    # M aggregator-shard processes — each running the full codec
+    # negotiation + IngestPool + fixed-point fold over its own client
+    # partition — whose serialized int64 partials the rank-0 coordinator
+    # wire-merges BIT-EQUAL to the single-process pool (the same
+    # associativity proof as ingest_workers, one level up, over the
+    # wire). Mean aggregation + sync FedAvg only: FedAsync's sequential
+    # server mix and FedBuff's global-arrival-order buffer REFUSE the
+    # flag. 0 (default) keeps the single-server ingest path.
+    agg_shards: int = 0
     # Federation flight recorder (obs/trace.py, --trace at the CLI;
     # docs/OBSERVABILITY.md): record upload-lifecycle spans (client
     # serialize → wire → codec decode → accumulator fold → round commit,

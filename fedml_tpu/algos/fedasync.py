@@ -177,6 +177,19 @@ class FedAsyncServerManager(ServerManager):
         # bit-equal to inline). The buffered subclass (fedbuff.py)
         # defers decode AND fold into the pool and reaps the
         # parallelism; see _defer_decode.
+        shards = int(getattr(cfg, "agg_shards", 0) or 0)
+        if shards > 0:
+            # The sharded aggregation plane (comm/shardplane.py) is a
+            # sync-FedAvg capability: pure async mixes every arrival into
+            # the global SEQUENTIALLY (order-dependent), and fedbuff's
+            # buffer_k barriers on GLOBAL arrival order — neither has an
+            # associative partition for M shards to merge. Refuse loudly
+            # rather than run an unsharded server under a sharded flag.
+            raise ValueError(
+                f"agg_shards={shards} is a synchronous-FedAvg capability "
+                "(comm/shardplane.py): the async tiers' sequential mix / "
+                "global-arrival buffer cannot be partitioned across "
+                "aggregator shards — run with agg_shards=0")
         workers = int(getattr(cfg, "ingest_workers", 0) or 0)
         if workers > 0:
             from fedml_tpu.comm.ingest import IngestPool

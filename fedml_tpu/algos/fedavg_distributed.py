@@ -87,6 +87,10 @@ MSG_TYPE_SRV_TICK = 5
 MSG_ARG_KEY_MODEL_PARAMS = Message.MSG_ARG_KEY_MODEL_PARAMS
 MSG_ARG_KEY_CLIENT_INDEX = Message.MSG_ARG_KEY_CLIENT_INDEX
 MSG_ARG_KEY_NUM_SAMPLES = Message.MSG_ARG_KEY_NUM_SAMPLES
+# Sharded aggregation plane (comm/shardplane.py): the assignment stamps
+# the rank the worker must UPLOAD to. Absent (the single-server path)
+# means rank 0 — the coordinator itself ingests.
+MSG_ARG_KEY_SHARD_RANK = "shard_rank"
 
 log = logging.getLogger(__name__)
 
@@ -432,13 +436,15 @@ class FedAVGServerManager(ServerManager):
         for worker in self._members_snapshot():
             msg = Message(MSG_TYPE_S2C_INIT_CONFIG, 0, worker)
             msg.add(MSG_ARG_KEY_MODEL_PARAMS, self.aggregator.net)
-            msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[worker - 1]))
+            ci = int(client_indexes[self._worker_slot(worker)])
+            msg.add(MSG_ARG_KEY_CLIENT_INDEX, ci)
             msg.add("round", self.round_idx)
             msg.add("epoch", self.epoch)
             msg.add(wire_codec.OFFER_KEY, wire_codec.codec_offer())
             # Negotiated delta capability (PR 15): this server decodes
             # delta-framed uploads against the round's broadcast anchor.
             msg.add(wire_codec.DELTA_OK_KEY, True)
+            self._stamp_routing(msg, ci)
             self._safe_send(msg, worker)
 
     def register_message_receive_handlers(self) -> None:
@@ -467,15 +473,34 @@ class FedAVGServerManager(ServerManager):
     def _k_effective(self) -> int:
         return max(1, min(self.aggregate_k, len(self._members)))
 
+    def _worker_slot(self, worker: int) -> int:
+        """Worker rank → its 0-based slot in the round's sampled
+        ``client_indexes`` (also the aggregator's worker index). The
+        sharded coordinator re-bases this — its worker ranks start after
+        the M aggregator-shard ranks (comm/shardplane.py)."""
+        return worker - 1
+
+    def _stamp_routing(self, out: Message, client_index: int) -> None:
+        """Hook for the sharded aggregation plane: stamp the shard rank
+        this worker must upload to. The single-server path routes every
+        upload to rank 0 — nothing to stamp."""
+
     def health(self) -> Dict[str, int]:
         """Control-plane counters, surfaced per round through the metrics
         logger and asserted on by the fault drills. ``bytes_tx``/
         ``bytes_rx`` are the transport's ByteLedger totals (comm/wire.py)
         — bytes-on-wire observability for the codec A/B; 0 on backends
-        without wire serialization (plain in-memory loopback)."""
+        without wire serialization (plain in-memory loopback).
+        ``ingest_saturated`` is the lifetime count of clipped fixed-point
+        contributions (comm/ingest.py) — the sharded coordinator overrides
+        it with the fleet-wide sum over its shards' gauges."""
         ledger = getattr(self.com_manager, "bytes_ledger", None)
+        saturated = 0
+        if self._pool is not None:
+            saturated = int(sum(p.saturated for p in self._pool.partials))
         with self._lock:
             return {
+                "ingest_saturated": saturated,
                 "members": len(self._members),
                 "evictions": self.evictions,
                 "readmissions": self.readmissions,
@@ -543,7 +568,8 @@ class FedAVGServerManager(ServerManager):
             client_indexes = self.aggregator.client_sampling(self.round_idx)
         out = Message(MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, worker)
         out.add(MSG_ARG_KEY_MODEL_PARAMS, self._broadcast_net)
-        out.add(MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[worker - 1]))
+        ci = int(client_indexes[self._worker_slot(worker)])
+        out.add(MSG_ARG_KEY_CLIENT_INDEX, ci)
         out.add("round", self.round_idx)
         out.add("done", False)
         out.add("epoch", self.epoch)
@@ -558,6 +584,7 @@ class FedAVGServerManager(ServerManager):
             # that, so a plain transport duplicate of a normal assignment
             # is dropped instead of costing a model-sized resend.
             out.add("resend", True)
+        self._stamp_routing(out, ci)
         self._safe_send(out, worker)
 
     # -- checkpointing ------------------------------------------------------
@@ -927,10 +954,10 @@ class FedAVGServerManager(ServerManager):
                 arrived=len(arrived)):
             if self._pool is not None:
                 global_net = self.aggregator.aggregate_pooled(
-                    [w - 1 for w in arrived], self._pool)
+                    [self._worker_slot(w) for w in arrived], self._pool)
             else:
                 global_net = self.aggregator.aggregate_from(
-                    [w - 1 for w in arrived])
+                    [self._worker_slot(w) for w in arrived])
         self.flight.record("round_commit", round=self.round_idx,
                            arrived=len(arrived))
         self._broadcast_net = global_net
@@ -1012,6 +1039,10 @@ class FedAVGClientManager(ClientManager):
         # server's per-worker round high-water mark makes resends
         # idempotent.
         self._last_upload: Optional[Message] = None
+        # Upload destination: rank 0 unless the assignment stamps a shard
+        # rank (the sharded aggregation plane, comm/shardplane.py).
+        # Control traffic — heartbeats — always goes to rank 0.
+        self._upload_to = 0
         self._compressor = make_compressor(compress)
         self._beats = HeartbeatSender(
             self._send_beat,
@@ -1087,6 +1118,17 @@ class FedAVGClientManager(ClientManager):
         if msg.get("done"):
             self.finish()
             return
+        sr = msg.get(MSG_ARG_KEY_SHARD_RANK)
+        if sr is not None and int(sr) != self._upload_to:
+            # Sharded plane routing (first stamp, or a re-route after a
+            # shard eviction). The cached upload re-targets too: a
+            # resend-flagged re-assignment after its shard died must
+            # re-ship to the SURVIVING shard, not the corpse.
+            self._upload_to = int(sr)
+            if self._last_upload is not None:
+                self._last_upload.receiver_id = self._upload_to
+                self._last_upload.add(Message.MSG_ARG_KEY_RECEIVER,
+                                      self._upload_to)
         # The server's round tag, not a local counter: under first-k
         # aggregation a straggler can be reassigned past skipped rounds.
         tag = msg.get("round")
@@ -1150,7 +1192,8 @@ class FedAVGClientManager(ClientManager):
                 # off this is skipped — device_get below syncs anyway.
                 jax.block_until_ready(net)
         t_ser = tr.now()
-        out = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        out = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank,
+                      self._upload_to)
         codec = (self._codec if self._codec is not None
                  and self._codec.name != "none" else None)
         if self._compressor.name != "none" or codec is not None:
@@ -1203,7 +1246,8 @@ def build_federation_setup(model, train_fed: FederatedArrays, test_global,
                            cfg: FedConfig, backend: str, loss_fn,
                            chaos: Optional[ChaosSpec] = None,
                            loopback_wire: str = "none",
-                           pretrained_params=None):
+                           pretrained_params=None,
+                           extra_ranks: int = 0):
     """Shared worker-process scaffolding for the message-passing
     federations (sync FedAvg here, async in fedasync.py): model fns +
     initial net, jitted local trainer / eval, and the backend ``args``
@@ -1218,8 +1262,12 @@ def build_federation_setup(model, train_fed: FederatedArrays, test_global,
     ``net0.params`` (structure-checked); adapter mode
     (``cfg.adapter_rank > 0``) freezes it as the BASE while the
     adapters keep their exact-identity init.
+
+    ``extra_ranks`` widens the rank space for non-worker processes — the
+    sharded aggregation plane's M aggregator shards at ranks ``1..M``
+    (comm/shardplane.py), with workers shifted to ``M+1..size-1``.
     Returns ``(size, net0, local_train, eval_fn, args)``."""
-    size = cfg.client_num_per_round + 1
+    size = cfg.client_num_per_round + 1 + int(extra_ranks)
     if getattr(cfg, "compute_layout", "none") not in ("none", ""):
         # The message-passing tiers build their local trainer here,
         # outside FedAvgAPI._build_local_train where the lane-fill
@@ -1328,6 +1376,8 @@ def FedML_FedAvg_distributed(
     idle_timeout_s: float = 0.0,
     trace_dir: Optional[str] = None,
     pretrained_params=None,
+    agg_shards: int = 0,
+    directory=None,
 ):
     """Build server + ``client_num_per_round`` workers on the chosen backend
     and run the full federation (FedAvgAPI.py:20 analogue). Returns the
@@ -1363,25 +1413,52 @@ def FedML_FedAvg_distributed(
     installed for the run and ``trace.chrome.json`` (Perfetto /
     ``chrome://tracing`` loadable) + ``trace.jsonl`` are dumped there,
     and the server's flight-recorder ring lands there on eviction /
-    abort / codec refusal. ``None`` (the default) is the no-op path."""
+    abort / codec refusal. ``None`` (the default) is the no-op path.
+
+    ``agg_shards`` = M > 0 stands up the SHARDED aggregation plane
+    (comm/shardplane.py): M aggregator-shard processes at ranks ``1..M``
+    ingest the uploads (workers shifted to ``M+1..``), and the rank-0
+    coordinator wire-merges their int64 fixed-point partials bit-equal to
+    the single-process IngestPool path. ``directory`` (an optional
+    data.directory.ClientDirectory) folds data-shard locality into the
+    client→shard routing."""
+    M = int(agg_shards or (getattr(cfg, "agg_shards", 0) or 0))
     size, net0, local_train, eval_fn, args = build_federation_setup(
         model, train_fed, test_global, cfg, backend, loss_fn, chaos=chaos,
-        loopback_wire=loopback_wire, pretrained_params=pretrained_params)
-    agg = FedAVGAggregator(net0, size - 1, cfg, eval_fn, test_global,
+        loopback_wire=loopback_wire, pretrained_params=pretrained_params,
+        extra_ranks=M)
+    agg = FedAVGAggregator(net0, size - 1 - M, cfg, eval_fn, test_global,
                            aggregator=aggregator)
-    server = FedAVGServerManager(args, agg, cfg, size, backend=backend,
-                                 compress=compress, aggregate_k=aggregate_k,
-                                 checkpoint_dir=checkpoint_dir,
-                                 metrics=metrics, flight_dir=trace_dir)
+    shards = []
+    if M > 0:
+        from fedml_tpu.comm.shardplane import (AggregatorShardManager,
+                                               ShardedFedAVGServerManager)
+
+        server = ShardedFedAVGServerManager(
+            args, agg, cfg, size, M, backend=backend,
+            aggregate_k=aggregate_k, checkpoint_dir=checkpoint_dir,
+            metrics=metrics, flight_dir=trace_dir, directory=directory)
+        shards = [
+            AggregatorShardManager(args, rank, size, cfg, net0,
+                                   backend=backend)
+            for rank in range(1, M + 1)
+        ]
+    else:
+        server = FedAVGServerManager(args, agg, cfg, size, backend=backend,
+                                     compress=compress,
+                                     aggregate_k=aggregate_k,
+                                     checkpoint_dir=checkpoint_dir,
+                                     metrics=metrics, flight_dir=trace_dir)
     clients = [
         FedAVGClientManager(args, rank, size, train_fed, local_train, cfg,
                             backend=backend, compress=compress,
                             wire_codec_spec=wire_codec,
                             idle_timeout_s=idle_timeout_s)
-        for rank in range(1, size)
+        for rank in range(M + 1, size)
     ]
     with obs_trace.tracing_to(trace_dir):
-        run_workers([server.run] + [c.run for c in clients])
+        run_workers([server.run] + [sh.run for sh in shards]
+                    + [c.run for c in clients])
     # Post-run observability: the managers are finished but callers (the
     # wire_codec bench A/B, drill tests) still need the control-plane
     # counters, ByteLedger totals and the ingest latency profile — stamp

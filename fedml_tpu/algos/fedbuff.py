@@ -96,6 +96,12 @@ class FedBuffServerManager(FedAsyncServerManager):
     ``staleness_exp`` is the discount exponent shared with fedasync.
     ``aggregator`` is any :func:`core.robust_agg.make_aggregator` spec —
     ``mean`` keeps the O(model) accumulate-on-arrival fast path.
+
+    ``cfg.agg_shards`` is refused (inherited from FedAsyncServerManager):
+    the buffer barriers on GLOBAL arrival order — the k-th arrival
+    triggers the aggregation wherever it lands — so there is no
+    per-partition partial for the sharded plane (comm/shardplane.py) to
+    merge without changing which uploads share a buffer.
     """
 
     #: The buffered tier folds DELTAS (client ships net − pulled model);

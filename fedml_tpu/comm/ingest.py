@@ -99,6 +99,37 @@ def quantize_weight(w: float) -> int:
     return int(np.clip(np.rint(w * _SCALE), 0.0, _CLIP))
 
 
+def finalize_partial_mean(total: "PartialAccumulator", ref_tree, dtype=None):
+    """The ONE place a fixed-point partial becomes a float mean: return
+    ``(mean_tree, count)`` — the weighted mean ``Σ w·x / Σ w`` as numpy
+    leaves shaped/ordered by ``ref_tree``, cast to each reference leaf's
+    dtype (or ``dtype`` for every leaf). ``mean_tree`` is ``None`` when
+    nothing (or only weight-zero contributions) accumulated.
+
+    Module-level because TWO finalize sites must agree to the bit:
+    :meth:`IngestPool.finalize_mean` (the in-process pool) and the shard
+    coordinator's wire merge (``comm/shardplane.py``), whose bit-equality
+    contract is "same int64 totals → same mean" BY CONSTRUCTION — both
+    call here, so there is no second copy of the division to drift."""
+    import jax
+
+    count = total.count
+    if total.leaves is None or total.wsum <= 0:
+        return None, count
+    ref_leaves, treedef = jax.tree.flatten(ref_tree)
+    if len(ref_leaves) != len(total.leaves):
+        raise ValueError(
+            f"pooled accumulator holds {len(total.leaves)} leaves but "
+            f"the reference model has {len(ref_leaves)}")
+    inv = 1.0 / (total.wsum / _SCALE)
+    out = []
+    for r, acc in zip(ref_leaves, total.leaves):
+        mean = (acc / _SCALE) * inv
+        d = dtype if dtype is not None else np.asarray(r).dtype
+        out.append(mean.reshape(np.shape(r)).astype(d))
+    return jax.tree.unflatten(treedef, out), count
+
+
 class PartialAccumulator:
     """One worker's running Σ w_i·x_i (int64 leaves) + Σ w_i (int).
     Single-writer (its owning pool worker); merged under the pool lock at
@@ -197,6 +228,15 @@ class PartialAccumulator:
         self.count += 1
 
     def merge_into(self, other: "PartialAccumulator") -> None:
+        """Exact merge: int64 leaf adds + scalar sums. The scalar tallies
+        — ``wsum``, ``count`` AND ``saturated`` — propagate even when
+        this partial never folded a leaf (an accumulator fresh off
+        ``reset()`` still carries its monotone saturation count; dropping
+        it at merge boundaries is how fleet-wide saturation used to
+        vanish from pooled health reports)."""
+        other.wsum += self.wsum
+        other.count += self.count
+        other.saturated += self.saturated
         if self.leaves is None:
             return
         if other.leaves is None:
@@ -204,8 +244,6 @@ class PartialAccumulator:
         else:
             for a, b in zip(other.leaves, self.leaves):
                 a += b
-        other.wsum += self.wsum
-        other.count += self.count
 
     def reset(self) -> None:
         # Keep the allocated leaves/scratch (zeroed in place) — reset
@@ -372,6 +410,24 @@ class IngestPool:
         for p in self.partials:
             p.reset()
 
+    def merge_partials(self) -> PartialAccumulator:
+        """Exact merge of the per-worker partials into ONE fresh
+        accumulator, resetting the workers — the flush-time export for
+        the sharded aggregation plane (``comm/shardplane.py``): the
+        shard ships the merged int64 partial over the wire and the
+        COORDINATOR finalizes, so the division happens exactly once per
+        round no matter how many processes folded. Because the
+        per-worker ``saturated`` tallies are monotone across resets, the
+        returned total's ``saturated`` is the pool's LIFETIME saturation
+        count at this flush (a gauge, not a delta). Callers must
+        :meth:`drain` first."""
+        total = PartialAccumulator()
+        with self._lock:
+            for p in self.partials:
+                p.merge_into(total)
+            self.reset()
+        return total
+
     def finalize_mean(self, ref_tree, dtype=None):
         """Merge the per-worker partials exactly and return
         ``(mean_tree, count)``: the weighted mean ``Σ w·x / Σ w`` as
@@ -382,28 +438,8 @@ class IngestPool:
         accumulated — the caller keeps its previous net, the
         all-excluded contract. Resets the partials either way. Callers
         must :meth:`drain` first."""
-        import jax
-
-        total = PartialAccumulator()
-        with self._lock:
-            for p in self.partials:
-                p.merge_into(total)
-            self.reset()
-        count = total.count
-        if total.leaves is None or total.wsum <= 0:
-            return None, count
-        ref_leaves, treedef = jax.tree.flatten(ref_tree)
-        if len(ref_leaves) != len(total.leaves):
-            raise ValueError(
-                f"pooled accumulator holds {len(total.leaves)} leaves but "
-                f"the reference model has {len(ref_leaves)}")
-        inv = 1.0 / (total.wsum / _SCALE)
-        out = []
-        for r, acc in zip(ref_leaves, total.leaves):
-            mean = (acc / _SCALE) * inv
-            d = dtype if dtype is not None else np.asarray(r).dtype
-            out.append(mean.reshape(np.shape(r)).astype(d))
-        return jax.tree.unflatten(treedef, out), count
+        return finalize_partial_mean(self.merge_partials(), ref_tree,
+                                     dtype=dtype)
 
     # -- observability -------------------------------------------------------
     def profile(self) -> Dict[str, object]:

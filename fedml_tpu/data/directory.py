@@ -122,6 +122,20 @@ class ClientDirectory:
         return np.bincount(self.shard_of[np.asarray(indices)],
                            minlength=self.num_shards)
 
+    def agg_shard_of(self, indices, num_agg_shards: int):
+        """Aggregator-shard assignment for the sharded aggregation plane
+        (comm/shardplane.py): fold the ``G`` DATA shards onto ``M``
+        aggregator shards by modulo, so clients that share a data shard
+        share an aggregator shard whenever ``M`` divides ``G`` — upload
+        locality follows storage locality. Scalar in → scalar out;
+        array in → int32 array."""
+        m = int(num_agg_shards)
+        if m < 1:
+            raise ValueError(f"num_agg_shards={num_agg_shards} must be >= 1")
+        if np.isscalar(indices):
+            return int(self.shard_of[int(indices)]) % m
+        return (self.shard_of[np.asarray(indices)] % m).astype(np.int32)
+
     def nbytes(self) -> int:
         return (self.counts.nbytes + self.shard_of.nbytes
                 + self.local_row_start.nbytes + self.shard_clients.nbytes

@@ -156,6 +156,16 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "so any N is bit-equal to N=1. 0 (default) keeps "
                         "the inline fold; mean aggregation only — "
                         "non-mean --aggregator combos refuse loudly")
+    p.add_argument("--agg_shards", type=int, default=0,
+                   help="sharded aggregation plane for the loopback "
+                        "cross-silo runner (comm/shardplane.py): M "
+                        "aggregator-shard processes each ingest their "
+                        "own client partition (full codec negotiation + "
+                        "ingest pool), and the rank-0 coordinator wire-"
+                        "merges their int64 fixed-point partials "
+                        "BIT-EQUAL to the single-process pool for any "
+                        "M. Sync FedAvg + mean aggregation only; 0 "
+                        "(default) keeps the single-server ingest path")
     p.add_argument("--compute_layout", type=str, default="none",
                    help="lane-fill compute layout for the client step: "
                         "none | auto (pad channel dims to MXU lane/"
@@ -342,6 +352,21 @@ def reject_ingest_pool_flag(args, algorithm: str) -> None:
             "silently inert here")
 
 
+def reject_agg_shards_flag(args, algorithm: str) -> None:
+    """Refuse ``--agg_shards`` wherever the sharded aggregation plane
+    cannot run (same convention as :func:`reject_ingest_pool_flag`):
+    the simulator tiers have no server processes to shard, and the
+    async tiers' server managers additionally refuse ``cfg.agg_shards``
+    themselves (their mix is order-dependent, algos/fedasync.py)."""
+    if getattr(args, "agg_shards", 0):
+        raise SystemExit(
+            f"{algorithm} does not support --agg_shards "
+            f"{args.agg_shards}: the sharded aggregation plane stands up "
+            "M aggregator-shard processes for the synchronous message-"
+            "passing federation (comm/shardplane.py) — the flag would "
+            "be silently inert here")
+
+
 def trace_dir_from(args) -> "str | None":
     """Resolve ``--trace`` into the runners' ``trace_dir``: the run
     directory when tracing is on (refusing loudly without one — trace
@@ -407,5 +432,6 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         round_timeout_s=args.round_timeout_s,
         heartbeat_interval_s=args.heartbeat_interval_s,
         ingest_workers=args.ingest_workers,
+        agg_shards=int(getattr(args, "agg_shards", 0) or 0),
         trace=args.trace,
     )

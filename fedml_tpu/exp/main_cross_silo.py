@@ -100,6 +100,7 @@ def main(argv=None):
             "feature; the cross-silo server samples uniformly (it has no "
             "access to silo-local losses before assignment)")
     from fedml_tpu.exp.args import (reject_adapter_flags,
+                                    reject_agg_shards_flag,
                                     reject_async_tier_flags,
                                     reject_fedavg_family_flags,
                                     reject_pod_plane_flags)
@@ -119,6 +120,11 @@ def main(argv=None):
     # built from plain model_fns, so --adapter_rank would silently
     # train the dense arm while reporting the adapter experiment.
     reject_adapter_flags(args, "the cross-silo pipeline")
+    # The sharded aggregation plane needs M extra in-process shard ranks
+    # between server and silos — a topology the rank-per-process CLI does
+    # not launch. It rides the loopback/sim runner:
+    # FedML_FedAvg_distributed(..., agg_shards=M) (comm/shardplane.py).
+    reject_agg_shards_flag(args, "the cross-silo pipeline")
 
     logging.basicConfig(
         level=logging.INFO,

@@ -19,6 +19,7 @@ import numpy as np
 
 from fedml_tpu.exp.args import (add_args, config_from_args,
                                 reject_adapter_flags,
+                                reject_agg_shards_flag,
                                 reject_async_tier_flags,
                                 reject_fedavg_family_flags,
                                 reject_ingest_pool_flag,
@@ -271,6 +272,12 @@ def main(argv=None):
         # The parallel ingest pool likewise rides only the message-
         # passing server tiers (FedAsync/FedBuff here; cross-silo CLI).
         reject_ingest_pool_flag(args, args.algorithm)
+    # The sharded aggregation plane is a synchronous-FedAvg capability
+    # (comm/shardplane.py): FedAsync/FedBuff refuse cfg.agg_shards in
+    # their server constructors (the sequential mix / global-arrival
+    # buffer cannot be partitioned), and every other specialty loop
+    # never stands up a message-passing server at all.
+    reject_agg_shards_flag(args, args.algorithm)
     # The pod compute plane (bf16 client step, DCN group reduction)
     # rides the FedAvg family's shared rounds; every specialty loop
     # refuses here. FedAsync/FedBuff refuse client_step_dtype /

@@ -136,11 +136,13 @@ def run(args, algorithm: str = "FedAvg"):
     # parallel ingest pool: the simulator aggregates inside the jitted
     # round, there is no server dispatch thread to unblock.
     from fedml_tpu.exp.args import (reject_adapter_flags,
+                                    reject_agg_shards_flag,
                                     reject_async_tier_flags,
                                     reject_ingest_pool_flag)
 
     reject_async_tier_flags(args, algorithm)
     reject_ingest_pool_flag(args, algorithm)
+    reject_agg_shards_flag(args, algorithm)
     if algorithm != "FedAdapter":
         # Frozen-base adapter knobs configure FedAdapter only on this
         # tier — on any other algorithm they would silently train the

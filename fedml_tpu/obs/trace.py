@@ -18,7 +18,9 @@ turns those into ARTIFACTS:
   attribute lookup + an empty ``with`` when tracing is off (pinned
   within 2% of uninstrumented in tests/test_trace.py).
 - :class:`FlightRecorder` — a bounded ring buffer of recent control-plane
-  events (beats, evictions, re-admissions, codec refusals, epoch drops)
+  events (beats, evictions, re-admissions, codec refusals, epoch drops;
+  on the sharded aggregation plane also ``shard_eviction`` /
+  ``shard_readmission`` and per-``round_commit`` shard membership)
   the server managers dump to the run directory on eviction / abort /
   ``CodecError``, so the minutes BEFORE a failure survive it.
 

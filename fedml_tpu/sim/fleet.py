@@ -208,7 +208,11 @@ class FleetSimulator:
     invariant); ``cfg.ingest_workers`` arms the server's parallel
     ingest pool (comm/ingest.py — decode+fold off the dispatch thread,
     bit-equal for any worker count, so the SAME seeded drill measures
-    the ingest-saturation curve)."""
+    the ingest-saturation curve); ``agg_shards`` stands up the sharded
+    aggregation plane (comm/shardplane.py — M virtual aggregator-shard
+    ranks between coordinator and devices, sync mode only; shard beats
+    and the shard watchdog run on the virtual clock, so shard-eviction
+    drills are as deterministic as device churn)."""
 
     def __init__(self, model, train_fed, test_global, cfg: FedConfig,
                  trace: FleetTrace, mode: str = "fedbuff", *,
@@ -217,10 +221,17 @@ class FleetSimulator:
                  staleness_exp: float = 0.5, buffer_k: int = 2,
                  aggregator="mean", corrupt_ranks=(), corruptor=None,
                  wire_codec: str = "none", sim_wire: str = "none",
-                 directory=None):
+                 directory=None, agg_shards: int = 0):
         if mode not in MODES:
             raise ValueError(f"unknown sim mode {mode!r}; known {MODES}")
+        if agg_shards and mode != "sync":
+            raise ValueError(
+                f"agg_shards={agg_shards} is a synchronous-FedAvg "
+                "capability (comm/shardplane.py); the async tiers refuse "
+                "it in their server constructors for the same reason — "
+                f"mode {mode!r} has no barrier round to partition")
         self.mode = mode
+        self.agg_shards = int(agg_shards or 0)
         self.trace = trace
         spec = trace.spec
         # The fleet IS the worker set: one rank per traced device. Sim
@@ -236,12 +247,14 @@ class FleetSimulator:
         self.cfg = cfg
         self.clock = VirtualClock()
         self.events = EventQueue(self.clock)
-        self.network = SimNetwork(spec.n_devices + 1, self.events,
+        self.network = SimNetwork(spec.n_devices + self.agg_shards + 1,
+                                  self.events,
                                   latency_fn=self._latency,
                                   deliver_guard=self._deliver_guard,
                                   wire=sim_wire)
         size, net0, local_train, eval_fn, args = build_federation_setup(
-            model, train_fed, test_global, cfg, "SIM", loss_fn, chaos=chaos)
+            model, train_fed, test_global, cfg, "SIM", loss_fn, chaos=chaos,
+            extra_ranks=self.agg_shards)
         args.network = self.network
         args.chaos_after = self.events.after
         # The jitted local trainer every client shares — exposed so a
@@ -252,13 +265,15 @@ class FleetSimulator:
         self.net0 = net0
         self._ready_at: Dict[Tuple[int, int], float] = {}
         self._ready_rank: Dict[int, float] = {}
-        self._task_idx: Dict[int, int] = {r: -1 for r in range(1, size)}
+        self._task_idx: Dict[int, int] = {
+            r: -1 for r in range(self.agg_shards + 1, size)}
         self.churn_killed = 0
 
         def timed_local_train(rank, fn=local_train):
             def run(*a):
                 self._task_idx[rank] += 1
-                dt = self.trace.compute_time(rank, self._task_idx[rank])
+                dt = self.trace.compute_time(self._dev(rank),
+                                             self._task_idx[rank])
                 cm = self._client_by_rank.get(rank)
                 task = getattr(cm, "_last_task", -1) if cm is not None else -1
                 # Charge the compute at TRAINING time as a completion
@@ -279,17 +294,37 @@ class FleetSimulator:
                 return fn(*a)
             return run
 
+        self.shards = []
         if mode == "sync":
-            self.aggregator = FedAVGAggregator(net0, size - 1, cfg, eval_fn,
-                                               test_global)
-            self.server = FedAVGServerManager(
-                args, self.aggregator, cfg, size, backend="SIM",
-                aggregate_k=aggregate_k, clock=self.clock)
+            M = self.agg_shards
+            self.aggregator = FedAVGAggregator(net0, size - 1 - M, cfg,
+                                               eval_fn, test_global)
+            if M > 0:
+                from fedml_tpu.comm.shardplane import (
+                    AggregatorShardManager, ShardedFedAVGServerManager)
+
+                self.server = ShardedFedAVGServerManager(
+                    args, self.aggregator, cfg, size, M, backend="SIM",
+                    aggregate_k=aggregate_k, clock=self.clock,
+                    directory=directory)
+                # beat_interval_s=0 silences the shard's wall-clock
+                # HeartbeatSender thread; _schedule_beats replays shard
+                # beats as virtual-time events instead.
+                self.shards = [
+                    AggregatorShardManager(args, r, size, cfg, net0,
+                                           backend="SIM",
+                                           beat_interval_s=0.0,
+                                           clock=self.clock)
+                    for r in range(1, M + 1)]
+            else:
+                self.server = FedAVGServerManager(
+                    args, self.aggregator, cfg, size, backend="SIM",
+                    aggregate_k=aggregate_k, clock=self.clock)
             self.clients = [
                 FedAVGClientManager(args, r, size, train_fed,
                                     timed_local_train(r), cfg, backend="SIM",
                                     wire_codec_spec=wire_codec)
-                for r in range(1, size)]
+                for r in range(M + 1, size)]
         elif mode == "fedasync":
             self.server = FedAsyncServerManager(
                 args, net0, cfg, size, backend="SIM",
@@ -326,6 +361,12 @@ class FleetSimulator:
         self._term_t0: Optional[float] = None
 
     # -- trace-driven policy hooks ------------------------------------------
+    def _dev(self, rank: int) -> int:
+        """Comm rank → trace device index. Identical when the rank
+        layout has no aggregator shards; with M shards the device ranks
+        start after them (rank M+d is device d)."""
+        return rank - self.agg_shards
+
     def _latency(self, msg) -> Optional[float]:
         sender = int(msg.get_sender_id())
         receiver = int(msg.get_receiver_id())
@@ -333,8 +374,12 @@ class FleetSimulator:
         wire = self.trace.spec.wire_latency_s
         if sender == receiver:
             return 0.0  # the watchdog's self-addressed tick: no network
-        if sender == 0:
-            return wire  # server hop; receiver checked at delivery
+        if sender <= self.agg_shards:
+            # Server or aggregator-shard hop (rank 0, or 1..M when the
+            # sharded plane is up): infrastructure is always online and
+            # has no trace entry — wire latency only. Receiver liveness
+            # is checked at delivery.
+            return wire
         # Device-originated. An upload is deliverable once its training
         # completes: ``_ready_at`` for task-tagged async/buffered
         # uploads, ``_ready_rank`` for the sync tier's round-keyed ones
@@ -348,7 +393,7 @@ class FleetSimulator:
                      else self._ready_rank.get(sender))
             if ready is not None:
                 dt = max(ready - now, 0.0)
-        if not self.trace.online_through(sender, now, now + dt):
+        if not self.trace.online_through(self._dev(sender), now, now + dt):
             # The availability window closed inside the training
             # interval: mid-round churn — the upload (or beat) is lost.
             if dt > 0.0:
@@ -358,7 +403,9 @@ class FleetSimulator:
 
     def _deliver_guard(self, msg) -> bool:
         receiver = int(msg.get_receiver_id())
-        return self.trace.online_at(receiver, self.clock.now)
+        if receiver <= self.agg_shards:
+            return True  # coordinator / aggregator shards: always online
+        return self.trace.online_at(self._dev(receiver), self.clock.now)
 
     # -- scheduled control events -------------------------------------------
     def _schedule_beats(self) -> None:
@@ -368,15 +415,30 @@ class FleetSimulator:
         def beat(client):
             if self.server._stopped or self.network.stopped(client.rank):
                 return
-            if self.trace.online_at(client.rank, self.clock.now):
+            if self.trace.online_at(self._dev(client.rank), self.clock.now):
                 client._send_beat()
             if self.clock.now + hb <= horizon:
                 self.events.after(hb, lambda: beat(client))
 
         for c in self.clients:
-            first = self.trace.next_online(c.rank, 0.0)
+            first = self.trace.next_online(self._dev(c.rank), 0.0)
             if first is not None:
                 self.events.at(first + hb, lambda c=c: beat(c))
+
+        # Aggregator shards beat too (their wall-clock HeartbeatSender is
+        # disarmed at construction): always online, so a plain cadence —
+        # unless a drill killed the shard's rank on the SIM fabric, which
+        # is exactly how shard-eviction tests silence one.
+        def shard_beat(sh):
+            if self.server._stopped or sh._stopped:
+                return
+            if not self.network.stopped(sh.rank):
+                sh._send_beat()
+            if self.clock.now + hb <= horizon:
+                self.events.after(hb, lambda: shard_beat(sh))
+
+        for sh in self.shards:
+            self.events.after(hb, lambda sh=sh: shard_beat(sh))
 
     def _schedule_watchdog(self) -> None:
         """The event-driven twin of the servers' watchdog threads: same
@@ -410,6 +472,15 @@ class FleetSimulator:
     def _sync_watch(self) -> None:
         srv = self.server
         now = self.clock.now
+        if self.shards:
+            # The sharded coordinator's shard watchdog, event-twinned the
+            # same way: silent live shards get a self-addressed tick and
+            # the eviction executes on the dispatch path
+            # (ShardedFedAVGServerManager._shard_watch_loop).
+            dead = (set(srv.shard_heartbeat.failed())
+                    & set(srv._live_shards_snapshot()))
+            if dead:
+                srv._post_shard_tick(sorted(dead))
         members = set(srv._members_snapshot())
         r = srv.round_idx
         if r != self._watch_round:
@@ -463,11 +534,14 @@ class FleetSimulator:
     def run(self, max_virtual_s: Optional[float] = None) -> FleetResult:
         horizon = (self.trace.spec.horizon_s if max_virtual_s is None
                    else max_virtual_s)
-        for mgr in [self.server] + self.clients:
+        for mgr in [self.server] + self.shards + self.clients:
             mgr.register_message_receive_handlers()
         # The server's run() preamble, minus its blocking receive loop.
-        for r in range(1, self.trace.spec.n_devices + 1):
+        M = self.agg_shards
+        for r in range(M + 1, M + self.trace.spec.n_devices + 1):
             self.server.heartbeat.beat(r)
+        for sh in self.shards:
+            self.server.shard_heartbeat.beat(sh.rank)
         self.server.send_init_msg()
         self._schedule_beats()
         self._schedule_watchdog()

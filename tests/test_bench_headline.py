@@ -86,8 +86,10 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
                  "bench_robust_agg",
                  "bench_chaos", "bench_wire_codec", "bench_fed_adapter",
                  "bench_ingest_profile",
-                 "bench_serving_1m", "bench_fleet_sim",
+                 "bench_serving_1m", "bench_agg_shards",
+                 "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
+                 "bench_serving_10m",
                  "bench_vit",
                  "bench_layout_fused_round", "bench_pod_reduce",
                  "bench_cnn_mfu_levers", "bench_resnet56_s2d",
@@ -114,7 +116,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     # Every section that RAN finished inside the budget: elapsed at its
     # start + the full section cap fit under 300s.
     assert len(ran) * 50 + 100 <= 300
-    assert len(ran) + len(skipped) == 21
+    assert len(ran) + len(skipped) == 23
 
 
 def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
@@ -128,8 +130,10 @@ def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
                  "bench_robust_agg",
                  "bench_chaos", "bench_wire_codec", "bench_fed_adapter",
                  "bench_ingest_profile",
-                 "bench_serving_1m", "bench_fleet_sim",
+                 "bench_serving_1m", "bench_agg_shards",
+                 "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
+                 "bench_serving_10m",
                  "bench_vit",
                  "bench_layout_fused_round", "bench_pod_reduce",
                  "bench_cnn_mfu_levers", "bench_resnet56_s2d",
@@ -250,10 +254,18 @@ def test_headline_tolerates_budget_skipped_submetrics():
     # story; the full blob keeps both).
     assert "fedopt_windowed_rps" not in h["sub"]
     assert "fedopt_windowed_speedup" not in h["sub"]
-    # The r14 pod-plane scalars ride (None when skipped).
+    # The r14 pod-plane scalars ride (None when skipped); bf16_acc_delta
+    # rotated out in r16 to fund the sharded-plane scalars.
     assert h["sub"]["pod_dcn_bytes_ratio"] is None
     assert h["sub"]["bf16_step_speedup"] is None
+    assert "bf16_acc_delta" not in h["sub"]
     assert "robust_agg_overhead" not in h["sub"]  # rotated out in r14
+    # The r16 sharded-aggregation-plane scalars ride (None when skipped).
+    assert h["sub"]["agg_shard_speedup_4v1"] is None
+    assert h["sub"]["agg_shard_coord_occupancy"] is None
+    assert h["sub"]["serving_10m_uploads_per_sec"] is None
+    assert "fleet_buffered_stale_p95_vs_async" not in h["sub"]  # r16
+    assert "synthetic_1m_peak_rss_ratio" not in h["sub"]  # r16
     # The r13 whole-zoo scalars ride (None when the section was skipped).
     assert h["sub"]["zoo_windowed_speedup"] is None
     assert h["sub"]["fedac_acc_delta"] is None

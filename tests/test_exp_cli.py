@@ -33,6 +33,7 @@ def test_run_hierarchical():
     assert np.isfinite(history[-1]["train_loss"])
 
 
+@pytest.mark.slow  # >8 s drill; tier-1 re-fit to the 870 s budget on the 1-core box (r16 audit)
 def test_run_fedadapter():
     """The adapter finetune CLI (PR 15): transformer + NWP + LoRA rank —
     the frozen-base federation trains end to end from exp/run.py."""

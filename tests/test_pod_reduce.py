@@ -245,6 +245,7 @@ def test_dcn_non_composable_refuses_loudly():
 
 # ---------------- FedAvgAPI end to end on the DCN mesh ----------------
 
+@pytest.mark.slow  # >8 s drill; tier-1 re-fit to the 870 s budget on the 1-core box (r16 audit)
 def test_fedavg_api_dcn_mesh_end_to_end():
     """cfg.group_reduce rides FedAvgAPI on a DCN mesh: n_shards spans
     both axes (cohort padding right), group-vs-flat mean bit-equal on

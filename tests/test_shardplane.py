@@ -1,0 +1,613 @@
+"""Sharded aggregation plane (comm/shardplane.py) — M-way server
+scale-out with wire-merged fixed-point partials.
+
+Fast lane: the partial wire frame (int64 exactness, additive identity),
+the ``merge_into`` saturation-rollup regression, M-shard folds bit-equal
+to the single-process ``IngestPool`` path for M ∈ {1, 2, 4} under seeded
+arrival permutations (pure pool math AND the fake-clock protocol
+fabric), shard-eviction / re-admission protocol pins, the ByteLedger +
+saturation health rollups, directory-aware routing, and the CLI /
+async-tier refusals. End-to-end: loopback federations at M ∈ {0,1,2,4}
+landing the bit-identical net, a kill-one-shard loopback drill healing
+through eviction, and the deterministic SIM fabric with virtual shards.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algos import FedConfig
+from fedml_tpu.algos.fedavg_distributed import (
+    MSG_ARG_KEY_SHARD_RANK,
+    MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+    MSG_TYPE_S2C_INIT_CONFIG,
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+    FedAVGAggregator,
+    FedML_FedAvg_distributed,
+)
+from fedml_tpu.comm.ingest import (
+    IngestPool,
+    PartialAccumulator,
+    finalize_partial_mean,
+)
+from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackNetwork
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.shardplane import (
+    MSG_TYPE_SHARD2COORD_BEAT,
+    MSG_TYPE_SHARD2COORD_PARTIAL,
+    PARTIAL_KEY,
+    AggregatorShardManager,
+    ShardedFedAVGServerManager,
+    decode_partial,
+    encode_partial,
+)
+from fedml_tpu.comm.wire import deserialize_message
+from fedml_tpu.data.batching import batch_global, build_federated_arrays
+from fedml_tpu.data.directory import ClientDirectory
+from fedml_tpu.data.partition import partition_homo
+from fedml_tpu.data.synthetic import make_classification
+from fedml_tpu.models.lr import LogisticRegression
+
+
+# --------------------------------------------------------------------------
+# The partial wire frame + pool math (no managers)
+
+
+def _fold_ref(uploads, net_ref):
+    """Single-process reference: one serial accumulator fold, finalized
+    through the one division site."""
+    total = PartialAccumulator()
+    for leaves, w in uploads:
+        total.add(leaves, w)
+    return finalize_partial_mean(total, net_ref)
+
+
+def test_encode_decode_partial_roundtrip():
+    acc = PartialAccumulator()
+    acc.add([np.array([1.5, -2.25], np.float32)], 7.0)
+    acc.add([np.array([0.125, 3.0], np.float32)], 11.0)
+    acc.saturated = 2
+    frame = encode_partial(acc)
+    assert frame["leaves"][0].dtype == np.int64
+    assert isinstance(frame["wsum"], int) and isinstance(frame["count"], int)
+    back = decode_partial(frame)
+    np.testing.assert_array_equal(back.leaves[0], acc.leaves[0])
+    assert (back.wsum, back.count, back.saturated) == (acc.wsum, 2, 2)
+
+
+def test_empty_partial_is_additive_identity():
+    """A shard that folded nothing ships ``leaves=None`` — merging it
+    must not perturb the total (and must still carry its tallies)."""
+    empty = decode_partial(encode_partial(PartialAccumulator()))
+    assert empty.leaves is None and empty.count == 0
+    total = PartialAccumulator()
+    total.add([np.array([2.0], np.float32)], 3.0)
+    w0, c0 = total.wsum, total.count
+    snap = [l.copy() for l in total.leaves]
+    empty.merge_into(total)
+    np.testing.assert_array_equal(total.leaves[0], snap[0])
+    assert (total.wsum, total.count) == (w0, c0)
+
+
+def test_merge_into_sums_saturated_across_boundaries():
+    """Satellite regression: ``saturated`` used to be dropped when the
+    source partial had no leaves (the early return ran before the scalar
+    sums), so a pool flush after a saturating round reported 0."""
+    src = PartialAccumulator()
+    src.saturated = 3  # e.g. survived a reset(): monotone telemetry
+    dst = PartialAccumulator()
+    dst.saturated = 2
+    src.merge_into(dst)
+    assert dst.saturated == 5
+    # And through the wire frame (the coordinator's merge path).
+    again = decode_partial(encode_partial(src))
+    again.merge_into(dst)
+    assert dst.saturated == 8
+
+
+@pytest.mark.parametrize("m", [1, 2, 4])
+def test_sharded_fold_bit_equal_single_pool_seeded_permutations(m):
+    """The acceptance pin, at pool level: partition 12 uploads over M
+    shard accumulators, fold each shard in a seeded-permuted arrival
+    order, round-trip every partial through the wire frame, merge at the
+    'coordinator' — bit-equal to the single serial fold, every seed."""
+    rng = np.random.default_rng(7)
+    net_ref = {"w": np.zeros((3, 2), np.float32), "b": np.zeros(2, np.float32)}
+    # Leaves in jax.tree.flatten order of the ref dict: "b" before "w".
+    uploads = [([rng.standard_normal(2).astype(np.float32),
+                 rng.standard_normal((3, 2)).astype(np.float32)],
+                float(5 + i)) for i in range(12)]
+    ref_mean, ref_count = _fold_ref(uploads, net_ref)
+    for seed in (0, 1, 2):
+        order = np.random.default_rng(seed).permutation(len(uploads))
+        shards = [PartialAccumulator() for _ in range(m)]
+        for i in order:
+            leaves, w = uploads[i]
+            shards[i % m].add(leaves, w)
+        total = PartialAccumulator()
+        for acc in shards:
+            decode_partial(encode_partial(acc)).merge_into(total)
+        mean, count = finalize_partial_mean(total, net_ref)
+        assert count == ref_count
+        for a, b in zip(ref_mean.values(), mean.values()):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_directory_agg_shard_of_locality_and_bounds():
+    """Data-shard locality folds onto the M aggregator shards: clients
+    sharing a data shard share an aggregator shard when M divides G;
+    scalar in → scalar out, array in → int32 array; M < 1 refuses."""
+    d = ClientDirectory(counts=np.full(8, 4), shard_of=np.arange(8) % 4)
+    out = d.agg_shard_of(np.arange(8), 2)
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, (np.arange(8) % 4) % 2)
+    assert d.agg_shard_of(5, 2) == int(out[5])
+    # M divides G=4: same data shard → same aggregator shard.
+    same = d.shard_of == d.shard_of[0]
+    assert len(set(out[same].tolist())) == 1
+    with pytest.raises(ValueError, match="num_agg_shards"):
+        d.agg_shard_of(0, 0)
+
+
+# --------------------------------------------------------------------------
+# Fake-clock protocol fabric (direct handler invocation — the receive
+# loops dispatch serially, so pumping the loopback inboxes is faithful)
+
+
+class _A:
+    pass
+
+
+def _fabric(m=2, workers=4, comm_round=3, wire="none", clock=None,
+            aggregate_k=0, directory=None):
+    args = _A()
+    size = workers + m + 1
+    args.network = LoopbackNetwork(size, wire=wire)
+    cfg = FedConfig(client_num_in_total=workers,
+                    client_num_per_round=workers, comm_round=comm_round,
+                    frequency_of_the_test=10 ** 6)
+    net0 = {"w": np.zeros(2, np.float32)}
+    agg = FedAVGAggregator(net0, workers, cfg)
+    clk = clock or time.monotonic
+    srv = ShardedFedAVGServerManager(
+        args, agg, cfg, size, m, aggregate_k=aggregate_k,
+        round_timeout_s=10.0, clock=clk, directory=directory)
+    shards = {r: AggregatorShardManager(args, r, size, cfg, net0,
+                                        beat_interval_s=0.0, clock=clk)
+              for r in range(1, m + 1)}
+    mgrs = {0: srv, **shards}
+    for mgr in mgrs.values():
+        mgr.register_message_receive_handlers()
+    return srv, shards, agg, args.network, mgrs
+
+
+def _pump(network, mgrs):
+    """Drain the coordinator/shard inboxes until quiescent, dispatching
+    through the registered handlers (per-channel FIFO preserved)."""
+    progress = True
+    while progress:
+        progress = False
+        for rank, mgr in mgrs.items():
+            q = network.inbox(rank)
+            while not q.empty():
+                msg = q.get()
+                if isinstance(msg, (bytes, bytearray)):
+                    n = len(msg)
+                    msg = deserialize_message(msg, network.wire)
+                    mgr.com_manager.bytes_ledger.count_rx(
+                        int(msg.get_sender_id()), n)
+                if not isinstance(msg, Message):
+                    continue  # a finish() stop sentinel
+                mgr.receive_message(msg.get_type(), msg)
+                progress = True
+
+
+def _worker_msgs(network, rank):
+    out = []
+    q = network.inbox(rank)
+    while not q.empty():
+        msg = q.get()
+        if isinstance(msg, (bytes, bytearray)):
+            msg = deserialize_message(msg, network.wire)
+        if isinstance(msg, Message):
+            out.append(msg)
+    return out
+
+
+def _assignments(network, srv):
+    """Drain every worker inbox; return worker → latest stamped shard."""
+    routed = {}
+    for w in sorted(srv._members_snapshot()):
+        for msg in _worker_msgs(network, w):
+            if msg.get_type() in (MSG_TYPE_S2C_INIT_CONFIG,
+                                  MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT):
+                sr = msg.get(MSG_ARG_KEY_SHARD_RANK)
+                if sr is not None:
+                    routed[w] = int(sr)
+    return routed
+
+
+def _post_upload(network, worker, shard, value, n=10, round_idx=0):
+    m = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, worker, shard)
+    m.add(Message.MSG_ARG_KEY_MODEL_PARAMS,
+          {"w": np.asarray(value, np.float32)})
+    m.add(Message.MSG_ARG_KEY_NUM_SAMPLES, n)
+    m.add("round", round_idx)
+    m.add("epoch", 0)
+    # A throwaway sender-side comm manager: exercises the wire serialize
+    # + ByteLedger tx path when the fabric runs a real wire format.
+    LoopbackCommManager(network, worker).send_message(m)
+
+
+def _fabric_round(m, order_seed, workers=6):
+    """One full fake-clock round at M shards: init → uploads posted in a
+    seeded permutation of the worker set → pump to the commit."""
+    srv, shards, agg, network, mgrs = _fabric(m=m, workers=workers,
+                                              comm_round=1)
+    srv.send_init_msg()
+    _pump(network, mgrs)
+    routed = _assignments(network, srv)
+    assert sorted(routed) == sorted(srv._members_snapshot())
+    order = np.random.default_rng(order_seed).permutation(sorted(routed))
+    for w in order:
+        slot = srv._worker_slot(int(w))
+        _post_upload(network, int(w), routed[int(w)],
+                     [float(slot + 1), float(-slot)], n=5 + slot)
+    _pump(network, mgrs)
+    assert srv.round_idx == 1  # committed
+    return srv, agg
+
+
+@pytest.mark.parametrize("m", [1, 2, 4])
+def test_fabric_round_bit_equal_to_single_pool(m):
+    """The acceptance pin through the REAL protocol: M-shard fabric
+    rounds at several arrival permutations all land the bit-identical
+    mean the single-process IngestPool computes."""
+    workers = 6
+    pool = IngestPool(1)
+    for slot in range(workers):
+        leaves = [np.asarray([float(slot + 1), float(-slot)], np.float32)]
+        pool.submit(lambda l=leaves, n=5 + slot: (l, float(n)))
+    pool.drain()
+    ref, ref_count = pool.finalize_mean({"w": np.zeros(2, np.float32)})
+    pool.close()
+    for seed in (0, 3):
+        srv, agg = _fabric_round(m, seed, workers=workers)
+        assert srv.health()["shards"] == m
+        mean = agg.net
+        np.testing.assert_array_equal(np.asarray(mean["w"]),
+                                      np.asarray(ref["w"]))
+
+
+def test_shard_eviction_pre_flush_reroutes_and_matches_m1():
+    """Satellite pin: kill shard 2 before its workers arrive — the
+    coordinator evicts, re-routes with resend-flagged assignments, and
+    the committed round is bit-equal to a federation that NEVER had that
+    shard (equal arrivals, all via the survivor)."""
+    t = [0.0]
+    srv, shards, agg, network, mgrs = _fabric(m=2, workers=4, comm_round=1,
+                                              clock=lambda: t[0])
+    srv.send_init_msg()
+    _pump(network, mgrs)
+    routed = _assignments(network, srv)
+    via1 = sorted(w for w, s in routed.items() if s == 1)
+    via2 = sorted(w for w, s in routed.items() if s == 2)
+    assert via1 and via2
+    for w in via1:
+        slot = srv._worker_slot(w)
+        _post_upload(network, w, 1, [float(slot + 1), 0.5], n=4 + slot)
+    _pump(network, mgrs)
+    assert srv.round_idx == 0  # waiting on shard-2's workers
+    # Shard 2 goes silent past the heartbeat deadline; shard 1 beats on.
+    t[0] = 99.0
+    srv.shard_heartbeat.beat(1)
+    srv._post_shard_tick([2])
+    _pump(network, mgrs)
+    assert srv.health()["shards"] == 1
+    assert srv.shard_evictions == 1
+    assert any(e["kind"] == "shard_eviction"
+               for e in srv.flight.snapshot())
+    # The pulled-back workers were re-assigned, re-routed to shard 1.
+    rerouted = _assignments(network, srv)
+    assert {rerouted[w] for w in via2} == {1}
+    for w in via2:
+        slot = srv._worker_slot(w)
+        _post_upload(network, w, 1, [float(slot + 1), 0.5], n=4 + slot)
+    _pump(network, mgrs)
+    assert srv.round_idx == 1
+    # Never-had-that-shard reference: the same arrivals at M=1.
+    srv1, shards1, agg1, network1, mgrs1 = _fabric(m=1, workers=4,
+                                                   comm_round=1)
+    srv1.send_init_msg()
+    _pump(network1, mgrs1)
+    _assignments(network1, srv1)
+    for w in via1 + via2:
+        slot = srv1._worker_slot(w - 1)  # M=1 fabric: ranks shift by 1
+        _post_upload(network1, w - 1, 1, [float(slot + 1), 0.5], n=4 + slot)
+    _pump(network1, mgrs1)
+    assert srv1.round_idx == 1
+    np.testing.assert_array_equal(np.asarray(agg.net["w"]),
+                                  np.asarray(agg1.net["w"]))
+
+
+def test_shard_eviction_mid_flush_commits_over_survivor_partials():
+    """A shard dying AFTER the flush started: the round commits over the
+    surviving shards' partials, and the dead shard's workers rejoin at
+    the commit with next-round catch-up assignments."""
+    t = [0.0]
+    srv, shards, agg, network, mgrs = _fabric(m=2, workers=4, comm_round=3,
+                                              aggregate_k=2,
+                                              clock=lambda: t[0])
+    srv.send_init_msg()
+    _pump(network, mgrs)
+    routed = _assignments(network, srv)
+    via1 = sorted(w for w, s in routed.items() if s == 1)
+    via2 = sorted(w for w, s in routed.items() if s == 2)
+    for w in via1:
+        _post_upload(network, w, 1, [1.0, 2.0], n=10)
+    # Pump ONLY shard 1 + coordinator: shard 2 is wedged (its FLUSH sits
+    # unprocessed in its inbox — exactly a dying process).
+    live_mgrs = {0: mgrs[0], 1: mgrs[1]}
+    _pump(network, live_mgrs)
+    assert srv._flushing_round == 0  # k=2 reached, shard 2's partial missing
+    t[0] = 99.0
+    srv.shard_heartbeat.beat(1)
+    srv._post_shard_tick([2])
+    _pump(network, live_mgrs)
+    # The eviction completed the flush over shard 1's partial alone.
+    assert srv.round_idx == 1
+    assert srv.shard_evictions == 1
+    np.testing.assert_allclose(np.asarray(agg.net["w"]),
+                               np.asarray([1.0, 2.0]), atol=1e-6)
+    # Shard-2's workers caught up at the commit: fresh round-1
+    # assignments, re-routed to the survivor.
+    rerouted = _assignments(network, srv)
+    assert {rerouted.get(w) for w in via2} == {1}
+
+
+def test_shard_readmission_resyncs_and_routes_back():
+    """An evicted shard whose beats resume is re-admitted with a resync
+    anchor (discarding any orphaned folds) and takes routes again."""
+    t = [0.0]
+    srv, shards, agg, network, mgrs = _fabric(m=2, workers=4, comm_round=5,
+                                              clock=lambda: t[0])
+    srv.send_init_msg()
+    _pump(network, mgrs)
+    _assignments(network, srv)
+    t[0] = 99.0
+    srv.shard_heartbeat.beat(1)
+    srv._post_shard_tick([2])
+    _pump(network, mgrs)
+    assert srv.health()["shards"] == 1
+    # Shard 2 comes back: a BEAT re-admits it.
+    beat = Message(MSG_TYPE_SHARD2COORD_BEAT, 2, 0)
+    beat.add("epoch", 0)
+    srv.receive_message(beat.get_type(), beat)
+    _pump(network, mgrs)
+    h = srv.health()
+    assert h["shards"] == 2 and h["shard_readmissions"] == 1
+    assert any(e["kind"] == "shard_readmission"
+               for e in srv.flight.snapshot())
+    assert shards[2].round_idx == srv.round_idx  # resync adopted
+    assert srv._route_shard(1) == 2  # client 1 prefers shard 2 again
+
+
+def test_health_rolls_up_shard_bytes_and_saturation():
+    """Satellites: per-shard ByteLedger totals and pool saturation
+    gauges ride every PARTIAL and fold into coordinator ``health()``."""
+    srv, shards, agg, network, mgrs = _fabric(m=2, workers=4, comm_round=1,
+                                              wire="tensor")
+    srv.send_init_msg()
+    _pump(network, mgrs)
+    routed = _assignments(network, srv)
+    for w, s in routed.items():
+        _post_upload(network, w, s, [1.0, 1.0], n=3)
+    _pump(network, mgrs)
+    assert srv.round_idx == 1
+    own_rx = srv.com_manager.bytes_ledger.total_rx
+    shard_rx = {s: rx for s, (rx, _) in srv._shard_bytes.items()}
+    assert sorted(shard_rx) == [1, 2]
+    assert all(rx > 0 for rx in shard_rx.values())  # uploads were counted
+    h = srv.health()
+    assert h["bytes_rx"] == own_rx + sum(shard_rx.values())
+    assert h["bytes_rx"] > own_rx
+    # Saturation gauge: latest-wins per shard, summed fleet-wide. A
+    # stale-round PARTIAL still refreshes the gauges (they ride every
+    # frame) without touching flush state.
+    frame = encode_partial(PartialAccumulator())
+    frame["saturated"] = 4
+    stale = Message(MSG_TYPE_SHARD2COORD_PARTIAL, 1, 0)
+    stale.add(PARTIAL_KEY, frame)
+    stale.add("round", -5)
+    stale.add("epoch", 0)
+    stale.add("bytes_rx", shard_rx[1])
+    stale.add("bytes_tx", 0)
+    srv.receive_message(stale.get_type(), stale)
+    assert srv.health()["ingest_saturated"] == 4
+
+
+# --------------------------------------------------------------------------
+# Refusals: async tiers, the SIM, and the CLI drivers
+
+
+def test_async_server_managers_refuse_agg_shards():
+    from fedml_tpu.algos.fedasync import FedAsyncServerManager
+
+    args = _A()
+    args.network = LoopbackNetwork(3)
+    cfg = FedConfig(client_num_in_total=2, client_num_per_round=2,
+                    comm_round=2, agg_shards=2)
+    with pytest.raises(ValueError, match="agg_shards"):
+        FedAsyncServerManager(args, {"w": np.zeros(2, np.float32)}, cfg, 3)
+
+
+def test_sim_refuses_agg_shards_off_sync():
+    from fedml_tpu.sim import FleetSimulator, FleetSpec, make_fleet_trace
+
+    x, y = make_classification(64, n_features=4, n_classes=2, seed=0)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 2),
+                                 batch_size=16)
+    cfg = FedConfig(client_num_in_total=2, client_num_per_round=2,
+                    comm_round=1)
+    with pytest.raises(ValueError, match="agg_shards"):
+        FleetSimulator(LogisticRegression(num_classes=2), fed, None, cfg,
+                       make_fleet_trace(FleetSpec(n_devices=2, seed=0)),
+                       mode="fedbuff", agg_shards=2)
+
+
+def test_cli_runners_reject_agg_shards():
+    """The refusal convention at the driver layer: the simulator tier
+    and the specialty main_extra loops refuse ``--agg_shards`` (it is a
+    message-passing sync-FedAvg capability)."""
+    from fedml_tpu.exp import parse_args, run
+    from fedml_tpu.exp.args import reject_agg_shards_flag
+    from fedml_tpu.exp.main_extra import main as extra_main
+
+    args = parse_args([
+        "--model", "lr", "--dataset", "synthetic_1_1",
+        "--client_num_in_total", "4", "--client_num_per_round", "4",
+        "--comm_round", "1", "--agg_shards", "2"])
+    with pytest.raises(SystemExit, match="agg_shards"):
+        run(args, algorithm="FedAvg")
+    with pytest.raises(SystemExit, match="agg_shards"):
+        extra_main(["--algorithm", "VFL", "--agg_shards", "2",
+                    "--comm_round", "1"])
+    args.agg_shards = 0
+    reject_agg_shards_flag(args, "anything")  # 0 passes silently
+
+
+# --------------------------------------------------------------------------
+# End-to-end: live loopback federations + the deterministic SIM
+
+
+def _loopback_problem():
+    x, y = make_classification(160, n_features=12, n_classes=3, seed=2)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 4),
+                                 batch_size=16)
+    return fed
+
+
+def _loopback_run(m, fed, **kw):
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=2, epochs=1, batch_size=16, lr=0.3,
+                    frequency_of_the_test=10 ** 6,
+                    ingest_workers=(0 if m else 1))
+    return FedML_FedAvg_distributed(
+        LogisticRegression(num_classes=3), fed, None, cfg,
+        wire_codec="topk0.25+int8", loopback_wire="tensor",
+        agg_shards=m, **kw)
+
+
+def test_loopback_sharded_bit_equal_m_0_1_2_4():
+    """The headline acceptance pin: full loopback federations (real
+    threads, negotiated codec, tensor wire) at M ∈ {1, 2, 4} land the
+    net bit-identical to the single-process pooled path (M=0)."""
+    import jax
+
+    fed = _loopback_problem()
+    base = _loopback_run(0, fed)
+    for m in (1, 2, 4):
+        agg = _loopback_run(m, fed)
+        for a, b in zip(jax.tree.leaves(base.net), jax.tree.leaves(agg.net)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        h = agg.final_health
+        assert h["shards"] == m and h["shard_evictions"] == 0
+        assert h["bytes_rx"] > base.final_health["bytes_rx"]  # shard hops
+
+
+def test_loopback_kill_one_shard_drill():
+    """Satellite drill: kill one of two shards mid-federation — the
+    coordinator evicts it (flight-recorded), routes everything to the
+    survivor, and the run completes in the clean-accuracy ballpark."""
+    from fedml_tpu.algos.fedavg_distributed import (
+        FedAVGClientManager,
+        build_federation_setup,
+    )
+    from fedml_tpu.comm.loopback import run_workers
+    from fedml_tpu.trainer.local import softmax_ce
+
+    x, y = make_classification(240, n_features=10, n_classes=3, seed=4)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 4),
+                                 batch_size=16)
+    test = batch_global(x[:80], y[:80], 16)
+
+    def cfg():
+        return FedConfig(client_num_in_total=4, client_num_per_round=4,
+                         comm_round=4, epochs=1, batch_size=16, lr=0.3,
+                         frequency_of_the_test=10 ** 6,
+                         heartbeat_interval_s=0.05)
+
+    clean = FedML_FedAvg_distributed(LogisticRegression(num_classes=3),
+                                     fed, test, cfg(), agg_shards=2)
+    clean_acc = clean.test_history[-1]["accuracy"]
+
+    size, net0, local_train, eval_fn, args = build_federation_setup(
+        LogisticRegression(num_classes=3), fed, test, cfg(), "LOOPBACK",
+        softmax_ce, extra_ranks=2)
+    agg = FedAVGAggregator(net0, size - 3, cfg(), eval_fn, test)
+    srv = ShardedFedAVGServerManager(args, agg, cfg(), size, 2,
+                                     round_timeout_s=8.0,
+                                     heartbeat_timeout_s=0.5)
+    shards = [AggregatorShardManager(args, r, size, cfg(), net0)
+              for r in (1, 2)]
+    clients = [FedAVGClientManager(args, r, size, fed, local_train, cfg())
+               for r in range(3, size)]
+
+    def killer():
+        deadline = time.monotonic() + 10.0
+        while srv.round_idx < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        shards[1].finish()  # rank 2 dies: receive loop + beats stop
+
+    run_workers([srv.run] + [sh.run for sh in shards]
+                + [c.run for c in clients] + [killer])
+    assert srv.round_idx == 4 and not srv.aborted
+    assert srv.shard_evictions >= 1
+    assert any(e["kind"] == "shard_eviction" for e in srv.flight.snapshot())
+    drill_acc = agg.test_history[-1]["accuracy"]
+    assert abs(drill_acc - clean_acc) < 0.15
+
+
+def _sim_sharded(m, seed=5):
+    from fedml_tpu.sim import FleetSimulator, FleetSpec, make_fleet_trace
+
+    x, y = make_classification(120, n_features=8, n_classes=3, seed=1)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 3),
+                                 batch_size=16)
+    # Churn-free: everyone joins at t=0 and stays online, deadlines far
+    # beyond the power-law compute tail — the ONLY difference across M
+    # is the aggregation plane, so the nets must be bit-equal. The M=0
+    # baseline runs the pooled path (ingest_workers=1): the bit-equality
+    # contract is fixed-point-fold vs fixed-point-fold.
+    cfg = FedConfig(client_num_in_total=3, client_num_per_round=3,
+                    comm_round=2, epochs=1, batch_size=16, lr=0.3,
+                    frequency_of_the_test=10 ** 6, round_timeout_s=10 ** 6,
+                    ingest_workers=1)
+    spec = FleetSpec(n_devices=3, seed=seed, horizon_s=10 ** 7,
+                     mean_online=1.0, arrival_spread_s=0.0,
+                     base_round_s=25.0, slot_s=150.0)
+    sim = FleetSimulator(LogisticRegression(num_classes=3), fed, None, cfg,
+                         make_fleet_trace(spec), mode="sync", agg_shards=m,
+                         wire_codec="int8")
+    res = sim.run()
+    return res, sim.aggregator.net
+
+
+def test_sim_sync_sharded_bit_equal_and_deterministic():
+    """Virtual shards on the deterministic SIM fabric: a churn-free
+    sync drill at M=2 is bit-equal to the M=0 pooled baseline, and two
+    identical M=2 runs replay event-for-event."""
+    import jax
+
+    r0, n0 = _sim_sharded(0)
+    r2, n2 = _sim_sharded(2)
+    assert r0.completed and r2.completed and r2.updates == 2
+    assert r2.health["shards"] == 2
+    for a, b in zip(jax.tree.leaves(n0), jax.tree.leaves(n2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    r2b, n2b = _sim_sharded(2)
+    assert r2b.virtual_s == r2.virtual_s
+    for a, b in zip(jax.tree.leaves(n2), jax.tree.leaves(n2b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

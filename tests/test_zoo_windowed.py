@@ -218,6 +218,7 @@ def test_fednova_on_device_refusal_names_aux():
 
 # ---------------------------------------------------------------- Ditto --
 
+@pytest.mark.slow  # >8 s drill; tier-1 re-fit to the 870 s budget on the 1-core box (r16 audit)
 def test_windowed_ditto_bit_equal():
     """Ditto's personal-model stack is the carry: global params AND all
     personal models bit-equal across tiers (repeat clients inside one
