@@ -28,11 +28,22 @@ export XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 echo "== static check (compileall + fedlint; the reference ran pyflakes) =="
 python -m compileall -q fedml_tpu
-# fedlint: the repo's own AST analyzer for the JAX pitfalls PR 1 shipped
-# (carried rng chains, staging aliasing, host syncs in hot paths,
-# recompile hazards, donation misuse — docs/LINT.md). Exits nonzero on
-# any finding not covered by fedlint.baseline.json (kept empty: clean).
-python scripts/fedlint.py fedml_tpu --format=text
+# fedlint: the repo's own AST analyzer, both rule families — the JAX
+# pitfalls PR 1 shipped (carried rng chains, staging aliasing, host
+# syncs in hot paths, recompile hazards, donation misuse) and the
+# protocol/concurrency family (P1 thread-shared state, P2 drop-without-
+# reply, P3 flag-refusal coverage, P4 copy-divergence — docs/LINT.md).
+# Exits nonzero on any finding not covered by fedlint.baseline.json
+# (kept empty: clean); U1 dead suppressions gate here too (strict).
+# The JSON finding list lands beside the smoke logs as a CI artifact.
+lint_dir="${CI_RUN_DIR:-$(mktemp -d "${TMPDIR:-/tmp}/fedlint-ci.XXXXXX")}"
+mkdir -p "$lint_dir"
+lint_t0=$SECONDS
+python scripts/fedlint.py fedml_tpu --no-unused-suppressions \
+    --format=json > "$lint_dir/fedlint.json" \
+    || { cat "$lint_dir/fedlint.json"; exit 1; }
+echo "fedlint: clean in $((SECONDS - lint_t0))s" \
+     "(artifact: $lint_dir/fedlint.json)"
 
 common="--client_num_in_total 4 --client_num_per_round 4 --batch_size 8 \
         --comm_round 2 --epochs 1 --ci 1"

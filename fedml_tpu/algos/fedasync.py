@@ -291,7 +291,7 @@ class FedAsyncServerManager(ServerManager):
         while not self._stopped:
             with self._lock:
                 members = sorted(self._members)
-            if not members or self.version >= self.cfg.comm_round:
+            if not members or self._version_snapshot() >= self.cfg.comm_round:
                 failed = self.heartbeat.wait_all_or_failed(
                     members,
                     have=lambda: (members if self._stopped
@@ -315,6 +315,13 @@ class FedAsyncServerManager(ServerManager):
         # bounded termination.
         with self._lock:
             return sorted(self._done_set)
+
+    def _version_snapshot(self) -> int:
+        # The version counter commits on the dispatch thread (_ingest);
+        # the watchdog's termination test must read it under the same
+        # lock or it can act on a torn view of the commit.
+        with self._lock:
+            return self.version
 
     def _post_tick(self, failed) -> None:
         msg = Message(MSG_TYPE_SRV_TICK, 0, 0)
@@ -529,6 +536,10 @@ class FedAsyncServerManager(ServerManager):
                 self.duplicate_drops += 1
                 self.flight.record("duplicate_drop", sender=worker,
                                    task_seq=task)
+                # Deliberate reply-less drop: the FIRST copy of this
+                # task was already answered with an assignment —
+                # replying again would hand the worker two live tasks.
+                # fedlint: disable=P2(duplicate delivery; the first copy was replied to, a second reply double-assigns)
                 return
             self._last_upload_task[worker] = task
         # Negotiated delta capability (PR 15): a STAMPED upload whose
@@ -626,7 +637,10 @@ class FedAsyncServerManager(ServerManager):
         w = staleness_weight(self.alpha, staleness, self.staleness_exp)
         self.net = self._mix(self.net, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
                              jnp.float32(w))
-        self.version += 1
+        # Commit the version under the lock: the watchdog's termination
+        # test (_version_snapshot) races this increment otherwise.
+        with self._lock:
+            self.version += 1
 
 
 class FedAsyncClientManager(ClientManager):

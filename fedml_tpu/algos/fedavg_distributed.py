@@ -470,6 +470,13 @@ class FedAVGServerManager(ServerManager):
         with self._lock:
             return sorted(self._done_set)
 
+    def _round_snapshot(self) -> int:
+        # round_idx commits on the dispatch thread (_complete_round);
+        # the watchdog keys its deadline/eviction decisions off it and
+        # must read the committed value, not a torn one.
+        with self._lock:
+            return self.round_idx
+
     def _k_effective(self) -> int:
         return max(1, min(self.aggregate_k, len(self._members)))
 
@@ -609,10 +616,10 @@ class FedAVGServerManager(ServerManager):
                 # Either everyone is dead (the tick handler aborts) or an
                 # eviction storm is healing through beat re-admissions —
                 # keep watching either way.
-                self._post_tick(self.round_idx, [])
+                self._post_tick(self._round_snapshot(), [])
                 time.sleep(max(poll, 0.1))
                 continue
-            r = self.round_idx
+            r = self._round_snapshot()
             if r >= self.cfg.comm_round:
                 if self.done_timeout_s and self.done_timeout_s > 0:
                     failed = self.heartbeat.wait_all_or_failed(
@@ -624,10 +631,11 @@ class FedAVGServerManager(ServerManager):
                 failed = self.heartbeat.wait_all_or_failed(
                     members,
                     have=lambda m=members, r=r: (
-                        m if (self._stopped or self.round_idx != r)
+                        m if (self._stopped or self._round_snapshot() != r)
                         else self._arrived_snapshot()),
                     poll_s=poll, deadline_s=self.round_timeout_s)
-                if not self._stopped and failed and self.round_idx == r:
+                if not self._stopped and failed \
+                        and self._round_snapshot() == r:
                     self._post_tick(r, failed)
             time.sleep(poll)
 
@@ -727,6 +735,7 @@ class FedAVGServerManager(ServerManager):
             # work — reject deterministically, never reply.
             self.epoch_drops += 1
             self.flight.record("epoch_drop", sender=sender, epoch=int(ep))
+            # fedlint: disable=P2(stale-epoch frame; the epoch re-anchor already handed this worker live work, a reply would double-assign)
             return
         self.heartbeat.beat(sender)
         tag = msg.get("round")
@@ -738,6 +747,7 @@ class FedAVGServerManager(ServerManager):
                 # replying again would hand the worker two assignments.
                 self.duplicate_drops += 1
                 self.flight.record("duplicate_drop", sender=sender, round=t)
+                # fedlint: disable=P2(duplicate delivery; the first copy was replied to, a second reply double-assigns)
                 return
             self._last_upload_round[sender] = t
             if sender not in self._members:
@@ -797,9 +807,7 @@ class FedAVGServerManager(ServerManager):
             t0 = time.perf_counter()
             with tr.span("ingest.decode", cat="ingest", corr=ck,
                          codec=codec):
-                if codec not in self._decoders:
-                    self._decoders[codec] = make_compressor(codec)
-                delta = self._decoders[codec].decode(payload, self._spec)
+                delta = self._decoder_for(codec).decode(payload, self._spec)
                 payload = tree_add(self._broadcast_net, delta)
             self._h_decode.record((time.perf_counter() - t0) * 1e3)
         elif wcodec:
@@ -871,6 +879,17 @@ class FedAVGServerManager(ServerManager):
         if ready:
             self._complete_round()
 
+    def _decoder_for(self, codec: str):
+        """Get-or-create the per-codec decoder under the lock. With the
+        ingest pool armed, two workers can miss the cache for the same
+        codec at once and construct twin compressors — harmless for
+        stateless codecs, state-splitting for error-feedback ones."""
+        with self._lock:
+            dec = self._decoders.get(codec)
+            if dec is None:
+                dec = self._decoders[codec] = make_compressor(codec)
+        return dec
+
     def _submit_ingest(self, sender: int, round_idx: int, payload, codec,
                        wcodec, weight: float, ck, *,
                        is_delta: bool = False) -> None:
@@ -882,11 +901,10 @@ class FedAVGServerManager(ServerManager):
         anchor = self._broadcast_net
         spec = self._spec
 
+        # fedlint: twin-of(fedml_tpu/comm/shardplane.py)
         def task():
             if codec:
-                if codec not in self._decoders:
-                    self._decoders[codec] = make_compressor(codec)
-                delta = self._decoders[codec].decode(payload, spec)
+                delta = self._decoder_for(codec).decode(payload, spec)
             elif wcodec:
                 delta = self._wire_decoders.decode(wcodec, payload, spec)
             elif is_delta:
@@ -967,7 +985,11 @@ class FedAVGServerManager(ServerManager):
         ):
             self.aggregator.test_on_server(self.round_idx)
         completed = self.round_idx
-        self.round_idx += 1
+        # Commit the round under the lock: the watchdog keys deadlines
+        # and ticks off _round_snapshot() and must never see a torn
+        # increment.
+        with self._lock:
+            self.round_idx += 1
         self._log_round_health(completed, arrived)
         if self._ckpt is not None and self.cfg.checkpoint_every and (
             self.round_idx % self.cfg.checkpoint_every == 0
@@ -1078,6 +1100,7 @@ class FedAVGClientManager(ClientManager):
 
     def _send_beat(self) -> None:
         msg = Message(MSG_TYPE_C2S_HEARTBEAT, self.rank, 0)
+        # fedlint: disable=P1(epoch is a monotonically-adopted small int; a beat stamped with the pre-adoption epoch is indistinguishable from one sent just before adoption and the server accepts both)
         msg.add("epoch", self.epoch)
         self.send_message(msg)
 
@@ -1112,6 +1135,7 @@ class FedAVGClientManager(ClientManager):
                 # Server restarted: adopt its epoch and reset the round
                 # dedupe — the restored run legitimately replays rounds.
                 # The cached upload died with the old epoch.
+                # fedlint: disable=P1(single-writer adoption on the dispatch thread; the beat thread only stamps the value and tolerates the previous epoch)
                 self.epoch = ep
                 self._last_handled = -1
                 self._last_upload = None

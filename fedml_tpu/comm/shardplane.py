@@ -169,6 +169,10 @@ class AggregatorShardManager(ServerManager):
         self._anchor = None  # this round's broadcast net (delta base)
         self._spec = tree_spec(net_ref)
         self._decoders = {}  # legacy compressor name → compressor
+        # Guards the decoder cache only: pool workers get-or-create
+        # concurrently, and twin compressors would split error-feedback
+        # state across them.
+        self._lock = threading.Lock()
         self._wire_decoders = wire_codec.CodecCache()
         self.registry = MetricsRegistry()
         self._h_bytes = self.registry.histogram("bytes_per_upload", lo=1.0)
@@ -202,6 +206,7 @@ class AggregatorShardManager(ServerManager):
 
     def _send_beat(self) -> None:
         msg = Message(MSG_TYPE_SHARD2COORD_BEAT, self.rank, 0)
+        # fedlint: disable=P1(epoch is a monotonically-adopted small int; a beat stamped with the pre-adoption epoch is indistinguishable from one sent just before adoption and the coordinator accepts both)
         msg.add("epoch", self.epoch)
         self.send_message(msg)
 
@@ -223,6 +228,7 @@ class AggregatorShardManager(ServerManager):
             if ep > self.epoch:
                 # Coordinator restart: adopt the epoch; the dedupe marks
                 # die with the old epoch (the restored run replays rounds).
+                # fedlint: disable=P1(single-writer adoption on the dispatch thread; the beat thread only stamps the value and tolerates the previous epoch)
                 self.epoch = ep
                 self._last_upload_round.clear()
         if msg.get("done"):
@@ -318,11 +324,10 @@ class AggregatorShardManager(ServerManager):
         anchor = self._anchor
         spec = self._spec
 
+        # fedlint: twin-of(fedml_tpu/algos/fedavg_distributed.py)
         def task():
             if codec:
-                if codec not in self._decoders:
-                    self._decoders[codec] = make_compressor(codec)
-                delta = self._decoders[codec].decode(payload, spec)
+                delta = self._decoder_for(codec).decode(payload, spec)
             elif wcodec:
                 delta = self._wire_decoders.decode(wcodec, payload, spec)
             elif is_delta:
@@ -337,6 +342,18 @@ class AggregatorShardManager(ServerManager):
                     [np.asarray(a) for a in jax.tree.leaves(anchor)])
 
         self._pool.submit(task, **ck)
+
+    def _decoder_for(self, codec: str):
+        """Get-or-create the per-codec decoder under the lock. The
+        shard's pool always runs >=1 worker, so two tasks can miss the
+        cache for the same codec at once and construct twin compressors
+        — harmless for stateless codecs, state-splitting for
+        error-feedback ones."""
+        with self._lock:
+            dec = self._decoders.get(codec)
+            if dec is None:
+                dec = self._decoders[codec] = make_compressor(codec)
+        return dec
 
     def _notify(self, kind: str, worker: int, round_idx: int,
                 error=None) -> None:
@@ -755,11 +772,13 @@ class ShardedFedAVGServerManager(FedAVGServerManager):
         self.shard_heartbeat.beat(shard)
         ep = msg.get("epoch")
         if ep is not None and int(ep) != self.epoch:
+            # fedlint: disable=P2(stale-epoch partial; the resync ANCHOR already re-seated this shard at the live epoch, so it is not blocked waiting on a reply)
             return
         with self._lock:
             live = shard in self._live_shards
         if not live:
-            return  # evicted mid-flush; its workers were re-routed
+            # fedlint: disable=P2(evicted mid-flush; its workers were re-routed with resend assignments and the flush barrier no longer counts this shard)
+            return
         # The satellite rollups ride every partial (latest-wins gauges:
         # the shard's saturated count is a lifetime monotone, the ledger
         # totals are cumulative).
@@ -824,7 +843,10 @@ class ShardedFedAVGServerManager(FedAVGServerManager):
         if (r % self.cfg.frequency_of_the_test == 0
                 or r == self.cfg.comm_round - 1):
             self.aggregator.test_on_server(r)
-        self.round_idx = r + 1
+        # Commit under the lock: the inherited watchdog thread reads the
+        # round counter through the base class's locked snapshot.
+        with self._lock:
+            self.round_idx = r + 1
         self._log_round_health(r, arrived)
         if self._ckpt is not None and self.cfg.checkpoint_every and (
                 self.round_idx % self.cfg.checkpoint_every == 0):

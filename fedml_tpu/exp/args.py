@@ -32,6 +32,7 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--wd", type=float, default=1e-4)
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--comm_round", type=int, default=10)
+    # fedlint: disable=P3(reference-parity flag: the FedML launch scripts pass it; nothing in the JAX port branches on mobile clients)
     p.add_argument("--is_mobile", type=int, default=0)
     p.add_argument("--frequency_of_the_test", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)

@@ -25,7 +25,12 @@ def run_centralized(args):
     from functools import partial
 
     from fedml_tpu.algos.centralized import CentralizedTrainer
-    from fedml_tpu.exp.args import (config_from_args, reject_adapter_flags,
+    from fedml_tpu.exp.args import (config_from_args,
+                                    reject_adapter_flags,
+                                    reject_agg_shards_flag,
+                                    reject_async_tier_flags,
+                                    reject_fedavg_family_flags,
+                                    reject_ingest_pool_flag,
                                     reject_pod_plane_flags)
     from fedml_tpu.exp.run import SEQ_DATASETS
 
@@ -38,6 +43,15 @@ def run_centralized(args):
     # the pooled baseline trains every param — --adapter_rank here
     # would report an "adapter" anchor that actually trained dense.
     reject_adapter_flags(args, "the centralized baseline")
+    # No aggregation step at all: the fedavg-family knobs (trimmed-mean
+    # aggregator, corruption injection), the async tier, the ingest
+    # pool, and the shard plane are all server-side machinery this
+    # baseline does not instantiate. Refuse rather than silently train
+    # a pooled run labeled with federation knobs.
+    reject_fedavg_family_flags(args, "the centralized baseline")
+    reject_async_tier_flags(args, "the centralized baseline")
+    reject_ingest_pool_flag(args, "the centralized baseline")
+    reject_agg_shards_flag(args, "the centralized baseline")
     from fedml_tpu.exp.setup import (
         build_mesh,
         create_model_for,

@@ -77,6 +77,8 @@ def main(argv=None):
     # --ingest_workers (also shared) arms the server's parallel ingest
     # pool (comm/ingest.py; rank 0 only — silos ignore it): decode +
     # mean-fold off the dispatch thread, bit-equal for any worker count.
+    # The read happens through cfg on the rank-0 manager:
+    # fedlint: consumes(ingest_workers)
     parser.add_argument("--aggregate_k", type=int, default=0,
                         help="straggler-tolerant first-k rounds: aggregate "
                              "as soon as k fresh uploads arrive (0 = wait "

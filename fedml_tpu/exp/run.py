@@ -143,6 +143,12 @@ def run(args, algorithm: str = "FedAvg"):
     reject_async_tier_flags(args, algorithm)
     reject_ingest_pool_flag(args, algorithm)
     reject_agg_shards_flag(args, algorithm)
+    # The FedAvg-family knobs are LIVE on this tier, read through cfg
+    # rather than args: --aggregator/--corrupt_mode by FedAvgAPI's
+    # pluggable reduce + corruption drill, and the pod compute-plane
+    # trio by the shared round builders under setup_standard.
+    # fedlint: consumes(aggregator, corrupt_mode)
+    # fedlint: consumes(client_step_dtype, group_reduce, dcn_hosts)
     if algorithm != "FedAdapter":
         # Frozen-base adapter knobs configure FedAdapter only on this
         # tier — on any other algorithm they would silently train the

@@ -17,21 +17,46 @@ package AST and flags those classes before review has to:
   static args, ``print``/Python-state mutation inside traced code);
 - **R5** donation misuse (reading an argument after it was donated).
 
+The protocol/concurrency family (``lint/protocol.py`` per-module,
+``lint/project.py`` project-wide) covers the control-plane bug classes
+the federation managers have actually shipped:
+
+- **P1** thread-shared state: ``self.<attr>`` reachable from two
+  manager thread classes (dispatch / watchdog / beat / ingest pool)
+  accessed outside ``with self._lock``;
+- **P2** drop-without-reply: an upload-handler path that rejects a
+  message without a reply, refusal helper, eviction, flush-barrier
+  deferral, or recorded progress (the PR 5/PR 10 deadlock class);
+- **P3** flag-refusal coverage: a driver that neither consumes nor
+  refuses a gated CLI flag (silently-inert flags), plus orphan-flag
+  and dead-FedConfig-field warnings;
+- **P4** copy-divergence: near-clones across the sync/async/fedbuff/
+  shardplane twins must be factored or carry
+  a ``twin-of(<path>)`` fedlint annotation;
+- **U1** dead suppressions: a disable directive (or twin-of
+  annotation) whose rule no longer fires is itself a warning.
+
 Every finding carries a ``# fedlint: disable=RULE(reason)`` suppression
 syntax, a severity, and a file:line report; ``scripts/fedlint.py`` is
 the CLI (text/json output, baseline-gated exit status, ``--fix`` for
-the mechanical R1 rewrite). The runtime complement — transfer-guard +
-recompile counting for the steady-state round loop — lives in
-``fedml_tpu.obs.sanitizer``. See docs/LINT.md.
+the mechanical R1 rewrite, ``--changed[=REF]`` for the pre-commit
+fast path, ``--thread-report`` for the inferred per-class thread
+model). The runtime complement — transfer-guard + recompile counting
+for the steady-state round loop — lives in ``fedml_tpu.obs.sanitizer``.
+See docs/LINT.md.
 """
 
 from fedml_tpu.lint.analyzer import (
+    PROJECT_RULES,
     RULES,
     Violation,
     analyze_file,
     analyze_paths,
     analyze_source,
+    unused_suppressions,
 )
+from fedml_tpu.lint.project import analyze_project
+from fedml_tpu.lint.protocol import thread_model_report
 from fedml_tpu.lint.baseline import (
     fingerprint,
     load_baseline,
@@ -40,13 +65,17 @@ from fedml_tpu.lint.baseline import (
 )
 
 __all__ = [
+    "PROJECT_RULES",
     "RULES",
     "Violation",
     "analyze_file",
     "analyze_paths",
+    "analyze_project",
     "analyze_source",
     "fingerprint",
     "load_baseline",
     "new_violations",
+    "thread_model_report",
+    "unused_suppressions",
     "write_baseline",
 ]

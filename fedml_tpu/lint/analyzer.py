@@ -61,7 +61,44 @@ RULES: Dict[str, Tuple[str, str, str]] = {
         "argument read after being passed in a donate_argnums position "
         "(the buffer is deleted by donation)",
     ),
+    # protocol/concurrency family (lint/protocol.py, lint/project.py):
+    # the control-plane bug classes, not the JAX ones
+    "P1": (
+        "thread-shared-state",
+        "error",
+        "self attribute shared across manager thread classes (dispatch "
+        "/ watchdog / beat / ingest-pool) accessed outside the lock",
+    ),
+    "P2": (
+        "drop-without-reply",
+        "error",
+        "upload-handler path drops a message with no reply, refusal "
+        "helper, eviction, flush-barrier deferral, or recorded progress",
+    ),
+    "P3": (
+        "flag-refusal-coverage",
+        "error",
+        "driver neither consumes nor refuses a gated CLI flag (the "
+        "flag would be silently inert); plus orphan-flag / dead-config "
+        "warnings",
+    ),
+    "P4": (
+        "copy-divergence",
+        "warning",
+        "near-clone of a protocol twin in another module: factor the "
+        "shared logic or annotate the def with twin-of(<path>)",
+    ),
+    "U1": (
+        "unused-suppression",
+        "warning",
+        "fedlint suppression (or twin-of annotation) whose rule no "
+        "longer fires on the covered line",
+    ),
 }
+
+#: rules that need the whole file set at once (lint/project.py); the
+#: rest run per-module.
+PROJECT_RULES = frozenset({"P3", "P4"})
 
 _TRACING = {"jit", "pjit", "pmap", "vmap", "grad", "value_and_grad",
             "checkpoint", "remat", "shard_map"}
@@ -163,21 +200,74 @@ def _dynamic_test_names(test: ast.AST) -> Set[str]:
     return out
 
 
-def _parse_suppressions(source: str) -> Dict[int, Dict[str, Optional[str]]]:
-    """line -> {rule: reason}. A directive suppresses findings on its own
-    line; a comment-only directive line also covers the next line."""
-    out: Dict[int, Dict[str, Optional[str]]] = {}
+@dataclass
+class _Directive:
+    """One ``# fedlint: disable=RULE(reason)`` occurrence — kept as a
+    first-class object so dead suppressions are themselves lintable
+    (U1)."""
+    line: int
+    rule: str
+    reason: Optional[str]
+    covers: Tuple[int, ...]
+
+
+def _suppression_directives(source: str) -> List[_Directive]:
+    out: List[_Directive] = []
     for i, raw in enumerate(source.splitlines(), start=1):
         m = _SUPPRESS_RE.search(raw)
         if not m:
             continue
-        rules = {r: reason or None
-                 for r, reason in _SUPPRESS_ITEM_RE.findall(m.group(1))}
-        if not rules:
-            continue
-        out.setdefault(i, {}).update(rules)
-        if raw.lstrip().startswith("#"):  # standalone: covers next line
-            out.setdefault(i + 1, {}).update(rules)
+        covers = (i, i + 1) if raw.lstrip().startswith("#") else (i,)
+        for rule, reason in _SUPPRESS_ITEM_RE.findall(m.group(1)):
+            out.append(_Directive(line=i, rule=rule, reason=reason or None,
+                                  covers=covers))
+    return out
+
+
+def _parse_suppressions(source: str) -> Dict[int, Dict[str, Optional[str]]]:
+    """line -> {rule: reason}. A directive suppresses findings on its own
+    line; a comment-only directive line also covers the next line."""
+    out: Dict[int, Dict[str, Optional[str]]] = {}
+    for d in _suppression_directives(source):
+        for line in d.covers:
+            out.setdefault(line, {})[d.rule] = d.reason
+    return out
+
+
+def unused_suppressions(sources: Dict[str, str],
+                        violations: Sequence[Violation],
+                        rules: Optional[Set[str]] = None) -> List[Violation]:
+    """U1: directives whose rule fired on none of their covered lines.
+    ``rules`` limits the check to rules that actually ran — a partial
+    analysis (``--changed``) must not call a project-rule suppression
+    dead just because its pass had no file set to run over."""
+    fired: Set[Tuple[str, str, int]] = {
+        (v.path, v.rule, v.line) for v in violations if v.suppressed}
+    out: List[Violation] = []
+    for path in sorted(sources):
+        lines = sources[path].splitlines()
+        sup = _parse_suppressions(sources[path])
+        for d in _suppression_directives(sources[path]):
+            if d.rule not in RULES or (rules is not None
+                                       and d.rule not in rules):
+                continue
+            if d.rule == "U1":
+                continue  # disable=U1 is a deliberate opt-out, not debt
+            if any((path, d.rule, ln) in fired for ln in d.covers):
+                continue
+            v = Violation(
+                rule="U1", path=path, line=d.line, col=0,
+                message=f"suppression 'fedlint: disable={d.rule}' is "
+                        f"dead: {d.rule} no longer fires on the covered "
+                        "line — drop the directive (or re-check the "
+                        "fix it was excusing)",
+                severity=RULES["U1"][1],
+                source_line=(lines[d.line - 1].strip()
+                             if 0 < d.line <= len(lines) else ""))
+            if "U1" in sup.get(d.line, {}):
+                v.suppressed = True
+                v.suppress_reason = sup[d.line]["U1"]
+            out.append(v)
     return out
 
 
@@ -707,6 +797,12 @@ class _Analyzer:
         self._check_r2()
         self._check_hot_bodies()
         self._check_jit_bindings()
+        # P1/P2 live in their own module but report through self so
+        # suppressions and the baseline behave identically (imported
+        # lazily: protocol.py imports helpers from this module).
+        from fedml_tpu.lint import protocol
+
+        protocol.check_module(self)
         self.violations.sort(key=lambda v: (v.line, v.col, v.rule))
         return self.violations
 
@@ -722,23 +818,47 @@ def analyze_file(path: str) -> List[Violation]:
     return analyze_source(src, path)
 
 
-def analyze_paths(paths: Sequence[str]) -> List[Violation]:
+def analyze_paths(paths: Sequence[str],
+                  partial: bool = False) -> List[Violation]:
     """Walk files/dirs (``.py`` only, ``__pycache__`` skipped). A path
     that does not exist (or is a non-.py file) raises — a typo'd path in
-    a CI gate must fail loudly, not report a clean run over nothing."""
+    a CI gate must fail loudly, not report a clean run over nothing.
+
+    Runs the per-module rules on each file, then the project-wide
+    passes (P3/P4) over the whole set, then the dead-suppression check
+    (U1). ``partial=True`` marks the file set as a subset of the real
+    project (``--changed``): project passes still run over what is
+    there, but U1 only judges per-module rules — a project rule that
+    happened not to fire because its counterpart file is outside the
+    set does not make a suppression "dead"."""
     import os
 
-    out: List[Violation] = []
+    sources: Dict[str, str] = {}
     for p in paths:
         if os.path.isdir(p):
             for root, dirs, files in os.walk(p):
                 dirs[:] = sorted(d for d in dirs if d != "__pycache__")
                 for f in sorted(files):
                     if f.endswith(".py"):
-                        out.extend(analyze_file(os.path.join(root, f)))
+                        fp = os.path.join(root, f)
+                        with open(fp, "r", encoding="utf-8") as fh:
+                            sources[fp] = fh.read()
         elif os.path.isfile(p) and p.endswith(".py"):
-            out.extend(analyze_file(p))
+            with open(p, "r", encoding="utf-8") as fh:
+                sources[p] = fh.read()
         else:
             raise FileNotFoundError(
                 f"fedlint: {p!r} is not a directory or .py file")
+
+    out: List[Violation] = []
+    for fp in sorted(sources):
+        out.extend(analyze_source(sources[fp], fp))
+
+    from fedml_tpu.lint import project
+
+    out.extend(project.analyze_project(sources, partial=partial))
+    u1_rules = set(RULES) - {"U1"}
+    if partial:
+        u1_rules -= set(PROJECT_RULES)
+    out.extend(unused_suppressions(sources, out, rules=u1_rules))
     return out

@@ -6,6 +6,13 @@ non-baselined findings remain that it could not rewrite), 2 on usage
 errors, including paths that do not exist — a typo'd gate path must
 fail loudly. ``--write-baseline`` snapshots the current findings as
 the new debt ledger.
+
+``--changed[=REF]`` narrows the run to files touched vs a git ref
+(default ``HEAD``) plus untracked files — same rules, same baseline
+semantics, same exit codes; only the file set shrinks (so the
+pre-commit loop on a 1-core box stops paying the whole-package sweep).
+U1 (dead suppressions) stays advisory unless ``--no-unused-
+suppressions`` makes it gate, which is how ci.sh runs it.
 """
 
 from __future__ import annotations
@@ -13,6 +20,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from typing import List, Optional
 
@@ -48,13 +56,54 @@ def _to_json(violations: List[Violation]) -> str:
     )
 
 
+def _changed_files(ref: str, paths: List[str]) -> Optional[List[str]]:
+    """Intersect the expanded ``paths`` file set with the files touched
+    vs ``ref`` (diff + untracked). None on git failure (usage error)."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True).stdout.strip()
+        diff = subprocess.run(
+            ["git", "-C", top, "diff", "--name-only",
+             "--diff-filter=ACMR", ref],
+            capture_output=True, text=True, check=True).stdout
+        untracked = subprocess.run(
+            ["git", "-C", top, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, check=True).stdout
+    except (OSError, subprocess.CalledProcessError) as e:
+        msg = getattr(e, "stderr", "") or str(e)
+        print(f"fedlint --changed: git failed: {msg.strip()}",
+              file=sys.stderr)
+        return None
+    touched = {os.path.realpath(os.path.join(top, ln))
+               for ln in (diff + untracked).splitlines() if ln.strip()}
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                for f in sorted(files):
+                    fp = os.path.join(root, f)
+                    if f.endswith(".py") \
+                            and os.path.realpath(fp) in touched:
+                        out.append(fp)
+        elif os.path.isfile(p) and os.path.realpath(p) in touched:
+            out.append(p)
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="fedlint",
-        description="AST analysis for the JAX pitfalls this codebase has "
-                    "hit (R1 carried rng chains, R2 staging aliasing, R3 "
-                    "host syncs in hot paths, R4 recompile hazards, R5 "
-                    "donation misuse). See docs/LINT.md.")
+        description="AST analysis for the pitfalls this codebase has "
+                    "hit: the JAX family (R1 carried rng chains, R2 "
+                    "staging aliasing, R3 host syncs in hot paths, R4 "
+                    "recompile hazards, R5 donation misuse) and the "
+                    "federation control-plane family (P1 thread-shared "
+                    "state, P2 drop-without-reply, P3 flag-refusal "
+                    "coverage, P4 copy-divergence, U1 dead "
+                    "suppressions). See docs/LINT.md.")
     ap.add_argument("paths", nargs="+", help="files or directories")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--baseline", default=None,
@@ -72,6 +121,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(split-chain -> fold_in-on-index)")
     ap.add_argument("--dry-run", action="store_true",
                     help="with --fix: print the diff, change nothing")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="only analyze files touched vs the git ref "
+                         "(default HEAD) plus untracked files; exit "
+                         "codes and baseline semantics are identical "
+                         "to a full run")
+    ap.add_argument("--no-unused-suppressions", action="store_true",
+                    help="make U1 (dead suppressions / stale twin-of "
+                         "annotations) gate the exit code instead of "
+                         "being advisory")
+    ap.add_argument("--thread-report", action="store_true",
+                    help="print the per-class thread model (which "
+                         "methods run on which threads, which attrs "
+                         "are shared) and exit 0")
     args = ap.parse_args(argv)
 
     if args.rules:
@@ -82,8 +145,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         wanted = set(RULES)
 
+    if args.thread_report:
+        from fedml_tpu.lint.protocol import thread_model_report
+
+        report = thread_model_report(args.paths)
+        print(report or "fedlint: no multithreaded manager classes found")
+        return 0
+
+    paths: List[str] = args.paths
+    partial = False
+    if args.changed is not None:
+        changed = _changed_files(args.changed, args.paths)
+        if changed is None:
+            return 2
+        if not changed:
+            print("fedlint --changed: no touched .py files under the "
+                  "given paths")
+            return 0
+        paths, partial = changed, True
+
     try:
-        all_v = [v for v in analyze_paths(args.paths) if v.rule in wanted]
+        all_v = [v for v in analyze_paths(paths, partial=partial)
+                 if v.rule in wanted]
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -121,6 +204,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0 if not rest_new else 1
 
     fresh = new_violations(active, load_baseline(baseline_path or ""))
+    # U1 is advisory by default: printed, but only gating under
+    # --no-unused-suppressions (ci.sh runs strict).
+    gating = fresh if args.no_unused_suppressions \
+        else [v for v in fresh if v.rule != "U1"]
     shown = all_v if args.show_suppressed else active
     if args.format == "json":
         print(_to_json(shown))
@@ -128,13 +215,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         for v in shown:
             print(v.format())
         known = len(active) - len(fresh)
-        summary = (f"fedlint: {len(fresh)} new finding(s), {known} "
-                   f"baselined, "
+        advisory = len(fresh) - len(gating)
+        summary = (f"fedlint: {len(gating)} new finding(s)"
+                   + (f" (+{advisory} advisory)" if advisory else "")
+                   + f", {known} baselined, "
                    f"{sum(1 for v in all_v if v.suppressed)} suppressed "
                    f"across {len(set(v.path for v in all_v)) or 0} "
                    "file(s)")
         print(summary)
-    return 1 if fresh else 0
+    return 1 if gating else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,428 @@
+"""fedlint project-wide passes P3–P4: analyses that need more than one
+module at a time.
+
+P3 ``flag-refusal-coverage``
+    ``exp/args.py`` defines the shared CLI surface and the
+    ``reject_*_flags`` refusal helpers; every *driver* (a module that
+    calls ``parse_args``/``add_args`` and then reads ``args``) must,
+    for each gated flag group, either consume the flags or call the
+    matching refusal helper — otherwise ``--agg_shards 4`` on that
+    driver is silently inert (the bug class PRs 4, 6, 12 and 14 fixed
+    by hand, one driver at a time). Consumption that happens indirectly
+    (through ``config_from_args``/``setup_standard``) is declared with
+    a ``consumes(flag_a, flag_b)`` fedlint comment, which is itself
+    checked: the declared flag must exist.
+
+    Two secondary warnings close the loop from the other side: a flag
+    defined in ``add_args`` that no analyzed module ever reads and no
+    helper gates (orphan flag), and a ``FedConfig`` field populated by
+    ``config_from_args`` that nothing ever reads (dead config plumbing).
+
+P4 ``copy-divergence``
+    Normalized-AST near-clone detection across modules. The sync /
+    async / fedbuff / shardplane managers historically copied handler
+    logic and then diverged silently (the PR 10 decoder-cache lesson).
+    Function pairs in *different* files whose normalized statement
+    streams match above a similarity threshold must either be factored
+    or carry an explicit ``twin-of(<path>)`` fedlint annotation on
+    one side, acknowledging the twin so future edits know to mirror.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from fedml_tpu.lint.analyzer import (
+    RULES,
+    Violation,
+    _call_tail,
+    _dotted,
+    _parse_suppressions,
+)
+
+_CONSUMES_RE = re.compile(r"#\s*fedlint:\s*consumes\(([^)]*)\)")
+_TWIN_RE = re.compile(r"#\s*fedlint:\s*twin-of\(([^)]*)\)")
+
+#: P4 tuning: functions shorter than this many normalized statements
+#: are idiom, not clones; pairs at or above this similarity are twins.
+#: 10 is low enough to hold the decode-task closures the sync and shard
+#: planes share (the PR 10 divergence site) above the floor.
+P4_MIN_STMTS = 10
+P4_SIMILARITY = 0.85
+
+
+@dataclass
+class _Module:
+    path: str
+    source: str
+    tree: ast.Module
+    lines: List[str]
+    suppressions: Dict[int, Dict[str, Optional[str]]]
+    consumes: Set[str] = field(default_factory=set)
+    #: line -> declared twin path (from the twin-of directive)
+    twins: Dict[int, str] = field(default_factory=dict)
+    twin_used: Set[int] = field(default_factory=set)
+
+
+def _load(sources: Dict[str, str]) -> List[_Module]:
+    mods: List[_Module] = []
+    for path in sorted(sources):
+        source = sources[path]
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            continue
+        m = _Module(path=path, source=source, tree=tree,
+                    lines=source.splitlines(),
+                    suppressions=_parse_suppressions(source))
+        for match in _CONSUMES_RE.finditer(source):
+            m.consumes |= {f.strip() for f in match.group(1).split(",")
+                           if f.strip()}
+        for i, raw in enumerate(m.lines, start=1):
+            t = _TWIN_RE.search(raw)
+            if t:
+                m.twins[i] = t.group(1).strip()
+        mods.append(m)
+    return mods
+
+
+def _violation(mod: _Module, rule: str, line: int, message: str,
+               severity: Optional[str] = None) -> Violation:
+    sup = mod.suppressions.get(line, {})
+    v = Violation(
+        rule=rule, path=mod.path, line=line, col=0, message=message,
+        severity=severity or RULES[rule][1],
+        source_line=(mod.lines[line - 1].strip()
+                     if 0 < line <= len(mod.lines) else ""))
+    if rule in sup:
+        v.suppressed = True
+        v.suppress_reason = sup[rule]
+    return v
+
+
+# -- P3: flag-refusal coverage -------------------------------------------
+
+@dataclass
+class _ArgsSurface:
+    mod: _Module
+    flags: Set[str] = field(default_factory=set)
+    flag_lines: Dict[str, int] = field(default_factory=dict)
+    #: reject helper name -> flags it refuses
+    helpers: Dict[str, Set[str]] = field(default_factory=dict)
+    helper_lines: Dict[str, int] = field(default_factory=dict)
+    #: FedConfig field -> line in config_from_args
+    cfg_fields: Dict[str, int] = field(default_factory=dict)
+
+
+def _args_reads(tree: ast.AST, names: Sequence[str] = ("args",)) -> Set[str]:
+    """Flags read off an ``args`` namespace: ``args.x`` attribute loads
+    and ``getattr(args, "x", ...)``."""
+    out: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name) \
+                and n.value.id in names:
+            out.add(n.attr)
+        if isinstance(n, ast.Call) and _call_tail(n) == "getattr" \
+                and len(n.args) >= 2 \
+                and isinstance(n.args[0], ast.Name) \
+                and n.args[0].id in names \
+                and isinstance(n.args[1], ast.Constant) \
+                and isinstance(n.args[1].value, str):
+            out.add(n.args[1].value)
+    return out
+
+
+def _find_args_surface(mods: List[_Module]) -> Optional[_ArgsSurface]:
+    for mod in mods:
+        funcs = {n.name: n for n in ast.walk(mod.tree)
+                 if isinstance(n, ast.FunctionDef)}
+        add = funcs.get("add_args")
+        if add is None:
+            continue
+        surface = _ArgsSurface(mod=mod)
+        for n in ast.walk(add):
+            if isinstance(n, ast.Call) and _call_tail(n) == "add_argument" \
+                    and n.args and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str) \
+                    and n.args[0].value.startswith("--"):
+                flag = n.args[0].value.lstrip("-").replace("-", "_")
+                surface.flags.add(flag)
+                surface.flag_lines[flag] = n.lineno
+        if not surface.flags:
+            continue
+        for name, fn in funcs.items():
+            if not name.startswith("reject_"):
+                continue
+            gated = _args_reads(fn) & surface.flags
+            if gated:
+                surface.helpers[name] = gated
+                surface.helper_lines[name] = fn.lineno
+        cfa = funcs.get("config_from_args")
+        if cfa is not None:
+            for n in ast.walk(cfa):
+                if isinstance(n, ast.Call) and _call_tail(n) \
+                        in {"FedConfig", "replace"}:
+                    for kw in n.keywords:
+                        if kw.arg:
+                            surface.cfg_fields[kw.arg] = kw.value.lineno
+        return surface
+    return None
+
+
+def _is_driver(mod: _Module, surface: _ArgsSurface) -> bool:
+    """A driver binds the SHARED CLI surface: it imports from the args
+    module (or calls ``add_args``) and then parses + reads ``args``.
+    Merely owning some other argparse CLI (fedlint's own, say) with a
+    local ``parse_args`` call does not make a module a driver."""
+    if mod is surface.mod:
+        return False
+    calls = {_call_tail(n) for n in ast.walk(mod.tree)
+             if isinstance(n, ast.Call)}
+    stem = surface.mod.path.rsplit("/", 1)[-1].rsplit(".", 1)[0]
+    imports_surface = any(
+        isinstance(n, ast.ImportFrom) and n.module
+        and n.module.rsplit(".", 1)[-1] == stem
+        for n in ast.walk(mod.tree))
+    if "add_args" not in calls and not imports_surface:
+        return False
+    return bool({"parse_args", "add_args"} & calls) \
+        and bool(_args_reads(mod.tree))
+
+
+def _driver_anchor(mod: _Module) -> int:
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Call) and _call_tail(n) == "parse_args":
+            return n.lineno
+    for n in mod.tree.body:
+        if isinstance(n, ast.FunctionDef) and n.name == "main":
+            return n.lineno
+    return 1
+
+
+def _check_p3(mods: List[_Module],
+              partial: bool = False) -> List[Violation]:
+    surface = _find_args_surface(mods)
+    if surface is None:
+        return []
+    out: List[Violation] = []
+    all_reads: Set[str] = set()
+    drivers = [m for m in mods if _is_driver(m, surface)]
+    for mod in mods:
+        names = ("args", "a") if mod is surface.mod else ("args",)
+        all_reads |= _args_reads(mod.tree, names) & surface.flags
+
+    for mod in drivers:
+        reads = _args_reads(mod.tree) & surface.flags
+        called = {_call_tail(n) for n in ast.walk(mod.tree)
+                  if isinstance(n, ast.Call)}
+        bogus = mod.consumes - surface.flags
+        anchor = _driver_anchor(mod)
+        if bogus:
+            out.append(_violation(
+                mod, "P3", anchor,
+                "fedlint: consumes() declares flag(s) that exp/args.py "
+                f"does not define: {', '.join(sorted(bogus))}",
+                severity="warning"))
+        covered = reads | mod.consumes
+        for helper in sorted(surface.helpers):
+            gated = surface.helpers[helper]
+            if helper in called:
+                continue
+            missing = sorted(gated - covered)
+            if not missing:
+                continue
+            out.append(_violation(
+                mod, "P3", anchor,
+                f"driver neither consumes nor refuses gated flag(s) "
+                f"{', '.join('--' + f for f in missing)}: call "
+                f"{helper}(args, ...) so the flag fails loudly instead "
+                "of being silently inert, or read it (declare indirect "
+                "consumption with a fedlint consumes(...) comment)"))
+
+    # The dead-flag / dead-field warnings are WHOLE-PROGRAM properties:
+    # a flag is only dead if NO module reads it. On a --changed subset
+    # (args.py in the diff, its consumers not) absence of a reader means
+    # nothing — skip them rather than spray false positives. The
+    # per-driver coverage checks above stay: driver and surface are both
+    # in the set, so those judgments are complete.
+    if drivers and not partial:
+        gated_anywhere: Set[str] = set()
+        for gated in surface.helpers.values():
+            gated_anywhere |= gated
+        for flag in sorted(surface.flags):
+            if flag not in all_reads and flag not in gated_anywhere:
+                out.append(_violation(
+                    surface.mod, "P3", surface.flag_lines[flag],
+                    f"--{flag} is defined but no analyzed module reads "
+                    "it and no reject_* helper gates it: dead flag "
+                    "surface (wire it up, gate it, or drop it)",
+                    severity="warning"))
+        field_reads: Set[str] = set()
+        for mod in mods:
+            if mod is surface.mod:
+                continue
+            for n in ast.walk(mod.tree):
+                if isinstance(n, ast.Attribute):
+                    field_reads.add(n.attr)
+                elif isinstance(n, ast.Call) \
+                        and _call_tail(n) == "getattr" \
+                        and len(n.args) >= 2 \
+                        and isinstance(n.args[1], ast.Constant) \
+                        and isinstance(n.args[1].value, str):
+                    # getattr(cfg, "field", default) reads count too —
+                    # the duck-typed config idiom all over algos/.
+                    field_reads.add(n.args[1].value)
+        for fld in sorted(surface.cfg_fields):
+            if fld not in field_reads:
+                out.append(_violation(
+                    surface.mod, "P3", surface.cfg_fields[fld],
+                    f"FedConfig field {fld!r} is populated by "
+                    "config_from_args but never read by any analyzed "
+                    "module: dead config plumbing", severity="warning"))
+    return out
+
+
+# -- P4: copy-divergence --------------------------------------------------
+
+@dataclass
+class _Fingerprint:
+    mod: _Module
+    qualname: str
+    line: int
+    tokens: List[str]
+    bag: Set[str]
+
+
+def _normalize_stmt(stmt: ast.stmt) -> str:
+    """One token per statement: the statement's shape with identifiers
+    erased but attribute/call vocabulary kept, so renamed locals still
+    match while genuinely different protocol logic does not."""
+    parts: List[str] = [type(stmt).__name__]
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Attribute):
+            parts.append(f".{n.attr}")
+        elif isinstance(n, ast.Call):
+            tail = _call_tail(n)
+            if tail:
+                parts.append(f"{tail}()")
+        elif isinstance(n, ast.Constant):
+            parts.append("c")
+        elif isinstance(n, (ast.For, ast.While, ast.If, ast.With,
+                            ast.Try, ast.Return, ast.Raise)):
+            parts.append(type(n).__name__)
+    return "|".join(parts)
+
+
+def _fingerprints(mods: List[_Module]) -> List[_Fingerprint]:
+    out: List[_Fingerprint] = []
+    for mod in mods:
+        stack: List[Tuple[ast.AST, str]] = [(mod.tree, "")]
+        while stack:
+            node, prefix = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    stack.append((child, f"{prefix}{child.name}."))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    tokens = [_normalize_stmt(s) for s in ast.walk(child)
+                              if isinstance(s, ast.stmt)
+                              and s is not child]
+                    if len(tokens) >= P4_MIN_STMTS:
+                        out.append(_Fingerprint(
+                            mod=mod, qualname=qual, line=child.lineno,
+                            tokens=tokens, bag=set(tokens)))
+                    stack.append((child, f"{prefix}{child.name}.<locals>."))
+    return out
+
+
+def _twin_declared(fp: _Fingerprint, other: _Fingerprint) -> bool:
+    """True when ``fp``'s def line (or the line above) carries a
+    ``twin-of(<path>)`` fedlint comment naming ``other``'s file."""
+    for line in (fp.line, fp.line - 1):
+        declared = fp.mod.twins.get(line)
+        if declared and (other.mod.path.endswith(declared)
+                         or declared in other.mod.path):
+            fp.mod.twin_used.add(line)
+            return True
+    return False
+
+
+def _check_p4(mods: List[_Module]) -> List[Violation]:
+    fps = _fingerprints(mods)
+    out: List[Violation] = []
+    seen: Set[Tuple[str, int]] = set()
+    for i, a in enumerate(fps):
+        for b in fps[i + 1:]:
+            if a.mod.path == b.mod.path:
+                continue
+            la, lb = len(a.tokens), len(b.tokens)
+            if min(la, lb) * 1.0 / max(la, lb) < 0.6:
+                continue
+            inter = len(a.bag & b.bag)
+            union = len(a.bag | b.bag)
+            if union == 0 or inter / union < 0.5:
+                continue
+            ratio = difflib.SequenceMatcher(
+                a=a.tokens, b=b.tokens, autojunk=False).ratio()
+            if ratio < P4_SIMILARITY:
+                continue
+            # report on the later file (sorted order) so the finding
+            # has one stable home
+            first, second = ((a, b) if a.mod.path < b.mod.path
+                             else (b, a))
+            # Evaluate BOTH sides (no short-circuit): either side's
+            # annotation acknowledges the pair, and both must be marked
+            # used or the quieter side's annotation reads as dead (U1).
+            declared_second = _twin_declared(second, first)
+            declared_first = _twin_declared(first, second)
+            suppressed_by_twin = declared_second or declared_first
+            if (second.mod.path, second.line) in seen:
+                continue
+            seen.add((second.mod.path, second.line))
+            v = _violation(
+                second.mod, "P4", second.line,
+                f"{second.qualname} is a near-clone of "
+                f"{first.mod.path}:{first.line} ({first.qualname}, "
+                f"similarity {ratio:.2f}): protocol twins diverge "
+                "silently — factor the shared logic or annotate "
+                "the def with a fedlint twin-of(<path>) comment so "
+                "future edits mirror "
+                "both sides")
+            if suppressed_by_twin:
+                v.suppressed = True
+                v.suppress_reason = "twin-of annotation"
+            out.append(v)
+    return out
+
+
+def _unused_twins(mods: List[_Module]) -> List[Violation]:
+    out: List[Violation] = []
+    for mod in mods:
+        for line in sorted(set(mod.twins) - mod.twin_used):
+            out.append(_violation(
+                mod, "U1", line,
+                f"twin-of({mod.twins[line]}) annotation matches no "
+                "P4 near-clone pair: the twin diverged past the "
+                "similarity threshold (re-mirror it) or the annotation "
+                "is stale (drop it)"))
+    return out
+
+
+def analyze_project(sources: Dict[str, str],
+                    partial: bool = False) -> List[Violation]:
+    """Run the project-wide passes over ``{path: source}``. Used by
+    ``analyze_paths`` for real trees and directly by fixture tests.
+    ``partial=True`` marks the set as a subset of the real project
+    (``--changed``): the whole-program P3 warnings and the stale
+    twin-of sweep are skipped — their judgments need every file."""
+    mods = _load(sources)
+    out = _check_p3(mods, partial=partial) + _check_p4(mods)
+    if not partial:
+        out.extend(_unused_twins(mods))
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out

@@ -1,0 +1,643 @@
+"""fedlint protocol/concurrency passes P1–P2 over one module at a time.
+
+The control-plane bug classes this file mechanizes are the ones this
+repo has actually shipped (see docs/LINT.md for the post-mortems):
+
+P1 ``thread-shared-state``
+    Every hard race so far had the same shape: a manager class whose
+    methods run on different threads (the dispatch loop, a watchdog
+    ``threading.Thread``, a ``HeartbeatSender`` beat thread, or the
+    ``IngestPool`` workers) touching the same ``self.<attr>`` where at
+    least one side skipped ``with self._lock``. PR 5's unlocked
+    ``sorted(self._done_set)`` in the watchdog is the canonical case.
+    The pass classifies each method by the thread classes that can run
+    it, closes the classification over ``self.m()`` calls, tracks
+    ``with self._lock`` regions per method, and flags cross-thread
+    attributes accessed outside them.
+
+P2 ``drop-without-reply``
+    A server upload handler that rejects a message and simply returns
+    leaves the sender waiting forever — the PR 5 / PR 10 deadlock.
+    Every handler path must end in a *terminal action* (send a reply,
+    route through a shared ``_refuse*``/``_evict*``/``_notify*``/
+    ``_send*`` helper, re-raise to the flush barrier via an
+    ``IngestPool`` submit, call ``finish()``, or raise) or *recorded
+    progress* (the upload folded into protocol state), or carry an
+    explicit ``disable=P2(reason)`` fedlint suppression.
+
+Resolution is per-module and name-based, like the R-rules: methods a
+class inherits from another module are invisible, so handler discovery
+falls back to the repo-wide ``_?handle_*`` naming convention and
+terminal discovery falls back to the shared helper-name prefixes.
+False negatives are accepted; false positives should be rare enough
+that suppressions stay reviewed, deliberate acts.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from fedml_tpu.lint.analyzer import _call_tail, _dotted
+
+# Thread-entry discovery --------------------------------------------------
+
+#: ``with self.<attr>:`` context managers treated as lock regions.
+_LOCKISH_RE = re.compile(r"(^|_)(lock|locks|cv|cond|condition|mutex)s?$",
+                         re.IGNORECASE)
+#: attribute names whose ``.submit(fn)`` / ``.run(fn)`` hand ``fn`` to
+#: worker threads (comm/ingest.py IngestPool and friends).
+_POOLISH_RE = re.compile(r"(^|_)pool$", re.IGNORECASE)
+#: message-type constant names whose registered handler is an *upload*
+#: handler for P2 (model uploads, delta frames, shard partials).
+_UPLOAD_MSG_RE = re.compile(r"SEND_MODEL|UPLOAD|DELTA|PARTIAL")
+#: method-name fallbacks for helpers inherited from other modules: these
+#: prefixes are the repo's shared refusal/reply vocabulary.
+_TERMINAL_NAME_RE = re.compile(
+    r"^(_?send_|_send\b|_refuse|_evict|_notify|_post_tick|finish$)")
+_HANDLERISH_RE = re.compile(r"^_?handle_")
+
+#: method calls that mutate a collection in place (P1 write detection;
+#: broader than analyzer._MUTATORS — ``update`` here is dict.update on
+#: self state, not optax).
+_P1_MUTATORS = {"append", "extend", "insert", "add", "setdefault",
+                "pop", "popitem", "remove", "discard", "clear",
+                "update", "fill", "sort"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` -> ``"x"`` (one level only), else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclass
+class _Access:
+    attr: str
+    write: bool
+    locked: bool
+    latch: bool  # plain ``self.x = True/False/None`` store
+    node: ast.AST
+
+
+@dataclass
+class _Method:
+    name: str
+    node: ast.AST
+    is_init: bool = False
+    tags: Set[str] = field(default_factory=set)
+    self_concurrent: bool = False
+    calls: Set[str] = field(default_factory=set)  # self.m() callee names
+    accesses: List[_Access] = field(default_factory=list)
+
+
+@dataclass
+class _ClassModel:
+    name: str
+    node: ast.ClassDef
+    methods: Dict[str, _Method] = field(default_factory=dict)
+    #: (message-constant tail, handler method name) registrations
+    registrations: List[Tuple[str, str]] = field(default_factory=list)
+    locked_attrs: Set[str] = field(default_factory=set)
+
+
+def _method_scope(node: ast.AST):
+    """Walk a method body without descending into nested defs/lambdas;
+    yields the nested def node itself once (callers decide what to do
+    with it)."""
+    stack = list(node.body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# -- model construction ---------------------------------------------------
+
+def build_class_model(cls: ast.ClassDef) -> _ClassModel:
+    model = _ClassModel(name=cls.name, node=cls)
+    defs = [n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for d in defs:
+        m = _Method(name=d.name, node=d, is_init=(d.name == "__init__"))
+        model.methods[d.name] = m
+        # nested defs (the IngestPool task closures) are pseudo-methods:
+        # they run wherever they are handed to, not where they are
+        # written.
+        for n in _method_scope(d):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                model.methods[f"{d.name}.{n.name}"] = _Method(
+                    name=f"{d.name}.{n.name}", node=n)
+    for d in defs:
+        _scan_entries(model, model.methods[d.name])
+    for m in list(model.methods.values()):
+        _collect_accesses(model, m)
+    _classify(model)
+    return model
+
+
+def _entry_target(model: _ClassModel, parent: _Method,
+                  node: ast.AST) -> Optional[str]:
+    """Resolve a callable handed to a thread entry point to a method
+    name in this class: ``self.m`` or a nested def bound in ``parent``."""
+    a = _self_attr(node)
+    if a is not None and a in model.methods:
+        return a
+    if isinstance(node, ast.Name):
+        nested = f"{parent.name}.{node.id}"
+        if nested in model.methods:
+            return nested
+    return None
+
+
+def _scan_entries(model: _ClassModel, m: _Method) -> None:
+    """Tag methods by the thread classes that can invoke them."""
+    # The manager run loop *is* the dispatch thread (managers.py:
+    # ``run()`` drives ``handle_receive_message``), and registered
+    # handlers run on it. ``_?handle_*`` covers handlers whose
+    # registration lives in a base class in another module.
+    if m.name == "run" or _HANDLERISH_RE.match(m.name):
+        m.tags.add("dispatch")
+
+    def tag(target: Optional[str], label: str, concurrent: bool) -> None:
+        if target is None or target not in model.methods:
+            return
+        tgt = model.methods[target]
+        tgt.tags.add(label)
+        tgt.self_concurrent |= concurrent
+
+    loop_depth = 0
+
+    def walk(node: ast.AST, loops: int) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not m.node:
+            return
+        bump = loops + (1 if isinstance(
+            node, (ast.For, ast.While, ast.ListComp, ast.SetComp,
+                   ast.DictComp, ast.GeneratorExp)) else 0)
+        if isinstance(node, ast.Call):
+            tail = _call_tail(node)
+            if tail == "register_message_receive_handler" \
+                    and len(node.args) >= 2:
+                tgt = _entry_target(model, m, node.args[1])
+                if tgt is not None:
+                    model.methods[tgt].tags.add("dispatch")
+                const = _dotted(node.args[0])
+                if const and tgt is not None:
+                    model.registrations.append(
+                        (const.rsplit(".", 1)[-1], tgt))
+            elif tail == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        tgt = _entry_target(model, m, kw.value)
+                        tag(tgt, f"thread:{tgt}", bump > 0)
+            elif tail == "Timer" and len(node.args) >= 2:
+                tgt = _entry_target(model, m, node.args[1])
+                tag(tgt, f"thread:{tgt}", bump > 0)
+            elif tail == "HeartbeatSender" and node.args:
+                tgt = _entry_target(model, m, node.args[0])
+                tag(tgt, f"beat:{tgt}", bump > 0)
+            elif tail in {"submit", "run"} \
+                    and isinstance(node.func, ast.Attribute):
+                base = _self_attr(node.func.value)
+                if base is not None and _POOLISH_RE.search(base) \
+                        and node.args:
+                    tgt = _entry_target(model, m, node.args[0])
+                    # IngestPool runs N workers: pool entries are
+                    # concurrent with themselves by construction.
+                    tag(tgt, "pool", True)
+        for child in ast.iter_child_nodes(node):
+            walk(child, bump)
+
+    for stmt in m.node.body:
+        walk(stmt, loop_depth)
+
+
+def _lock_item(item: ast.withitem) -> bool:
+    expr = item.context_expr
+    # ``with self._lock:`` and ``with self._cv:`` open a guarded region.
+    a = _self_attr(expr)
+    return a is not None and bool(_LOCKISH_RE.search(a))
+
+
+def _collect_accesses(model: _ClassModel, m: _Method) -> None:
+    def record(attr: str, write: bool, locked: bool, latch: bool,
+               node: ast.AST) -> None:
+        if _LOCKISH_RE.search(attr):
+            return
+        if locked:
+            model.locked_attrs.add(attr)
+        m.accesses.append(_Access(attr, write, locked, latch, node))
+
+    def visit(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not m.node:
+            return  # nested defs are their own pseudo-methods
+        if isinstance(node, ast.With):
+            inner = locked or any(_lock_item(i) for i in node.items)
+            for item in node.items:
+                visit(item.context_expr, locked)
+                if item.optional_vars is not None:
+                    visit(item.optional_vars, locked)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if isinstance(node, ast.Assign):
+            latch = isinstance(node.value, ast.Constant) \
+                and node.value.value in (True, False, None) \
+                and len(node.targets) == 1
+            for t in node.targets:
+                for sub in ast.walk(t):
+                    a = _self_attr(sub)
+                    if a is not None \
+                            and isinstance(sub.ctx, (ast.Store, ast.Del)):
+                        record(a, True, locked, latch, node)
+            visit(node.value, locked)
+            # subscript stores on self state: self.d[k] = v
+            for t in node.targets:
+                if isinstance(t, (ast.Subscript,)):
+                    a = _self_attr(t.value)
+                    if a is not None:
+                        record(a, True, locked, False, node)
+                    visit(t.slice, locked)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            t = node.target
+            a = _self_attr(t)
+            if a is not None:
+                record(a, True, locked, False, node)
+            elif isinstance(t, ast.Subscript):
+                a = _self_attr(t.value)
+                if a is not None:
+                    record(a, True, locked, False, node)
+            if node.value is not None:
+                visit(node.value, locked)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                a = _self_attr(t)
+                if a is None and isinstance(t, ast.Subscript):
+                    a = _self_attr(t.value)
+                if a is not None:
+                    record(a, True, locked, False, node)
+            return
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _P1_MUTATORS:
+                a = _self_attr(node.func.value)
+                if a is not None:
+                    record(a, True, locked, False, node)
+        if isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+            a = _self_attr(node)
+            if a is not None:
+                record(a, False, locked, False, node)
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked)
+
+    for stmt in m.node.body:
+        visit(stmt, False)
+
+
+def _classify(model: _ClassModel) -> None:
+    """Close thread tags over ``self.m()`` calls (a helper called from
+    the watchdog runs on the watchdog thread)."""
+    for m in model.methods.values():
+        for n in _method_scope(m.node):
+            if isinstance(n, ast.Call):
+                a = _self_attr(n.func)
+                if a is not None and a in model.methods:
+                    m.calls.add(a)
+    changed = True
+    while changed:
+        changed = False
+        for m in model.methods.values():
+            if not m.tags:
+                continue
+            for callee in m.calls:
+                tgt = model.methods[callee]
+                if tgt.is_init:
+                    continue
+                if not (m.tags <= tgt.tags):
+                    tgt.tags |= m.tags
+                    changed = True
+                if m.self_concurrent and not tgt.self_concurrent:
+                    tgt.self_concurrent = True
+                    changed = True
+
+
+# -- P1: thread-shared-state ---------------------------------------------
+
+@dataclass
+class _AttrFacts:
+    written_outside_init: bool = False
+    all_latch: bool = True
+    writer_tags: Set[str] = field(default_factory=set)
+    tagsets: List[Tuple[Set[str], bool]] = field(default_factory=list)
+
+
+def _attr_facts(model: _ClassModel) -> Dict[str, _AttrFacts]:
+    facts: Dict[str, _AttrFacts] = {}
+    for m in model.methods.values():
+        for acc in m.accesses:
+            f = facts.setdefault(acc.attr, _AttrFacts())
+            if acc.write and not m.is_init:
+                f.written_outside_init = True
+                if not acc.latch:
+                    f.all_latch = False
+                if m.tags:
+                    f.writer_tags |= m.tags
+            if m.tags and not m.is_init:
+                f.tagsets.append((m.tags, m.self_concurrent))
+    return facts
+
+
+def _shared_attrs(model: _ClassModel) -> Dict[str, _AttrFacts]:
+    """Attributes reachable from >= 2 thread classes (or one
+    self-concurrent class) with at least one real post-init write."""
+    out: Dict[str, _AttrFacts] = {}
+    for attr, f in _attr_facts(model).items():
+        if not f.written_outside_init or f.all_latch:
+            continue  # immutable config / stop-latch idiom: exempt
+        tags: Set[str] = set()
+        concurrent = False
+        for tagset, conc in f.tagsets:
+            tags |= tagset
+            concurrent |= conc
+        if len(tags) >= 2 or (concurrent and tags):
+            out[attr] = f
+    return out
+
+
+def _check_p1(analyzer, model: _ClassModel) -> None:
+    shared = _shared_attrs(model)
+    if not shared:
+        return
+    reported: Set[Tuple[str, str]] = set()
+    for m in model.methods.values():
+        if not m.tags or m.is_init:
+            continue
+        for acc in m.accesses:
+            f = shared.get(acc.attr)
+            if f is None or acc.locked:
+                continue
+            if (acc.attr, m.name) in reported:
+                continue
+            guarded = acc.attr in model.locked_attrs
+            if not acc.write:
+                # A read on the single writer thread is sequential with
+                # every write — the snapshot discipline only matters
+                # across threads.
+                if f.writer_tags and m.tags == f.writer_tags \
+                        and len(f.writer_tags) == 1 \
+                        and not m.self_concurrent:
+                    continue
+                if not guarded and not f.writer_tags:
+                    # never-locked attr written only from unclassified
+                    # helpers: flag the writes, not every read
+                    continue
+            reported.add((acc.attr, m.name))
+            tags = ", ".join(sorted(m.tags))
+            if guarded:
+                analyzer.report(
+                    "P1", acc.node,
+                    f"self.{acc.attr} is lock-guarded elsewhere in "
+                    f"{model.name} but "
+                    f"{'mutated' if acc.write else 'read'} here without "
+                    f"the lock; this method runs on [{tags}] while "
+                    "other threads touch the same attribute — take the "
+                    "lock or use the *_snapshot() idiom")
+            else:
+                analyzer.report(
+                    "P1", acc.node,
+                    f"self.{acc.attr} is shared across thread classes "
+                    f"[{', '.join(sorted(set().union(*[t for t, _ in f.tagsets])))}] "
+                    f"in {model.name} but never lock-guarded; "
+                    f"{'this write' if acc.write else 'this read'} races "
+                    "— guard it with the manager lock")
+
+
+# -- P2: drop-without-reply ----------------------------------------------
+
+def _primitive_terminal(node: ast.AST, model: _ClassModel) -> bool:
+    if isinstance(node, ast.Raise):
+        return True
+    if not isinstance(node, ast.Call):
+        return False
+    tail = _call_tail(node)
+    if tail in {"send_message", "finish"}:
+        return True
+    a = _self_attr(node.func)
+    if a is not None and _TERMINAL_NAME_RE.match(a):
+        return True
+    if tail in {"submit", "run"} and isinstance(node.func, ast.Attribute):
+        base = _self_attr(node.func.value)
+        # handing the upload to the IngestPool defers the refusal to
+        # the flush barrier (drain() replays errors through the shared
+        # refusal helper) — terminal by design.
+        if base is not None and _POOLISH_RE.search(base):
+            return True
+    return False
+
+
+def _primitive_progress(node: ast.AST) -> bool:
+    """The upload was folded into protocol state: a collection on self
+    mutated (arrived maps, done sets, pending buffers)."""
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) \
+                    and _self_attr(t.value) is not None:
+                return True
+    if isinstance(node, ast.Call) \
+            and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _P1_MUTATORS \
+            and _self_attr(node.func.value) is not None:
+        return True
+    return False
+
+
+def _acting_methods(model: _ClassModel) -> Set[str]:
+    """Fixpoint of methods that terminate or progress the protocol
+    somewhere in their body (callees count)."""
+    acting: Set[str] = set()
+    for name, m in model.methods.items():
+        for n in _method_scope(m.node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if _primitive_terminal(n, model) or _primitive_progress(n):
+                acting.add(name)
+                break
+    changed = True
+    while changed:
+        changed = False
+        for name, m in model.methods.items():
+            if name in acting:
+                continue
+            if m.calls & acting:
+                acting.add(name)
+                changed = True
+    return acting
+
+
+def _upload_handlers(model: _ClassModel) -> List[_Method]:
+    names: Set[str] = set()
+    for const, meth in model.registrations:
+        if _UPLOAD_MSG_RE.search(const):
+            names.add(meth)
+    # inherited registrations are invisible per-module: fall back to the
+    # handler naming convention for upload-shaped names
+    for name in model.methods:
+        if _HANDLERISH_RE.match(name) and re.search(
+                r"upload|model_from_client|partial|delta", name):
+            names.add(name)
+    return [model.methods[n] for n in sorted(names) if n in model.methods]
+
+
+def _check_p2(analyzer, model: _ClassModel) -> None:
+    acting = _acting_methods(model)
+
+    def stmt_acts(node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if _primitive_terminal(n, model) or _primitive_progress(n):
+                return True
+            if isinstance(n, ast.Call):
+                a = _self_attr(n.func)
+                if a is not None and a in acting:
+                    return True
+        return False
+
+    def bad_return(node: ast.Return) -> bool:
+        return node.value is None or not stmt_acts(node.value)
+
+    def check_block(stmts: Sequence[ast.stmt], acted: bool,
+                    handler: _Method) -> Tuple[bool, bool]:
+        """-> (acted_at_fall_through, terminated). Reports P2 at any
+        return reached with nothing done for the sender."""
+        for stmt in stmts:
+            if isinstance(stmt, ast.Return):
+                if not acted and bad_return(stmt):
+                    analyzer.report(
+                        "P2", stmt,
+                        f"upload-handler path in {model.name}."
+                        f"{handler.name} returns without a terminal "
+                        "action (reply / shared refusal helper / "
+                        "eviction / pool deferral / finish / raise) or "
+                        "recorded progress — the PR 5/PR 10 "
+                        "drop-without-reply deadlock shape; reply or "
+                        "evict before dropping, or suppress with the "
+                        "reason the sender cannot be waiting")
+                return True, True
+            if isinstance(stmt, ast.Raise):
+                return True, True
+            if isinstance(stmt, ast.If):
+                acted_in = acted or stmt_acts(stmt.test)
+                a_body, t_body = check_block(stmt.body, acted_in, handler)
+                a_else, t_else = check_block(stmt.orelse, acted_in, handler)
+                if t_body and t_else:
+                    return True, True
+                conts = [a for a, t in ((a_body, t_body), (a_else, t_else))
+                         if not t]
+                acted = all(conts) if conts else acted
+                continue
+            if isinstance(stmt, ast.Try):
+                a_body, t_body = check_block(stmt.body, acted, handler)
+                for h in stmt.handlers:
+                    check_block(h.body, acted, handler)
+                if stmt.orelse:
+                    check_block(stmt.orelse, a_body, handler)
+                if stmt.finalbody:
+                    check_block(stmt.finalbody, acted, handler)
+                acted = acted or stmt_acts(stmt)
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                check_block(stmt.body, acted, handler)
+                check_block(stmt.orelse, acted, handler)
+                acted = acted or stmt_acts(stmt)
+                continue
+            if isinstance(stmt, ast.With):
+                acted_in = acted or any(
+                    stmt_acts(i.context_expr) for i in stmt.items)
+                a_body, t_body = check_block(stmt.body, acted_in, handler)
+                if t_body:
+                    return True, True
+                acted = a_body
+                continue
+            if stmt_acts(stmt):
+                acted = True
+        return acted, False
+
+    for handler in _upload_handlers(model):
+        acted, terminated = check_block(handler.node.body, False, handler)
+        if not terminated and not acted:
+            analyzer.report(
+                "P2", handler.node,
+                f"upload handler {model.name}.{handler.name} can fall "
+                "through having neither replied, refused, evicted, "
+                "deferred to the flush barrier, nor recorded the "
+                "upload — the sender would wait forever")
+
+
+# -- entry points ---------------------------------------------------------
+
+def check_module(analyzer) -> None:
+    """Run P1 + P2 over every class in ``analyzer.tree``; violations go
+    through ``analyzer.report`` so suppressions/baseline Just Work."""
+    for node in ast.walk(analyzer.tree):
+        if isinstance(node, ast.ClassDef):
+            model = build_class_model(node)
+            _check_p1(analyzer, model)
+            _check_p2(analyzer, model)
+
+
+def thread_model_report(paths: Sequence[str]) -> str:
+    """Human-readable per-class thread model (``fedlint
+    --thread-report``): which methods run on which threads, and which
+    attributes are shared across them."""
+    import os
+
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                files.extend(os.path.join(root, f) for f in sorted(names)
+                             if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    lines: List[str] = []
+    for path in files:
+        with open(path, "r", encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read())
+            except SyntaxError:
+                continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            model = build_class_model(node)
+            tagged = {n: m for n, m in model.methods.items() if m.tags}
+            if not any(t != {"dispatch"} for t in
+                       (m.tags for m in tagged.values())):
+                continue  # single-threaded class: nothing to report
+            lines.append(f"{path}:{node.lineno}: class {model.name}")
+            for name in sorted(tagged):
+                m = tagged[name]
+                conc = " (self-concurrent)" if m.self_concurrent else ""
+                lines.append(
+                    f"  {name}: [{', '.join(sorted(m.tags))}]{conc}")
+            shared = _shared_attrs(model)
+            for attr in sorted(shared):
+                guard = ("locked" if attr in model.locked_attrs
+                         else "UNGUARDED")
+                lines.append(f"  shared self.{attr}: {guard}")
+    return "\n".join(lines)

@@ -1,0 +1,775 @@
+"""fedlint protocol/concurrency family: P1 thread-shared state, P2
+drop-without-reply, P3 flag-refusal coverage, P4 copy-divergence, U1
+dead suppressions, and the ``--changed`` pre-commit fast path.
+
+Each rule gets a positive fixture replaying the real bug class it was
+built from (the PR 5 unlocked done-set read, the PR 5/PR 10
+drop-without-reply deadlock, a driver with a silently-inert
+``--agg_shards``, a twin edited on one side only) and a suppressed /
+annotated fixture. The regression fixtures at the bottom replay the
+EXACT pre-fix shapes of the true findings this PR fixed in
+algos/fedasync.py, algos/fedavg_distributed.py and comm/shardplane.py —
+the rules must stay red on the old shape while the shipped tree stays
+clean (tests/test_fedlint.py's package gate).
+"""
+
+import json
+import os
+import subprocess
+import textwrap
+import threading
+
+import numpy as np
+
+import fedml_tpu
+from fedml_tpu.lint import analyze_paths, analyze_project, analyze_source
+from fedml_tpu.lint.cli import main as fedlint_main
+from fedml_tpu.lint.protocol import thread_model_report
+
+PKG_DIR = os.path.dirname(os.path.abspath(fedml_tpu.__file__))
+
+
+def _findings(src, rule=None, suppressed=False):
+    out = [v for v in analyze_source(textwrap.dedent(src), "fixture.py")
+           if v.suppressed == suppressed]
+    return [v for v in out if v.rule == rule] if rule else out
+
+
+# ---------------------------------------------------------------------------
+# P1 — thread-shared state
+
+
+P1_DONE_SET = """
+    import threading
+
+    class Manager:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._done_set = set()
+            self._watchdog = threading.Thread(target=self._watchdog_loop)
+
+        def _handle_upload(self, msg):
+            with self._lock:
+                self._done_set.add(msg.sender)
+            self._send_ack(msg.sender)
+
+        def _watchdog_loop(self):
+            while True:
+                missing = sorted(self._done_set)
+                self._post_tick(missing)
+"""
+
+
+def test_p1_pr5_unlocked_done_set_read_flagged():
+    # The canonical PR 5 race: the dispatch thread mutates the done set
+    # under the lock, the watchdog thread reads it bare.
+    vs = _findings(P1_DONE_SET, "P1")
+    assert len(vs) == 1, [v.format() for v in vs]
+    assert vs[0].severity == "error"
+    assert "_done_set" in vs[0].message
+    assert "lock-guarded elsewhere" in vs[0].message
+
+
+def test_p1_locked_read_is_clean():
+    fixed = P1_DONE_SET.replace(
+        "                missing = sorted(self._done_set)",
+        "                with self._lock:\n"
+        "                    missing = sorted(self._done_set)")
+    assert not _findings(fixed, "P1")
+
+
+def test_p1_suppression():
+    src = P1_DONE_SET.replace(
+        "missing = sorted(self._done_set)",
+        "missing = sorted(self._done_set)  "
+        "# fedlint: disable=P1(fixture reason)")
+    assert not _findings(src, "P1")
+    sup = _findings(src, "P1", suppressed=True)
+    assert len(sup) == 1 and sup[0].suppress_reason == "fixture reason"
+
+
+def test_p1_init_only_writes_exempt():
+    # epoch-style config adopted in __init__ and only read afterwards
+    clean = """
+        import threading
+
+        class Manager:
+            def __init__(self):
+                self.epoch = 0
+                t = threading.Thread(target=self._beat)
+
+            def _handle_upload(self, msg):
+                self._send_ack(msg.sender, self.epoch)
+
+            def _beat(self):
+                self._send_beat(self.epoch)
+    """
+    assert not _findings(clean, "P1")
+
+
+def test_p1_stop_latch_exempt():
+    # the `self._stopped = True` latch idiom is not a race worth a lock
+    clean = """
+        import threading
+
+        class Manager:
+            def __init__(self):
+                self._stopped = False
+                t = threading.Thread(target=self._beat)
+
+            def _handle_upload(self, msg):
+                self._stopped = True
+
+            def _beat(self):
+                while not self._stopped:
+                    self._send_beat()
+    """
+    assert not _findings(clean, "P1")
+
+
+def test_p1_heartbeat_sender_entry_tagged():
+    # HeartbeatSender(self._send_beat, ...) puts _send_beat on the beat
+    # thread; a non-latch shared counter read there must be flagged.
+    src = """
+        class Manager:
+            def __init__(self):
+                self._lock = Lock()
+                self.seq = 0
+                self._beats = HeartbeatSender(self._send_beat, 1.0)
+
+            def _handle_upload(self, msg):
+                with self._lock:
+                    self.seq += 1
+                self._send_ack(msg.sender)
+
+            def _send_beat(self):
+                self._post(self.seq)
+    """
+    vs = _findings(src, "P1")
+    assert len(vs) == 1 and "seq" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
+# P1 — the ingest-pool decoder-cache race (the PR 10 lesson, fixed for
+# real in fedavg_distributed + shardplane this PR)
+
+
+P1_POOL_PREFIX = """
+    class Server:
+        def __init__(self):
+            import threading
+            self._lock = threading.Lock()
+            self._decoders = {}
+            self._pool = IngestPool(2)
+"""
+
+P1_POOL_RACY = P1_POOL_PREFIX + """
+        def _handle_upload(self, msg):
+            def task():
+                if msg.codec not in self._decoders:
+                    self._decoders[msg.codec] = make_compressor(msg.codec)
+                return self._decoders[msg.codec].decode(msg.payload)
+            self._pool.submit(task)
+"""
+
+P1_POOL_FIXED = P1_POOL_PREFIX + """
+        def _handle_upload(self, msg):
+            def task():
+                return self._decoder_for(msg.codec).decode(msg.payload)
+            self._pool.submit(task)
+
+        def _decoder_for(self, codec):
+            with self._lock:
+                dec = self._decoders.get(codec)
+                if dec is None:
+                    dec = self._decoders[codec] = make_compressor(codec)
+            return dec
+"""
+
+
+def test_p1_pool_task_decoder_cache_race_flagged():
+    # pre-fix shape of fedavg_distributed._submit_ingest /
+    # shardplane._submit_upload: get-or-create on self._decoders inside
+    # the pool task — two workers can construct twin compressors.
+    vs = _findings(P1_POOL_RACY, "P1")
+    assert vs, "pool-task write to self._decoders must be flagged"
+    assert any("_decoders" in v.message for v in vs)
+
+
+def test_p1_pool_task_locked_get_or_create_clean():
+    # the shipped fix: the locked _decoder_for helper
+    assert not _findings(P1_POOL_FIXED, "P1")
+
+
+P1_VERSION_RACY = """
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.version = 0
+            t = threading.Thread(target=self._watchdog)
+
+        def _handle_upload(self, msg):
+            self._ingest(msg)
+            self._send_ack(msg.sender)
+
+        def _ingest(self, msg):
+            self.version += 1
+
+        def _watchdog(self):
+            if self.version >= 10:
+                self.finish()
+"""
+
+
+def test_p1_version_counter_race_flagged_both_sides():
+    # pre-fix shape of fedasync: the dispatch thread commits version
+    # bare and the watchdog reads it bare — both sides race.
+    vs = _findings(P1_VERSION_RACY, "P1")
+    assert len(vs) == 2, [v.format() for v in vs]
+    assert all("version" in v.message for v in vs)
+    assert any("never lock-guarded" in v.message for v in vs)
+
+
+P1_VERSION_FIXED = """
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.version = 0
+            t = threading.Thread(target=self._watchdog)
+
+        def _handle_upload(self, msg):
+            self._ingest(msg)
+            self._send_ack(msg.sender)
+
+        def _ingest(self, msg):
+            with self._lock:
+                self.version += 1
+
+        def _version_snapshot(self):
+            with self._lock:
+                return self.version
+
+        def _watchdog(self):
+            if self._version_snapshot() >= 10:
+                self.finish()
+"""
+
+
+def test_p1_version_counter_snapshot_idiom_clean():
+    # the shipped fedasync fix: locked commit + locked snapshot read
+    assert not _findings(P1_VERSION_FIXED, "P1")
+
+
+# ---------------------------------------------------------------------------
+# P2 — drop-without-reply
+
+
+P2_DROP = """
+    class Server:
+        def register_message_receive_handlers(self):
+            self.com_manager.register_message_receive_handler(
+                MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+                self.handle_message_receive_model_from_client)
+
+        def handle_message_receive_model_from_client(self, msg):
+            r = msg.get("round")
+            if r != self.round_idx:
+                return
+            self._arrived[msg.sender] = msg.payload
+            self._send_ack(msg.sender)
+"""
+
+
+def test_p2_silent_drop_flagged():
+    # the PR 5/PR 10 deadlock replay: a stale-round upload dropped with
+    # a bare return — the sender waits forever for its next assignment
+    vs = _findings(P2_DROP, "P2")
+    assert len(vs) == 1 and vs[0].severity == "error"
+    assert "drop-without-reply" in vs[0].message or "terminal" in vs[0].message
+
+
+def test_p2_refusal_helper_before_drop_is_clean():
+    fixed = P2_DROP.replace(
+        "            if r != self.round_idx:\n"
+        "                return",
+        "            if r != self.round_idx:\n"
+        "                self._refuse_upload(msg.sender, r)\n"
+        "                return")
+    assert not _findings(fixed, "P2")
+
+
+def test_p2_pool_deferral_is_terminal():
+    # handing the upload to the IngestPool defers refusal to the flush
+    # barrier — terminal by design
+    fixed = P2_DROP.replace(
+        "            if r != self.round_idx:\n"
+        "                return",
+        "            if r != self.round_idx:\n"
+        "                self._pool.submit(lambda: self._refuse(r))\n"
+        "                return")
+    assert not _findings(fixed, "P2")
+
+
+def test_p2_raise_is_terminal():
+    fixed = P2_DROP.replace(
+        "                return",
+        "                raise ValueError(r)")
+    assert not _findings(fixed, "P2")
+
+
+def test_p2_suppression():
+    src = P2_DROP.replace(
+        "            if r != self.round_idx:\n"
+        "                return",
+        "            if r != self.round_idx:\n"
+        "                # fedlint: disable=P2(duplicate delivery fixture)\n"
+        "                return")
+    assert not _findings(src, "P2")
+    sup = _findings(src, "P2", suppressed=True)
+    assert len(sup) == 1 \
+        and sup[0].suppress_reason == "duplicate delivery fixture"
+
+
+def test_p2_fall_through_with_nothing_done_flagged():
+    src = """
+        class Server:
+            def _handle_upload(self, msg):
+                payload = msg.payload
+                log.info("got %s", payload)
+    """
+    vs = _findings(src, "P2")
+    assert len(vs) == 1 and "fall" in vs[0].message
+
+
+def test_p2_non_upload_handlers_not_checked():
+    # heartbeat/notice handlers may legitimately just record and return
+    src = """
+        class Server:
+            def _handle_heartbeat(self, msg):
+                if msg.sender not in self._live:
+                    return
+                log.info("beat")
+    """
+    assert not _findings(src, "P2")
+
+
+# ---------------------------------------------------------------------------
+# P3 — flag-refusal coverage (project-wide, fixture modules)
+
+
+ARGS_SRC = textwrap.dedent("""
+    import argparse
+
+    def add_args(p):
+        p.add_argument("--lr", type=float, default=0.1)
+        p.add_argument("--agg_shards", type=int, default=0)
+
+    def parse_args(argv):
+        p = argparse.ArgumentParser()
+        add_args(p)
+        return p.parse_args(argv)
+
+    def reject_agg_shards_flag(args, algorithm):
+        if getattr(args, "agg_shards", 0):
+            raise SystemExit(algorithm)
+
+    def config_from_args(args):
+        return FedConfig(lr=args.lr, dead_knob=args.lr,
+                         duck_knob=args.lr)
+""")
+
+DRIVER_BAD = textwrap.dedent("""
+    from exp.args import config_from_args, parse_args
+
+    def main(argv):
+        args = parse_args(argv)
+        cfg = config_from_args(args)
+        duck = getattr(cfg, "duck_knob", 0)
+        return train(cfg.lr, args.lr, duck)
+""")
+
+
+def _p3(driver_src, args_src=ARGS_SRC):
+    return [v for v in analyze_project({"exp/args.py": args_src,
+                                        "exp/run.py": driver_src})
+            if v.rule == "P3"]
+
+
+def test_p3_unguarded_agg_shards_flagged():
+    # the seeded regression: a driver that parses the shared surface but
+    # neither consumes nor refuses --agg_shards
+    vs = [v for v in _p3(DRIVER_BAD) if not v.suppressed]
+    hits = [v for v in vs if "agg_shards" in v.message]
+    assert len(hits) == 1 and hits[0].path == "exp/run.py"
+    assert "reject_agg_shards_flag" in hits[0].message
+
+
+def test_p3_refusal_call_covers():
+    good = DRIVER_BAD.replace(
+        "from exp.args import config_from_args, parse_args",
+        "from exp.args import (config_from_args, parse_args,\n"
+        "                      reject_agg_shards_flag)",
+    ).replace(
+        "    cfg = config_from_args(args)",
+        "    reject_agg_shards_flag(args, \"fixture\")\n"
+        "    cfg = config_from_args(args)")
+    assert not [v for v in _p3(good)
+                if not v.suppressed and "agg_shards" in v.message]
+
+
+def test_p3_consumes_annotation_covers_and_is_checked():
+    good = DRIVER_BAD.replace(
+        "    args = parse_args(argv)",
+        "    # fedlint: consumes(agg_shards)\n"
+        "    args = parse_args(argv)")
+    assert not [v for v in _p3(good)
+                if not v.suppressed and "agg_shards" in v.message]
+    # a consumes() naming a flag the surface does not define is itself
+    # a finding — annotations must not rot
+    bogus = DRIVER_BAD.replace(
+        "    args = parse_args(argv)",
+        "    # fedlint: consumes(no_such_flag)\n"
+        "    args = parse_args(argv)")
+    assert any("no_such_flag" in v.message for v in _p3(bogus))
+
+
+def test_p3_non_surface_cli_is_not_a_driver():
+    # a module with its OWN argparse CLI (fedlint's cli.py shape) must
+    # not be held to the shared surface's refusal matrix
+    other_cli = textwrap.dedent("""
+        import argparse
+
+        def main(argv):
+            ap = argparse.ArgumentParser()
+            ap.add_argument("--format", default="text")
+            args = ap.parse_args(argv)
+            return args.format
+    """)
+    assert not _p3(other_cli)
+
+
+def test_p3_orphan_flag_and_dead_cfg_field_warnings():
+    args_src = ARGS_SRC.replace(
+        '    p.add_argument("--lr", type=float, default=0.1)',
+        '    p.add_argument("--lr", type=float, default=0.1)\n'
+        '    p.add_argument("--is_mobile_fixture", type=int)')
+    assert "is_mobile_fixture" in args_src
+    vs = _p3(DRIVER_BAD, args_src)
+    # orphan flag: defined, never read, never gated
+    assert any("is_mobile_fixture" in v.message and v.severity == "warning"
+               for v in vs)
+    # dead FedConfig field: populated by config_from_args, read nowhere
+    assert any("dead_knob" in v.message for v in vs)
+    # ...but getattr(cfg, "duck_knob", 0) COUNTS as a read (the duck-
+    # typed config idiom): must not be flagged dead
+    assert not any("duck_knob" in v.message for v in vs)
+
+
+def test_p3_whole_program_warnings_skipped_in_partial_mode():
+    # the --changed false-positive class: args.py lands in the diff with
+    # ONE driver while the flag's real consumers sit outside the set —
+    # "no analyzed module reads it" is then vacuous, not evidence.
+    args_src = ARGS_SRC.replace(
+        '    p.add_argument("--lr", type=float, default=0.1)',
+        '    p.add_argument("--lr", type=float, default=0.1)\n'
+        '    p.add_argument("--is_mobile_fixture", type=int)')
+    vs = [v for v in analyze_project({"exp/args.py": args_src,
+                                      "exp/run.py": DRIVER_BAD},
+                                     partial=True)
+          if v.rule == "P3"]
+    assert not any("is_mobile_fixture" in v.message for v in vs)
+    assert not any("dead_knob" in v.message for v in vs)
+    # the per-driver coverage judgment is complete (driver AND surface
+    # are both in the set) and must still fire
+    assert any("agg_shards" in v.message for v in vs if not v.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# P4 — copy-divergence (project-wide, fixture modules)
+
+
+P4_FN = textwrap.dedent("""
+    def fold(self, payload, spec):
+        total = 0
+        items = []
+        for leaf in payload:
+            v = self.decode(leaf, spec)
+            items.append(v)
+            total += v.size
+        if not items:
+            self.log("empty")
+            return None
+        out = self.merge(items)
+        self.record(total)
+        self.notify(out)
+        return out
+""")
+
+P4_EDITED = P4_FN.replace("self.record(total)",
+                          "self.record(total * self.scale)")
+
+
+def _p4(a_src, b_src, partial=False):
+    return [v for v in analyze_project({"plane_a.py": a_src,
+                                        "plane_b.py": b_src},
+                                       partial=partial)
+            if v.rule in ("P4", "U1")]
+
+
+def test_p4_edited_in_one_twin_flagged():
+    # the seeded regression: a handler copied across planes, then edited
+    # on one side only — still a near-clone, silently diverging
+    vs = _p4(P4_FN, P4_EDITED)
+    assert len(vs) == 1 and vs[0].rule == "P4"
+    assert vs[0].path == "plane_b.py" and not vs[0].suppressed
+    assert "near-clone" in vs[0].message and "plane_a.py" in vs[0].message
+
+
+def test_p4_twin_of_annotation_suppresses():
+    annotated = "# fedlint: twin-of(plane_a.py)\n" + P4_EDITED.lstrip("\n")
+    vs = _p4(P4_FN, annotated)
+    assert len(vs) == 1 and vs[0].rule == "P4" and vs[0].suppressed
+    assert vs[0].suppress_reason == "twin-of annotation"
+
+
+def test_p4_both_sides_annotated_neither_reads_dead():
+    # regression for the or-short-circuit: when BOTH planes carry the
+    # annotation, both must be marked used — no U1 on the quiet side
+    a = "# fedlint: twin-of(plane_b.py)\n" + P4_FN.lstrip("\n")
+    b = "# fedlint: twin-of(plane_a.py)\n" + P4_EDITED.lstrip("\n")
+    vs = _p4(a, b)
+    assert [v.rule for v in vs] == ["P4"] and vs[0].suppressed
+
+
+def test_p4_genuinely_different_functions_clean():
+    other = textwrap.dedent("""
+        def route(self, msg, table):
+            rank = table.get(msg.sender)
+            if rank is None:
+                self.refuse(msg)
+                return None
+            frame = self.encode(msg)
+            for hop in self.path_to(rank):
+                frame = hop.wrap(frame)
+            self.transmit(rank, frame)
+            self.count += 1
+            self.audit(msg.sender, rank)
+            return rank
+    """)
+    assert not _p4(P4_FN, other)
+
+
+def test_p4_stale_twin_of_is_dead_annotation():
+    # annotation names a file it no longer matches -> U1, not silence
+    other = "# fedlint: twin-of(plane_a.py)\ndef tiny(self):\n    return 1\n"
+    vs = _p4(P4_FN, other)
+    assert len(vs) == 1 and vs[0].rule == "U1"
+    assert "twin-of" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
+# U1 — dead suppressions + the strict CLI gate
+
+
+def test_u1_dead_suppression_detected(tmp_path, capsys):
+    mod = tmp_path / "mod.py"
+    mod.write_text("def f(x):\n"
+                   "    return x  # fedlint: disable=R3(stale excuse)\n")
+    vs = analyze_paths([str(mod)])
+    assert [v.rule for v in vs] == ["U1"]
+    assert "R3" in vs[0].message
+    # advisory by default; gating under --no-unused-suppressions
+    assert fedlint_main([str(mod)]) == 0
+    assert fedlint_main([str(mod), "--no-unused-suppressions"]) == 1
+    capsys.readouterr()
+
+
+def test_u1_live_suppression_not_flagged(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        import jax
+
+        def hot(x):
+            return float(x)  # fedlint: disable=R3(fixture)
+
+        jitted = jax.jit(hot)
+    """))
+    assert not [v for v in analyze_paths([str(mod)]) if v.rule == "U1"]
+
+
+def test_u1_partial_mode_spares_project_rule_directives(tmp_path):
+    # --changed analyzes a file subset: P3/P4 don't run, so their
+    # suppressions/annotations must not be reported dead
+    mod = tmp_path / "mod.py"
+    mod.write_text("# fedlint: twin-of(other_plane.py)\n"
+                   "def f(x):\n"
+                   "    # fedlint: disable=P3(indirect consumption)\n"
+                   "    return x\n")
+    full = [v for v in analyze_paths([str(mod)]) if v.rule == "U1"]
+    assert len(full) == 2  # alone in the set, both directives are dead
+    part = [v for v in analyze_paths([str(mod)], partial=True)
+            if v.rule == "U1"]
+    assert not part
+
+
+# ---------------------------------------------------------------------------
+# --changed: the pre-commit fast path
+
+
+def _git(cwd, *argv):
+    subprocess.run(
+        ["git", "-c", "user.email=ci@example.com", "-c", "user.name=ci",
+         *argv], cwd=cwd, check=True, capture_output=True)
+
+
+def test_changed_mode_roundtrip(tmp_path, monkeypatch, capsys):
+    repo = tmp_path / "repo"
+    pkg = repo / "pkg"
+    pkg.mkdir(parents=True)
+    clean = "def f(x):\n    return x + 1\n"
+    bad = ("import jax\n\n"
+           "def hot(x):\n"
+           "    return float(x)\n\n"
+           "jitted = jax.jit(hot)\n")
+    (pkg / "a.py").write_text(clean)
+    (pkg / "b.py").write_text(clean)
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-q", "-m", "seed")
+    monkeypatch.chdir(repo)
+
+    # nothing touched: exit 0 without analyzing anything
+    assert fedlint_main(["pkg", "--changed"]) == 0
+    assert "no touched" in capsys.readouterr().out
+
+    # seed a violation in ONE file: --changed gates exactly like a full
+    # run (exit 1) and analyzes only the touched file
+    (pkg / "b.py").write_text(bad)
+    assert fedlint_main(["pkg", "--changed", "--format=json"]) == 1
+    out = capsys.readouterr().out
+    data = json.loads(out[:out.rindex("]") + 1])
+    assert {d["path"] for d in data} == {os.path.join("pkg", "b.py")}
+    assert fedlint_main(["pkg"]) == 1  # full run agrees
+    capsys.readouterr()
+
+    # baseline semantics identical to a full run
+    assert fedlint_main(["pkg", "--baseline", "base.json",
+                         "--write-baseline"]) == 0
+    assert fedlint_main(["pkg", "--changed", "--baseline",
+                         "base.json"]) == 0
+    capsys.readouterr()
+
+    # committed: the HEAD diff is empty again
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-q", "-m", "bad")
+    assert fedlint_main(["pkg", "--changed"]) == 0
+    # ...but an explicit REF still sees it
+    assert fedlint_main(["pkg", "--changed=HEAD~1"]) == 1
+    capsys.readouterr()
+
+
+def test_changed_mode_outside_git_is_usage_error(tmp_path, monkeypatch,
+                                                 capsys):
+    mod = tmp_path / "m.py"
+    mod.write_text("x = 1\n")
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("GIT_DIR", str(tmp_path / "nowhere"))
+    monkeypatch.setenv("GIT_CEILING_DIRECTORIES", str(tmp_path))
+    assert fedlint_main([str(mod), "--changed"]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# --thread-report
+
+
+def test_thread_report_names_threads_and_shared_state(tmp_path, capsys):
+    mod = tmp_path / "mgr.py"
+    mod.write_text(textwrap.dedent(P1_DONE_SET))
+    assert fedlint_main([str(mod), "--thread-report"]) == 0
+    out = capsys.readouterr().out
+    assert "class Manager" in out
+    assert "thread:_watchdog_loop" in out
+    assert "shared self._done_set: locked" in out
+    # and the real tree: the report is non-empty and names the managers
+    report = thread_model_report([os.path.join(PKG_DIR, "comm")])
+    assert "AggregatorShardManager" in report
+
+
+# ---------------------------------------------------------------------------
+# the real fixes behind the fixtures
+
+
+def test_decoder_for_returns_one_instance_across_threads():
+    """The shipped _decoder_for: racing pool workers must converge on a
+    single compressor instance (twin compressors would split
+    error-feedback state)."""
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import (FedAVGAggregator,
+                                                    FedAVGServerManager)
+    from fedml_tpu.comm.loopback import LoopbackNetwork
+
+    class A:
+        pass
+
+    a = A()
+    a.network = LoopbackNetwork(2)
+    cfg = FedConfig(client_num_in_total=1, client_num_per_round=1,
+                    comm_round=1)
+    agg = FedAVGAggregator({"w": np.zeros(4, np.float32)}, 1, cfg)
+    srv = FedAVGServerManager(a, agg, cfg, 2)
+    barrier = threading.Barrier(6)
+    got = []
+
+    def grab():
+        barrier.wait()
+        got.append(srv._decoder_for("topk0.25"))
+
+    threads = [threading.Thread(target=grab) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(got) == 6 and all(d is got[0] for d in got)
+    assert len(srv._decoders) == 1
+
+
+def test_round_snapshot_reads_committed_round():
+    from fedml_tpu.algos.config import FedConfig
+    from fedml_tpu.algos.fedavg_distributed import (FedAVGAggregator,
+                                                    FedAVGServerManager)
+    from fedml_tpu.comm.loopback import LoopbackNetwork
+
+    class A:
+        pass
+
+    a = A()
+    a.network = LoopbackNetwork(2)
+    cfg = FedConfig(client_num_in_total=1, client_num_per_round=1,
+                    comm_round=3)
+    agg = FedAVGAggregator({"w": np.zeros(4, np.float32)}, 1, cfg)
+    srv = FedAVGServerManager(a, agg, cfg, 2)
+    assert srv._round_snapshot() == srv.round_idx == 0
+    with srv._lock:
+        srv.round_idx = 2
+    assert srv._round_snapshot() == 2
+
+
+def test_shipped_control_plane_modules_clean_under_p_rules():
+    """The tier-1 protocol gate: the fixed control-plane modules carry
+    zero unsuppressed P1/P2 findings, and every suppression there has a
+    reason (the package-wide gate in test_fedlint.py covers the rest)."""
+    targets = [os.path.join(PKG_DIR, "algos", "fedasync.py"),
+               os.path.join(PKG_DIR, "algos", "fedavg_distributed.py"),
+               os.path.join(PKG_DIR, "comm", "shardplane.py")]
+    vs = [v for v in analyze_paths(targets, partial=True)
+          if v.rule in ("P1", "P2")]
+    fresh = [v for v in vs if not v.suppressed]
+    assert not fresh, "protocol regressions:\n" + "\n".join(
+        v.format() for v in fresh)
+    sup = [v for v in vs if v.suppressed]
+    assert sup and all(v.suppress_reason for v in sup)
