@@ -342,6 +342,99 @@ print(f"adapter smoke OK: {adapter_bpu:.0f}B/upload vs dense-delta "
       f"{dense_bpu:.0f}B, base frozen, codec_refusals=0")
 PYEOF
 
+echo "== serve smoke (requests during a FedBuff run; rank-0 row == dense) =="
+python - <<'PYEOF'
+import math
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.algos.fedbuff import FedML_FedBuff_distributed
+from fedml_tpu.data.batching import build_federated_arrays
+from fedml_tpu.data.partition import partition_homo
+from fedml_tpu.models.adapter import PersonalAdapterStore, adapter_model_fns
+from fedml_tpu.models.registry import create_model
+from fedml_tpu.serve import ServeForward, ServeManager
+from fedml_tpu.trainer.local import NetState, model_fns, seq_softmax_ce
+
+V, T, B = 64, 16, 4
+rng = np.random.RandomState(0)
+seqs = rng.randint(1, V, size=(32, T + 1))
+fed = build_federated_arrays(seqs[:, :T].astype(np.int32),
+                             seqs[:, 1:].astype(np.int32),
+                             partition_homo(32, 4), B)
+
+
+def mk(rank):
+    return create_model("transformer_lm", vocab_size=V, d_model=32,
+                        n_heads=2, n_layers=2, max_len=T,
+                        adapter_rank=rank)
+
+
+# The serve plane over the SAME deterministic frozen base the trainer
+# uses (seed 0 — base bitwise identity is pinned by the adapter smoke).
+fns = adapter_model_fns(mk(4))
+glob0 = fns.init(jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)).params
+fwd = ServeForward(fns, glob0)
+store = PersonalAdapterStore(32, glob0)
+mgr = ServeManager(fwd, store, glob0, seq_len=T, max_batch=8,
+                   deadline_s=0.005, queue_cap=64).start()
+probe = rng.randint(1, V, T).astype(np.int32)
+mgr.request(0, probe)  # warm the one compiled [8, T] shape
+
+# 2-aggregation FedBuff run in the background; requests ride DURING it.
+result = {}
+cfg = FedConfig(client_num_in_total=4, client_num_per_round=2,
+                comm_round=2, epochs=1, batch_size=B, lr=0.1, seed=0,
+                adapter_rank=4)
+trainer = threading.Thread(target=lambda: result.update(
+    srv=FedML_FedBuff_distributed(mk(4), fed, None, cfg,
+                                  loopback_wire="tensor", buffer_k=2,
+                                  loss_fn=partial(seq_softmax_ce,
+                                                  pad_id=0))))
+trainer.start()
+during = 0
+while trainer.is_alive() and during < 48:
+    mgr.request(int(during % 32), probe)
+    during += 1
+trainer.join()
+
+# Publish the trained globals to the plane, then pin the identity
+# invariant on the read path: a client with a ZERO (rank-0) adapter row
+# serves logits byte-identical to the DENSE model over the same frozen
+# base, at the plane's own [8, T] batch shape.
+mgr.set_live(1, result["srv"].net.params)
+store.scatter([7], np.zeros((1, fwd.dim), np.float32))
+logits, _ = mgr.request(7, probe)
+dense_fns = model_fns(mk(0))
+base = fns.holder["base"]
+
+
+def dense_row(tok):
+    out, _ = dense_fns.apply(NetState(base, {}), tok[None], train=False)
+    return out[0]
+
+
+padded = np.zeros((8, T), np.int32)
+padded[0] = probe
+dense = np.asarray(jax.jit(jax.vmap(dense_row))(jnp.asarray(padded)))[0]
+assert np.array_equal(np.asarray(logits), dense), "rank-0 row != dense"
+
+stats = mgr.stats()
+mgr.close()
+p95 = stats.get("serve/latency_ms_p95")
+assert p95 is not None and math.isfinite(p95), stats
+assert stats.get("serve/refused", 0) == 0, stats
+assert stats.get("serve/shed", 0) == 0, stats
+assert stats.get("serve/served", 0) >= during + 2, stats
+print(f"serve smoke OK: {during} requests during training, "
+      f"p95={p95:.1f}ms, refused=0 shed=0, rank-0 row == dense model")
+PYEOF
+
 echo "== parallel ingest pool: workers=2 bit-equal to workers=1 + pool spans =="
 python - <<'PYEOF'
 import json, os, tempfile

@@ -244,6 +244,29 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     # broker; used only with --backend MQTT)
     p.add_argument("--mqtt_host", type=str, default="127.0.0.1")
     p.add_argument("--mqtt_port", type=int, default=1883)
+    # Multi-tenant adapter serving plane (fedml_tpu.serve; docs/SERVING.md).
+    # Only main_extra's FedBuff runner serves — every other driver refuses
+    # these loudly (reject_serve_flags).
+    p.add_argument("--serve", action="store_true",
+                   help="stand up the multi-tenant adapter serving plane "
+                        "next to the FedBuff training fleet: batched "
+                        "per-request LoRA inference over one frozen-base "
+                        "dispatch (requires --adapter_rank > 0 and the "
+                        "transformer_lm model)")
+    p.add_argument("--serve_port", type=int, default=0,
+                   help="TCP port for the line-delimited-JSON serve front "
+                        "end (0 = no socket; in-process traffic only)")
+    p.add_argument("--serve_max_batch", type=int, default=32,
+                   help="micro-batcher batch size: a batch closes when "
+                        "this many requests arrived or the deadline "
+                        "expired, whichever is first")
+    p.add_argument("--serve_deadline_ms", type=float, default=5.0,
+                   help="micro-batcher window: max milliseconds the first "
+                        "request of a batch waits for co-batching traffic")
+    p.add_argument("--serve_requests", type=int, default=0,
+                   help="smoke traffic: issue this many in-process serve "
+                        "requests DURING training and report latency "
+                        "percentiles in the output (0 = none)")
     return p
 
 
@@ -333,6 +356,32 @@ def reject_adapter_flags(args, algorithm: str) -> None:
             "adapter finetuning rides FedAdapter (exp/run.py) and the "
             "FedAsync/FedBuff adapter-delta uploads only — the flag "
             "would silently train the dense arm here")
+
+
+def reject_serve_flags(args, algorithm: str) -> None:
+    """Refuse the serving-plane knobs for drivers that never stand up a
+    plane (the PR 4/14 flag-rejection convention): only main_extra's
+    FedBuff runner serves (``fedml_tpu.serve``; docs/SERVING.md). A run
+    whose ``--serve_requests`` silently does nothing would report a
+    training-only run as a serving benchmark — the flag must refuse,
+    not no-op."""
+    bad = []
+    if getattr(args, "serve", False):
+        bad.append("--serve")
+    if getattr(args, "serve_port", 0):
+        bad.append(f"--serve_port {args.serve_port}")
+    if getattr(args, "serve_max_batch", 32) != 32:
+        bad.append(f"--serve_max_batch {args.serve_max_batch}")
+    if getattr(args, "serve_deadline_ms", 5.0) != 5.0:
+        bad.append(f"--serve_deadline_ms {args.serve_deadline_ms}")
+    if getattr(args, "serve_requests", 0):
+        bad.append(f"--serve_requests {args.serve_requests}")
+    if bad:
+        raise SystemExit(
+            f"{algorithm} does not support {', '.join(bad)}: the "
+            "multi-tenant adapter serving plane rides main_extra's "
+            "FedBuff runner only (fedml_tpu.serve) — the flag would be "
+            "silently inert here")
 
 
 def reject_ingest_pool_flag(args, algorithm: str) -> None:

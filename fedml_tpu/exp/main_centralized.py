@@ -31,7 +31,8 @@ def run_centralized(args):
                                     reject_async_tier_flags,
                                     reject_fedavg_family_flags,
                                     reject_ingest_pool_flag,
-                                    reject_pod_plane_flags)
+                                    reject_pod_plane_flags,
+                                    reject_serve_flags)
     from fedml_tpu.exp.run import SEQ_DATASETS
 
     # The pooled baseline has no client step and no client axis — every
@@ -52,6 +53,8 @@ def run_centralized(args):
     reject_async_tier_flags(args, "the centralized baseline")
     reject_ingest_pool_flag(args, "the centralized baseline")
     reject_agg_shards_flag(args, "the centralized baseline")
+    # ...and no serving plane: serving rides main_extra's FedBuff runner.
+    reject_serve_flags(args, "the centralized baseline")
     from fedml_tpu.exp.setup import (
         build_mesh,
         create_model_for,

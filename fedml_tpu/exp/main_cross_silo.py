@@ -105,7 +105,8 @@ def main(argv=None):
                                     reject_agg_shards_flag,
                                     reject_async_tier_flags,
                                     reject_fedavg_family_flags,
-                                    reject_pod_plane_flags)
+                                    reject_pod_plane_flags,
+                                    reject_serve_flags)
 
     # The cross-silo server reduces with FedAVGAggregator-parity math —
     # the simulator's pluggable aggregator/corruption drill would be
@@ -127,6 +128,9 @@ def main(argv=None):
     # not launch. It rides the loopback/sim runner:
     # FedML_FedAvg_distributed(..., agg_shards=M) (comm/shardplane.py).
     reject_agg_shards_flag(args, "the cross-silo pipeline")
+    # No serving plane on the rank-per-process CLI either — serving
+    # rides main_extra's FedBuff runner (fedml_tpu.serve).
+    reject_serve_flags(args, "the cross-silo pipeline")
 
     logging.basicConfig(
         level=logging.INFO,

@@ -23,7 +23,8 @@ from fedml_tpu.exp.args import (add_args, config_from_args,
                                 reject_async_tier_flags,
                                 reject_fedavg_family_flags,
                                 reject_ingest_pool_flag,
-                                reject_pod_plane_flags)
+                                reject_pod_plane_flags,
+                                reject_serve_flags)
 from fedml_tpu.exp.setup import global_test_batches, load_data
 from fedml_tpu.data.loaders import to_federated_arrays
 
@@ -133,6 +134,23 @@ def run_decentralized(args):
     return _loop(api, cfg)
 
 
+def _async_loss_kwargs(args):
+    """Sequence-dataset loss for the async runners (run.py's make_api
+    wiring, which these CLI paths bypass): without it a transformer_lm +
+    shakespeare worker dies on the classification CE's label shape and
+    the federation deadlocks waiting for its uploads."""
+    from fedml_tpu.exp.run import SEQ_DATASETS
+
+    if args.dataset not in SEQ_DATASETS:
+        return {}
+    from functools import partial
+
+    from fedml_tpu.trainer.local import seq_softmax_ce
+
+    pad_id = -1 if args.dataset == "shakespeare" else 0
+    return {"loss_fn": partial(seq_softmax_ce, pad_id=pad_id)}
+
+
 def _async_obs_kwargs(args):
     """Shared --run_dir/--trace wiring for the async-tier runners: a
     metrics.jsonl ctrl/ stream per model version (the same schema the
@@ -163,7 +181,7 @@ def run_fedasync(args):
             model, arrays, test, cfg,
             alpha=(0.6 if args.fedasync_alpha < 0 else args.fedasync_alpha),
             staleness_exp=args.staleness_exp, wire_codec=args.wire_codec,
-            **obs_kw)
+            **_async_loss_kwargs(args), **obs_kw)
     finally:
         if metrics is not None:
             metrics.close()
@@ -196,13 +214,71 @@ def run_fedbuff(args):
             alpha=(1.0 if args.fedasync_alpha < 0 else args.fedasync_alpha),
             staleness_exp=args.staleness_exp, buffer_k=args.buffer_k,
             aggregator=args.aggregator, wire_codec=args.wire_codec,
-            corrupt_ranks=corrupt_ranks, corruptor=corruptor, **obs_kw)
+            corrupt_ranks=corrupt_ranks, corruptor=corruptor,
+            **_async_loss_kwargs(args), **obs_kw)
     finally:
         if metrics is not None:
             metrics.close()
     logging.info("fedbuff staleness history: %s (guard_drops=%d)",
                  srv.staleness_history, srv.guard_drops)
-    return srv.test_history or [{"version": srv.version}]
+    history = srv.test_history or [{"version": srv.version}]
+    if getattr(args, "serve", False):
+        history[-1] = dict(history[-1], **_serve_fedbuff_global(args, model,
+                                                               srv))
+    return history
+
+
+def _serve_fedbuff_global(args, model, srv):
+    """Stand up the multi-tenant serving plane (fedml_tpu.serve;
+    docs/SERVING.md) on the trained FedBuff global: batched LoRA
+    inference over the run's frozen base, ``--serve_requests`` smoke
+    traffic through the micro-batcher, optional ``--serve_port`` JSON
+    socket. Returns flat serve_* scalars for the output line."""
+    from fedml_tpu.models.adapter import adapter_model_fns
+    from fedml_tpu.serve import (AdapterDecoder, ServeForward, ServeManager,
+                                 ServeSocketServer)
+
+    holder = getattr(srv, "adapter_holder", None)
+    if not holder or "base" not in holder:
+        raise SystemExit(
+            "--serve needs the frozen-base adapter run: pass "
+            "--adapter_rank > 0 with --model transformer_lm (the serving "
+            "plane batches per-request LoRA deltas over one frozen base)")
+    fns = adapter_model_fns(model, holder=holder)
+    glob = srv.net.params
+    fwd = ServeForward(fns, glob)
+    dec = AdapterDecoder(model, fns, glob)
+    seq_len = min(int(getattr(model, "max_len", 32)), 32)
+    vocab = int(getattr(model, "vocab_size", 64))
+    mgr = ServeManager(fwd, None, glob, seq_len=seq_len,
+                       max_batch=args.serve_max_batch,
+                       deadline_s=args.serve_deadline_ms / 1e3,
+                       decoder=dec).start()
+    sock = None
+    try:
+        if args.serve_port:
+            sock = ServeSocketServer(mgr, args.serve_port).start()
+            logging.info("serve socket listening on 127.0.0.1:%d", sock.port)
+        rng = np.random.default_rng(0)
+        pending = []
+        for i in range(int(args.serve_requests)):
+            toks = rng.integers(0, vocab,
+                                size=int(rng.integers(1, seq_len + 1)))
+            pending.append(mgr.submit(i, toks.astype(np.int32),
+                                      max_new_tokens=2))
+            if len(pending) >= 64:
+                for r in pending:
+                    r.result(120)
+                pending.clear()
+        for r in pending:
+            r.result(120)
+        stats = mgr.stats()
+    finally:
+        if sock is not None:
+            sock.close()
+        mgr.close()
+    return {k.replace("/", "_"): v for k, v in stats.items()
+            if isinstance(v, (int, float))}
 
 
 def run_base_framework(args):
@@ -261,6 +337,19 @@ def main(argv=None):
         reject_fedavg_family_flags(args, args.algorithm)
         reject_async_tier_flags(args, args.algorithm,
                                 allow_mixing=args.algorithm == "FedAsync")
+        # Only the FedBuff runner stands up the serving plane
+        # (fedml_tpu.serve) — every other specialty loop refuses the
+        # serve knobs rather than silently training without serving.
+        reject_serve_flags(args, args.algorithm)
+    elif not getattr(args, "serve", False):
+        # FedBuff without --serve: the tuning/traffic knobs would be
+        # silently inert — same refuse-don't-noop convention.
+        reject_serve_flags(args, f"{args.algorithm} without --serve")
+    elif not getattr(args, "adapter_rank", 0):
+        raise SystemExit(
+            "--serve needs --adapter_rank > 0 (and --model "
+            "transformer_lm): the serving plane batches per-request "
+            "LoRA deltas over one frozen base (fedml_tpu.serve)")
     if (args.algorithm not in ("FedAsync", "FedBuff")
             and getattr(args, "wire_codec", "none") != "none"):
         raise SystemExit(

@@ -138,11 +138,15 @@ def run(args, algorithm: str = "FedAvg"):
     from fedml_tpu.exp.args import (reject_adapter_flags,
                                     reject_agg_shards_flag,
                                     reject_async_tier_flags,
-                                    reject_ingest_pool_flag)
+                                    reject_ingest_pool_flag,
+                                    reject_serve_flags)
 
     reject_async_tier_flags(args, algorithm)
     reject_ingest_pool_flag(args, algorithm)
     reject_agg_shards_flag(args, algorithm)
+    # No simulator tier serves: the serving plane rides main_extra's
+    # FedBuff runner only (fedml_tpu.serve).
+    reject_serve_flags(args, algorithm)
     # The FedAvg-family knobs are LIVE on this tier, read through cfg
     # rather than args: --aggregator/--corrupt_mode by FedAvgAPI's
     # pluggable reduce + corruption drill, and the pod compute-plane

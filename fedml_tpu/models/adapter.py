@@ -33,6 +33,7 @@ federated machinery that should only ever see the adapter tree:
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Callable, NamedTuple, Optional
 
 import numpy as np
@@ -174,7 +175,17 @@ class PersonalAdapterStore:
 
     Never-personalized clients read as the caller-provided default (the
     current global adapters), so a cohort gather always yields usable
-    state."""
+    state.
+
+    **Concurrency.** The serving plane (fedml_tpu.serve) gathers request
+    rows WHILE the training fleet scatters personalization updates — the
+    store's first concurrent reader. All row access is copy-on-read
+    under ``self._lock``: ``gather`` copies the cohort slice and its
+    ``seen`` mask inside the critical section, so a row is always one
+    consistent scatter's bytes (never a torn half-write) and the
+    returned array is private to the caller; ``scatter`` and the
+    checkpoint surface take the same lock. The lock bounds only the
+    memcpy, not the fallback fill or any downstream compute."""
 
     def __init__(self, n_clients: int, template_params, *,
                  spill_dir: Optional[str] = None):
@@ -194,6 +205,7 @@ class PersonalAdapterStore:
             self._data = np.zeros((self.n_clients, self.dim), np.float32)
         self.seen = np.zeros(self.n_clients, bool)
         self._to_vec = tree_to_vector_np
+        self._lock = threading.Lock()
 
     def nbytes(self) -> int:
         return int(self._data.nbytes)
@@ -208,23 +220,29 @@ class PersonalAdapterStore:
 
     def gather(self, idx, default_params) -> np.ndarray:
         """``[k, D]`` personal vectors for the cohort; rows never
-        scattered to read as ``default_params`` (the global adapters)."""
+        scattered to read as ``default_params`` (the global adapters).
+        Copy-on-read under the store lock: the returned array is a
+        private snapshot whose rows are each one complete scatter."""
         idx = np.asarray(idx, np.int64)
-        out = self._data[idx].astype(np.float32, copy=True)
-        missing = ~self.seen[idx]
+        with self._lock:
+            out = self._data[idx].astype(np.float32, copy=True)
+            missing = ~self.seen[idx]
         if missing.any():
             out[missing] = self.vec_of(default_params)[None]
         return out
 
     def scatter(self, idx, vecs) -> None:
         idx = np.asarray(idx, np.int64)
-        self._data[idx] = np.asarray(vecs, np.float32)
-        self.seen[idx] = True
+        vecs = np.asarray(vecs, np.float32)
+        with self._lock:
+            self._data[idx] = vecs
+            self.seen[idx] = True
 
     # -- checkpoint surface (bit-equal restore is test-pinned) ----------
     def state_dict(self) -> dict:
-        return {"personal_vecs": np.array(self._data),
-                "personal_seen": np.array(self.seen)}
+        with self._lock:
+            return {"personal_vecs": np.array(self._data),
+                    "personal_seen": np.array(self.seen)}
 
     def load_state_dict(self, state) -> None:
         vecs = np.asarray(state["personal_vecs"], np.float32)
@@ -233,5 +251,6 @@ class PersonalAdapterStore:
                 f"personal adapter checkpoint shape {vecs.shape} does not "
                 f"match the store ({self._data.shape}) — different "
                 "adapter rank/scope or client count")
-        self._data[:] = vecs
-        self.seen[:] = np.asarray(state["personal_seen"], bool)
+        with self._lock:
+            self._data[:] = vecs
+            self.seen[:] = np.asarray(state["personal_seen"], bool)

@@ -25,11 +25,32 @@ from fedml_tpu.parallel.ring_attention import reference_attention
 ADAPTER_SCOPES = ("attn", "mlp", "all")
 
 
+def lora_delta(a, b, x, *, alpha: float, rank: int):
+    """The low-rank residual ``(alpha/rank) * (x @ A) @ B`` (Hu et al.
+    2021; FedPara/LoRA-style low-rank updates, arXiv:2108.06098) for ONE
+    adapter pair — the single expression both the training-time module
+    injection (:func:`_lora_delta`) and the serving plane's KV-decode
+    path (fedml_tpu.serve.forward) evaluate, so the two can never
+    diverge numerically."""
+    return (alpha / rank) * ((x @ a) @ b)
+
+
+def lora_delta_batched(a, b, x, *, alpha: float, rank: int):
+    """Batched-B twin of :func:`lora_delta`: ``B`` per-row adapter pairs
+    ``a [B, d, r]`` / ``b [B, r, o]`` applied to ``x [B, ..., d]`` inside
+    ONE dispatch — the multi-tenant serving move (fedml_tpu.serve): B
+    different personalized models share a single batched forward instead
+    of B per-request dispatches. The contraction order matches
+    :func:`lora_delta` exactly (x·A then ·B, scale last), so the B=1
+    slice is bitwise-equal to the per-request path (test-pinned)."""
+    xa = jnp.einsum("b...d,bdr->b...r", x, a)
+    return (alpha / rank) * jnp.einsum("b...r,bro->b...o", xa, b)
+
+
 def _lora_delta(mod: nn.Module, name: str, x, out_dim: int, rank: int,
                 alpha: float, dtype):
-    """The low-rank residual ``(alpha/rank) * (x @ A) @ B`` added next to
-    a dense projection (Hu et al. 2021; FedPara/LoRA-style low-rank
-    updates, arXiv:2108.06098). ``A`` is small-normal, ``B`` zero — the
+    """Module-side injection of :func:`lora_delta`: creates the pair next
+    to a dense projection. ``A`` is small-normal, ``B`` zero — the
     injected model is exactly the base model at init. Param names carry
     the ``lora_`` prefix :mod:`fedml_tpu.models.adapter` splits on."""
     a = mod.param(f"lora_{name}_a", nn.initializers.normal(0.02),
@@ -37,7 +58,7 @@ def _lora_delta(mod: nn.Module, name: str, x, out_dim: int, rank: int,
     b = mod.param(f"lora_{name}_b", nn.initializers.zeros, (rank, out_dim))
     if dtype is not None:
         a, b = a.astype(dtype), b.astype(dtype)
-    return (alpha / rank) * ((x @ a) @ b)
+    return lora_delta(a, b, x, alpha=alpha, rank=rank)
 
 
 class MHA(nn.Module):

@@ -1,0 +1,33 @@
+"""Multi-tenant adapter serving — the inference half of the north star.
+
+The training side personalizes millions of adapter-only models
+(models/adapter.PersonalAdapterStore, algos/fedadapter); this package
+serves them: thousands of *different* personalized models share one
+batched frozen-base forward (serve.forward), a micro-batching request
+plane admits/sheds/batches live traffic (serve.plane), and a versioned
+rollout loop publishes new globals from the training fleet behind a
+shadow-eval regression gate with one-step rollback (serve.rollout).
+docs/SERVING.md is the operator story.
+"""
+
+from fedml_tpu.serve.forward import (FLASH_CROSSOVER_T, AdapterDecoder,
+                                     ServeForward, pick_attention,
+                                     stacked_tree_of)
+from fedml_tpu.serve.plane import (ServeManager, ServeOverload, ServeRefused,
+                                   ServeRequest, ServeSocketServer)
+from fedml_tpu.serve.rollout import RolloutCoordinator, StaleEpochError
+
+__all__ = [
+    "FLASH_CROSSOVER_T",
+    "AdapterDecoder",
+    "RolloutCoordinator",
+    "ServeForward",
+    "ServeManager",
+    "ServeOverload",
+    "ServeRefused",
+    "ServeRequest",
+    "ServeSocketServer",
+    "StaleEpochError",
+    "pick_attention",
+    "stacked_tree_of",
+]

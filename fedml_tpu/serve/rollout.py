@@ -1,0 +1,234 @@
+"""Versioned adapter rollout: publish → shadow → promote | rollback.
+
+The training fleet keeps producing new global adapters; the serving
+plane must pick them up WITHOUT trusting them — an aggregation that
+diverged (poisoned cohort, codec bug, NaN blow-up) must never become
+what live traffic is answered with. ``RolloutCoordinator`` is that
+gate:
+
+- :meth:`publish` stages a candidate version behind an EPOCH FENCE
+  (the PR 5 server-epoch discipline): a snapshot published under an
+  epoch at or below the last accepted one is a zombie — a pre-restart
+  coordinator's in-flight publish — and raises :class:`StaleEpochError`
+  instead of racing the new incarnation.
+- While staged, the plane mirrors live traffic through BOTH the live
+  global and the candidate (serve/plane.py ``serve.shadow`` spans) and
+  accumulates next-token CE per arm.
+- :meth:`try_promote` reads the mirrored scores and promotes ONLY when
+  the candidate saw enough shadow tokens, its CE is finite, and it does
+  not regress the live CE beyond ``regression_tol``. Promotion keeps
+  the displaced version as the one-step rollback target.
+- :meth:`rollback` restores that displaced version BIT-EQUAL (the
+  adapter vector round-trips through the checkpoint as raw float32 —
+  test-pinned).
+
+Every transition persists a fixed-shape payload through the PR 5
+:class:`~fedml_tpu.obs.checkpoint.CheckpointManager` before it takes
+effect on the plane, so a coordinator restart mid-promotion resumes on
+the fenced epoch with the same live/candidate/rollback state (orbax
+restore is structure-checked; fixed shapes make every snapshot
+restorable by every incarnation).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class StaleEpochError(RuntimeError):
+    """Publish fenced off: the snapshot's epoch is not newer than the
+    last accepted one — a previous coordinator incarnation's in-flight
+    publish, refused so it cannot race the current one."""
+
+
+class RolloutCoordinator:
+    """Shadow-gated version control for the serving plane's live global.
+
+    ``manager`` is the :class:`~fedml_tpu.serve.plane.ServeManager`
+    whose live/shadow versions this coordinator owns. ``directory``
+    (optional) persists every transition via
+    :class:`~fedml_tpu.obs.checkpoint.CheckpointManager`; on
+    construction an existing state is restored INTO the manager —
+    restart-resume is the constructor, not a separate code path.
+
+    ``regression_tol`` is relative: candidate CE may exceed live CE by
+    at most ``live_ce * regression_tol``. ``min_shadow_tokens`` keeps a
+    lucky two-token mirror from promoting anything.
+    """
+
+    def __init__(self, manager, *, directory: Optional[str] = None,
+                 regression_tol: float = 0.02,
+                 min_shadow_tokens: int = 32):
+        self.manager = manager
+        self.regression_tol = float(regression_tol)
+        self.min_shadow_tokens = int(min_shadow_tokens)
+        self.dim = int(manager.fwd.dim)
+        self._mgr = None
+        self._seq = 0  # checkpoint step allocator (monotonic)
+        self.fence_epoch = -1
+        self.live_version = int(manager.live_version)
+        self._live_vec = manager._vec(manager.live_adapters())
+        self.prev_version: Optional[int] = None
+        self._prev_vec = np.zeros(self.dim, np.float32)
+        self.cand_version: Optional[int] = None
+        self._cand_vec = np.zeros(self.dim, np.float32)
+        if directory is not None:
+            from fedml_tpu.obs.checkpoint import CheckpointManager
+
+            self._mgr = CheckpointManager(directory, max_to_keep=3)
+            self._restore()
+
+    # -- persistence -----------------------------------------------------
+
+    def _payload(self) -> dict:
+        """Fixed-shape snapshot: absent versions ride as ``-1`` + zero
+        vectors so every incarnation can ``restore(like=)`` every step."""
+        return {
+            "seq": np.asarray(self._seq, np.int64),
+            "fence_epoch": np.asarray(self.fence_epoch, np.int64),
+            "live_version": np.asarray(self.live_version, np.int64),
+            "live_vec": np.asarray(self._live_vec, np.float32),
+            "prev_version": np.asarray(
+                -1 if self.prev_version is None else self.prev_version,
+                np.int64),
+            "prev_vec": np.asarray(self._prev_vec, np.float32),
+            "cand_version": np.asarray(
+                -1 if self.cand_version is None else self.cand_version,
+                np.int64),
+            "cand_vec": np.asarray(self._cand_vec, np.float32),
+        }
+
+    def _persist(self) -> None:
+        """Durable-then-visible: the snapshot commits BEFORE the
+        transition lands on the plane, so a crash between the two
+        resumes on the new state, never a half-applied one."""
+        if self._mgr is None:
+            return
+        self._seq += 1
+        self._mgr.save(self._seq, self._payload())
+
+    def _restore(self) -> None:
+        restored = self._mgr.restore(like=self._payload())
+        if restored is None:
+            return
+        self._seq = int(restored["seq"])
+        self.fence_epoch = int(restored["fence_epoch"])
+        self.live_version = int(restored["live_version"])
+        self._live_vec = np.asarray(restored["live_vec"], np.float32)
+        pv = int(restored["prev_version"])
+        self.prev_version = None if pv < 0 else pv
+        self._prev_vec = np.asarray(restored["prev_vec"], np.float32)
+        cv = int(restored["cand_version"])
+        self.cand_version = None if cv < 0 else cv
+        self._cand_vec = np.asarray(restored["cand_vec"], np.float32)
+        self.manager.set_live(self.live_version, self._tree(self._live_vec))
+        if self.cand_version is not None:
+            # Resume mid-promotion: re-stage the candidate shadow. CE
+            # accumulators restart from zero — mirrored evidence from the
+            # dead incarnation is not trusted across a restart.
+            self.manager.set_shadow(self.cand_version,
+                                    self._tree(self._cand_vec))
+        else:
+            self.manager.set_shadow(None)
+
+    def _tree(self, vec: np.ndarray):
+        from fedml_tpu.comm.codec import vector_to_tree_np
+
+        return vector_to_tree_np(np.asarray(vec, np.float32),
+                                 self.manager.fwd.spec)
+
+    # -- transitions -----------------------------------------------------
+
+    def publish(self, adapters, *, epoch: int) -> int:
+        """Stage ``adapters`` (a training-fleet snapshot taken under
+        server ``epoch``) as the shadow candidate. Returns the candidate
+        version id. Replaces any currently staged candidate — the fleet
+        moved on, so should the gate."""
+        epoch = int(epoch)
+        if epoch <= self.fence_epoch:
+            raise StaleEpochError(
+                f"publish under epoch {epoch} refused: fence is at "
+                f"{self.fence_epoch} — a newer coordinator incarnation "
+                "already accepted a snapshot from this epoch or later")
+        self.fence_epoch = epoch
+        version = max(self.live_version,
+                      self.cand_version if self.cand_version is not None
+                      else -1) + 1
+        self.cand_version = version
+        self._cand_vec = self.manager._vec(adapters)
+        self._persist()
+        self.manager.set_shadow(version, self._tree(self._cand_vec))
+        return version
+
+    def try_promote(self) -> dict:
+        """Promote the staged candidate iff the shadow gate passes.
+        Returns the verdict dict (``promoted`` bool + the scores it was
+        judged on); no candidate staged → ``{"promoted": False,
+        "reason": "no_candidate"}``. A blocked candidate STAYS staged —
+        more mirrored traffic may still clear (or confirm) it; call
+        :meth:`discard` to drop it."""
+        if self.cand_version is None:
+            return {"promoted": False, "reason": "no_candidate"}
+        scores = self.manager.shadow_scores()
+        verdict = dict(scores, promoted=False,
+                       candidate_version=self.cand_version)
+        if scores["tokens"] < self.min_shadow_tokens:
+            verdict["reason"] = (
+                f"insufficient_shadow_traffic ({scores['tokens']} < "
+                f"{self.min_shadow_tokens} tokens)")
+            return verdict
+        if not np.isfinite(scores["cand_ce"]):
+            verdict["reason"] = "candidate_ce_not_finite"
+            return verdict
+        limit = scores["live_ce"] * (1.0 + self.regression_tol)
+        if np.isfinite(scores["live_ce"]) and scores["cand_ce"] > limit:
+            verdict["reason"] = (
+                f"regression (cand_ce {scores['cand_ce']:.4f} > "
+                f"{limit:.4f})")
+            return verdict
+        # Gate passed: displaced live becomes the one-step rollback
+        # target; persist, then flip the plane.
+        self.prev_version = self.live_version
+        self._prev_vec = self._live_vec
+        self.live_version = self.cand_version
+        self._live_vec = self._cand_vec
+        self.cand_version = None
+        self._cand_vec = np.zeros(self.dim, np.float32)
+        self._persist()
+        self.manager.set_shadow(None)
+        self.manager.set_live(self.live_version, self._tree(self._live_vec))
+        verdict.update(promoted=True, reason="ok",
+                       live_version=self.live_version)
+        return verdict
+
+    def discard(self) -> None:
+        """Drop the staged candidate without promoting."""
+        if self.cand_version is None:
+            return
+        self.cand_version = None
+        self._cand_vec = np.zeros(self.dim, np.float32)
+        self._persist()
+        self.manager.set_shadow(None)
+
+    def rollback(self) -> int:
+        """One-step rollback: the previously displaced version becomes
+        live again, BIT-EQUAL to what it was (raw float32 vector round-
+        trip — test-pinned). The rolled-back-from version becomes the
+        new rollback target, so a mistaken rollback is itself one step
+        reversible. No displaced version recorded → RuntimeError."""
+        if self.prev_version is None:
+            raise RuntimeError(
+                "no previous version to roll back to: nothing was ever "
+                "promoted over")
+        self.prev_version, self.live_version = (self.live_version,
+                                                self.prev_version)
+        self._prev_vec, self._live_vec = self._live_vec, self._prev_vec
+        self._persist()
+        self.manager.set_live(self.live_version, self._tree(self._live_vec))
+        return self.live_version
+
+    def close(self) -> None:
+        if self._mgr is not None:
+            self._mgr.close()

@@ -85,6 +85,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
                  "bench_store_windowed_fedopt", "bench_zoo_windowed",
                  "bench_robust_agg",
                  "bench_chaos", "bench_wire_codec", "bench_fed_adapter",
+                 "bench_serving_plane",
                  "bench_ingest_profile",
                  "bench_serving_1m", "bench_agg_shards",
                  "bench_fleet_sim",
@@ -116,7 +117,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     # Every section that RAN finished inside the budget: elapsed at its
     # start + the full section cap fit under 300s.
     assert len(ran) * 50 + 100 <= 300
-    assert len(ran) + len(skipped) == 23
+    assert len(ran) + len(skipped) == 24
 
 
 def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
@@ -129,6 +130,7 @@ def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
                  "bench_store_windowed_fedopt", "bench_zoo_windowed",
                  "bench_robust_agg",
                  "bench_chaos", "bench_wire_codec", "bench_fed_adapter",
+                 "bench_serving_plane",
                  "bench_ingest_profile",
                  "bench_serving_1m", "bench_agg_shards",
                  "bench_fleet_sim",
@@ -268,8 +270,52 @@ def test_headline_tolerates_budget_skipped_submetrics():
     assert "synthetic_1m_peak_rss_ratio" not in h["sub"]  # r16
     # The r13 whole-zoo scalars ride (None when the section was skipped).
     assert h["sub"]["zoo_windowed_speedup"] is None
-    assert h["sub"]["fedac_acc_delta"] is None
     assert "fleet_buffered_acc" not in h["sub"]  # rotated out in r13
+    # The r18 serving-plane scalars ride (None when the section was
+    # skipped); uploads_per_sec, fedac_acc_delta and layout_pad_ratio
+    # rotated out in r18 to fund them under the <1KB tail budget.
+    assert h["sub"]["serve_rps"] is None
+    assert h["sub"]["serve_tokens_per_sec"] is None
+    assert h["sub"]["serve_batch_speedup"] is None
+    assert "uploads_per_sec" not in h["sub"]
+    assert "fedac_acc_delta" not in h["sub"]
+    assert "layout_pad_ratio" not in h["sub"]
     assert h["sub"]["flash_speedup_t16384"] is None
     assert h["sub"]["transformer_mfu"] is None
     assert len(json.dumps(h)) < 1024
+
+
+def test_headline_carries_serving_plane_scalars():
+    """The r18 serving-plane trio rides the headline when the section
+    ran (only the three scalars — p50/p95 and the arm records stay in
+    the full blob)."""
+    out = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 2.0,
+           "submetrics": {"serving_plane": {"serve_rps": 120.5,
+                                            "serve_tokens_per_sec": 2892.0,
+                                            "serve_batch_speedup": 6.1,
+                                            "latency_ms_p95": 40.2}},
+           "tuned_best": None}
+    h = json.loads(json.dumps(bench.build_headline(out)))
+    assert h["sub"]["serve_rps"] == 120.5
+    assert h["sub"]["serve_tokens_per_sec"] == 2892.0
+    assert h["sub"]["serve_batch_speedup"] == 6.1
+    assert "latency_ms_p95" not in h["sub"]
+    assert len(json.dumps(h)) < 1024
+
+
+@pytest.mark.slow  # serve-plane compiles (batched + B=1 decode) ~1-2 min
+def test_bench_serving_plane_machinery_toy_scale():
+    """The r18 serving-plane section's machinery end-to-end at toy
+    scale: memmap store build → personalization scatter → warm →
+    fleet-writer thread → batched window → sequential window → speedup
+    — the real section runs the 2^20 defaults."""
+    out = bench.bench_serving_plane(
+        N=4096, d_model=16, n_heads=2, n_layers=1, vocab=64, seq_len=8,
+        rank=2, max_batch=8, decode_tokens=2, personalized=64,
+        min_window_s=0.3, max_requests=128, max_seq_requests=32)
+    assert out["stored_adapters"] == 4096 and out["memmap_spill"]
+    assert out["serve_rps"] > 0 and out["serve_tokens_per_sec"] > 0
+    assert out["sequential_rps"] > 0 and out["serve_batch_speedup"] > 0
+    assert out["latency_ms_p95"] is not None
+    assert out["shed"] == 0 and out["refused"] == 0
+    assert out["fleet_scatters_during_drill"] > 0
