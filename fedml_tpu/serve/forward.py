@@ -135,8 +135,9 @@ class ServeForward:
 
 class _DecodeCache(NamedTuple):
     """Per-layer KV cache: ``k``/``v`` are ``[L, B, T_max, H, Dh]``;
-    ``pos`` is the number of filled positions (same for every row — the
-    plane pads prompts to one length)."""
+    ``pos`` is the PER-ROW ``[B]`` count of filled positions — rows with
+    different true prompt lengths decode from their own last token, so
+    the plane's right-padding stays inert through decode."""
 
     k: Any
     v: Any
@@ -192,9 +193,10 @@ class AdapterDecoder:
         return lora_delta_batched(a, b, x, alpha=self.alpha,
                                   rank=int(a.shape[-1]))
 
-    def _block(self, base, ad, x, ck, cv, pos0):
-        """One pre-LN block over ``x [B, S, d]`` with the KV cache;
-        returns updated ``(x, ck, cv)`` (``ck``/``cv`` ``[B, T, H, Dh]``)."""
+    def _block(self, base, ad, x, ck, cv, pos):
+        """One pre-LN block over ``x [B, S, d]`` with the KV cache
+        (``pos [B]`` per-row write offsets); returns updated
+        ``(x, ck, cv)`` (``ck``/``cv`` ``[B, T, H, Dh]``)."""
         h = _layer_norm(x, base["LayerNorm_0"])
         mha, mad = base["MHA_0"], (ad or {}).get("MHA_0", {})
         qkv = h @ mha["Dense_0"]["kernel"]
@@ -206,16 +208,19 @@ class AdapterDecoder:
         hd = self.d_model // self.n_heads
         shp = (bsz, s, self.n_heads, hd)
         q, k, v = q.reshape(shp), k.reshape(shp), v.reshape(shp)
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, pos0, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, pos0, 0, 0))
+        upd = jax.vmap(lambda c, new, p: jax.lax.dynamic_update_slice(
+            c, new, (p, 0, 0)))
+        ck = upd(ck, k, pos)
+        cv = upd(cv, v, pos)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(
             jnp.asarray(hd, q.dtype))
-        # Causal over ABSOLUTE positions: query i sits at pos0+i, key j
-        # is valid iff j <= pos0+i (unfilled cache slots are masked by
-        # the same inequality — they live beyond pos0+S-1).
-        qpos = pos0 + jnp.arange(s)
-        keep = jnp.arange(ck.shape[1])[None, :] <= qpos[:, None]
-        scores = jnp.where(keep[None, None], scores, -jnp.inf)
+        # Causal over ABSOLUTE per-row positions: row b's query i sits at
+        # pos[b]+i, key j is valid iff j <= pos[b]+i (unfilled cache
+        # slots — and a short row's stale prompt-pad slots — live beyond
+        # pos[b]+S-1, so the same inequality masks them).
+        qpos = pos[:, None] + jnp.arange(s)[None, :]
+        keep = jnp.arange(ck.shape[1])[None, None, :] <= qpos[:, :, None]
+        scores = jnp.where(keep[:, None], scores, -jnp.inf)
         probs = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bhqk,bkhd->bqhd", probs, cv).reshape(bsz, s,
                                                             self.d_model)
@@ -237,25 +242,26 @@ class AdapterDecoder:
         return x + down, ck, cv
 
     def _run(self, stacked, tokens, cache, *, steps: int):
-        """``steps`` positions starting at ``cache.pos``: prompt prefill
-        (``steps = T0``, empty cache) and single-token decode
-        (``steps = 1``) are the same traced program at different static
-        shapes. Returns ``(logits [B, steps, V], cache')``."""
+        """``steps`` positions starting at the per-row ``cache.pos``:
+        prompt prefill (``steps = T0``, empty cache) and single-token
+        decode (``steps = 1``) are the same traced program at different
+        static shapes. Returns ``(logits [B, steps, V], cache')``."""
         base = self.fns.holder["base"]
-        pos0 = cache.pos
+        pos = cache.pos
         x = (base["Embed_0"]["embedding"][tokens]
-             + base["Embed_1"]["embedding"][pos0 + jnp.arange(steps)][None])
+             + base["Embed_1"]["embedding"][pos[:, None]
+                                            + jnp.arange(steps)[None]])
         ks, vs = [], []
         for li in range(self.n_layers):
             name = f"Block_{li}"
             x, ck, cv = self._block(base[name], stacked.get(name), x,
-                                    cache.k[li], cache.v[li], pos0)
+                                    cache.k[li], cache.v[li], pos)
             ks.append(ck)
             vs.append(cv)
         x = _layer_norm(x, base["LayerNorm_0"])
         logits = (x @ base["Dense_0"]["kernel"]).astype(jnp.float32)
         return logits, _DecodeCache(jnp.stack(ks), jnp.stack(vs),
-                                    pos0 + steps)
+                                    pos + steps)
 
     # -- public surface -------------------------------------------------
 
@@ -265,16 +271,27 @@ class AdapterDecoder:
         shape = (self.n_layers, batch, t, self.n_heads, hd)
         return _DecodeCache(jnp.zeros(shape, jnp.float32),
                             jnp.zeros(shape, jnp.float32),
-                            jnp.asarray(0, jnp.int32))
+                            jnp.zeros(batch, jnp.int32))
 
-    def prefill(self, stacked, tokens, max_len: Optional[int] = None):
-        """Prompt pass: ``[B, T0]`` tokens → last-position logits
-        ``[B, V]`` + the filled cache."""
+    def prefill(self, stacked, tokens, lens=None,
+                max_len: Optional[int] = None):
+        """Prompt pass: ``[B, T0]`` tokens → TRUE-last-position logits
+        ``[B, V]`` + the filled cache. ``lens [B]`` gives per-row true
+        prompt lengths for right-padded batches: the returned logits are
+        gathered at ``lens-1`` (never a pad position) and the cache's
+        per-row write offsets rewind to ``lens``, so decode overwrites a
+        short row's pad slots before its causal mask can reach them.
+        ``lens=None`` means every row is full length."""
         tokens = jnp.asarray(tokens, jnp.int32)
         cache = self.empty_cache(tokens.shape[0], max_len)
         logits, cache = self._jit_run(stacked, tokens, cache,
                                       steps=int(tokens.shape[1]))
-        return logits[:, -1], cache
+        if lens is None:
+            return logits[:, -1], cache
+        lens = jnp.asarray(lens, jnp.int32)
+        last = jnp.take_along_axis(logits, (lens - 1)[:, None, None],
+                                   axis=1)[:, 0]
+        return last, cache._replace(pos=lens)
 
     def step(self, stacked, token, cache):
         """One decode position: ``[B]`` tokens → ``[B, V]`` logits."""
@@ -282,10 +299,12 @@ class AdapterDecoder:
                                       steps=1)
         return logits[:, 0], cache
 
-    def generate(self, stacked, tokens, n_new: int):
-        """Greedy decode ``n_new`` tokens per row. Returns ``[B, n_new]``
-        int32 — the tokens/s workload (one cached step per token)."""
-        logits, cache = self.prefill(stacked, tokens)
+    def generate(self, stacked, tokens, n_new: int, lens=None):
+        """Greedy decode ``n_new`` tokens per row (``lens`` as in
+        :meth:`prefill` — right-padded rows continue from their true
+        last token). Returns ``[B, n_new]`` int32 — the tokens/s
+        workload (one cached step per token)."""
+        logits, cache = self.prefill(stacked, tokens, lens=lens)
         out = []
         for _ in range(int(n_new)):
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)

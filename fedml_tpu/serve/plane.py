@@ -186,18 +186,34 @@ class ServeManager:
             raise ServeRefused(
                 f"request tokens must be [1..{self.seq_len}] ints, got "
                 f"shape {tokens.shape}")
-        if not self._running and self._thread is not None:
+        n_new = int(max_new_tokens)
+        if n_new < 0:
             self.registry.counter("serve/refused").inc()
-            raise ServeRefused("serve plane is shut down")
-        req = ServeRequest(client_id, tokens, max_new_tokens,
-                           self._clock())
-        try:
-            self._q.put_nowait(req)
-        except queue.Full:
-            self.registry.counter("serve/shed").inc()
-            raise ServeOverload(
-                f"request queue full ({self._q.maxsize}): shedding — "
-                "scale replicas or raise queue_cap") from None
+            raise ServeRefused(f"max_new_tokens must be >= 0, got {n_new}")
+        if n_new and self.decoder is not None \
+                and self.seq_len + n_new > self.decoder.max_len:
+            # Past max_len the decoder's positional gather / cache writes
+            # would be silently clamped by JAX OOB semantics — refuse
+            # loudly instead of serving garbage tokens.
+            self.registry.counter("serve/refused").inc()
+            raise ServeRefused(
+                f"max_new_tokens {n_new} exceeds the decoder budget "
+                f"(seq_len {self.seq_len} + {n_new} > max_len "
+                f"{self.decoder.max_len})")
+        req = ServeRequest(client_id, tokens, n_new, self._clock())
+        # Admission and shutdown race on _running: flag + enqueue under
+        # the lock so no request slips in after close() starts draining.
+        with self._lock:
+            if not self._running and self._thread is not None:
+                self.registry.counter("serve/refused").inc()
+                raise ServeRefused("serve plane is shut down")
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                self.registry.counter("serve/shed").inc()
+                raise ServeOverload(
+                    f"request queue full ({self._q.maxsize}): shedding — "
+                    "scale replicas or raise queue_cap") from None
         self.registry.counter("serve/admitted").inc()
         return req
 
@@ -219,10 +235,24 @@ class ServeManager:
         return self
 
     def close(self) -> None:
-        if self._thread is not None:
+        with self._lock:
             self._running = False
+        if self._thread is not None:
             self._q.put(_STOP)
             self._thread.join(timeout=30.0)
+        # Drain stragglers (admitted concurrently with shutdown, or
+        # queued behind _STOP when the batcher stopped mid-collection):
+        # complete them with a refusal so no waiter blocks to timeout.
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req is _STOP:
+                continue
+            self.registry.counter("serve/refused").inc()
+            req._error = ServeRefused("serve plane shut down")
+            req._done.set()
 
     def __enter__(self) -> "ServeManager":
         return self.start()
@@ -280,7 +310,10 @@ class ServeManager:
             live_vec = self._live_vec
             shadow = self._shadow
         tokens = np.zeros((self.max_batch, self.seq_len), np.int32)
-        lens = np.zeros(n, np.int64)
+        # Pad rows get length 1 (a lone token 0): keeps the decoder's
+        # per-row lens-1 indexing in range while the mirror mask still
+        # counts them as zero next-token targets. Real rows overwrite.
+        lens = np.ones(self.max_batch, np.int32)
         for i, req in enumerate(batch):
             lens[i] = req.tokens.shape[0]
             tokens[i, :lens[i]] = req.tokens
@@ -301,11 +334,12 @@ class ServeManager:
             with tracer.span("serve.decode", cat="serve", batch=n,
                              new_tokens=n_new):
                 generated = np.asarray(
-                    self.decoder.generate(stacked, tokens, n_new))
+                    self.decoder.generate(stacked, tokens, n_new,
+                                          lens=lens))
         if shadow is not None:
             with tracer.span("serve.shadow", cat="serve", batch=n,
                              candidate=shadow[0]):
-                self._mirror(tokens[:n], lens, live_vec, shadow[2])
+                self._mirror(tokens, lens, live_vec, shadow[2])
         now = self._clock()
         fill = self.registry.histogram("serve/batch_fill", lo=1.0)
         lat = self.registry.histogram("serve/latency_ms")
@@ -330,9 +364,14 @@ class ServeManager:
     def _mirror(self, tokens, lens, live_vec, cand_vec) -> None:
         """Run the batch's token stream through BOTH globals and
         accumulate next-token CE — the shadow gate's regression signal.
-        Mirrored traffic only ever affects the accumulators."""
+        Mirrored traffic only ever affects the accumulators. Runs on the
+        already-padded ``[max_batch, seq_len]`` tokens with the length
+        mask zeroing pad rows/positions, so the jitted CE compiles ONCE
+        at the plane's fixed shape — a half-full batch while a candidate
+        is staged never triggers a fresh XLA compile on the serving
+        thread."""
         b = tokens.shape[0]
-        mask = (np.arange(self.seq_len)[None, :] < lens[:b, None])
+        mask = (np.arange(self.seq_len)[None, :] < lens[:, None])
         toks = jnp.asarray(tokens)
         m = jnp.asarray(mask)
         sums = np.zeros(4, np.float64)

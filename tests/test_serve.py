@@ -180,6 +180,51 @@ def test_decoder_matches_full_forward(stack):
         cur = np.concatenate([cur, nxt[:, None]], 1)
 
 
+def test_decoder_short_prompt_decodes_from_true_last_token(stack):
+    """A right-padded short prompt decodes from its TRUE last token, not
+    the pad tail: per-row ``lens`` gathers the lens-1 logits for the
+    first step and rewinds the cache's per-row write offsets, so every
+    generated token matches the greedy continuation of the UNPADDED
+    prompt through the full forward — the 'padding is inert' contract
+    on the decode path."""
+    fwd, dec = stack["fwd"], stack["dec"]
+    true_len, n_new = 5, 3
+    vecs, toks = _vecs(stack, 2), _toks(2, t=true_len)
+    padded = np.zeros((2, T), np.int32)
+    padded[:, :true_len] = toks
+    stacked = fwd.stacked_tree(vecs)
+    lens = np.full(2, true_len, np.int32)
+    last, _ = dec.prefill(stacked, padded, lens=lens)
+    full = np.asarray(fwd.batched(stacked, jnp.asarray(toks)))
+    np.testing.assert_allclose(np.asarray(last), full[:, -1], atol=2e-5)
+    gen = np.asarray(dec.generate(stacked, padded, n_new, lens=lens))
+    assert np.array_equal(gen[:, 0], full[:, -1].argmax(-1))
+    cur = toks.copy()
+    for step in range(n_new):
+        logits = np.asarray(fwd.batched(stacked, jnp.asarray(cur)))
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        assert np.array_equal(gen[:, step], nxt)
+        cur = np.concatenate([cur, nxt[:, None]], 1)
+
+
+def test_decoder_mixed_lengths_decode_independently(stack):
+    """Rows of DIFFERENT true lengths in one padded batch each continue
+    from their own last token (per-row cache positions), matching the
+    row served alone at its true length."""
+    fwd, dec = stack["fwd"], stack["dec"]
+    vecs = _vecs(stack, 2)
+    lens = np.array([3, 7], np.int32)
+    padded = _toks(2, t=T)
+    for i, ln in enumerate(lens):
+        padded[i, ln:] = 0
+    stacked = fwd.stacked_tree(vecs)
+    gen = np.asarray(dec.generate(stacked, padded, 2, lens=lens))
+    for i, ln in enumerate(lens):
+        solo = np.asarray(dec.generate(
+            fwd.stacked_tree(vecs[i:i + 1]), padded[i:i + 1, :ln], 2))
+        assert np.array_equal(gen[i], solo[0])
+
+
 def test_pick_attention_crossover():
     from fedml_tpu.serve import FLASH_CROSSOVER_T, pick_attention
 
@@ -284,6 +329,80 @@ def test_micro_batcher_thread_serves_and_decodes(stack):
     # zero-count metrics are omitted from registry snapshots
     assert stats["serve/served"] == 6 and stats.get("serve/shed", 0) == 0
     assert stats["serve/latency_ms_count"] == 6
+
+
+def test_serve_batch_decode_consistent_with_next_token(stack):
+    """Short prompts through the padded plane: each request's first
+    generated token is the argmax of its OWN true-last-position logits —
+    the same value the socket reply computes — never a continuation of
+    the pad tail."""
+    mgr = _manager(stack, decoder=stack["dec"])
+    reqs = [mgr.submit(i, [1, 2, 3][:ln], max_new_tokens=2)
+            for i, ln in enumerate((3, 1))]
+    mgr.serve_batch([mgr._q.get_nowait() for _ in range(2)])
+    for req in reqs:
+        logits, gen = req.result(5)
+        assert gen.shape == (2,)
+        assert gen[0] == int(np.argmax(logits[-1]))
+
+
+def test_submit_refuses_bad_max_new_tokens(stack):
+    """Decode budget is validated at admission: negative counts and
+    requests whose seq_len + max_new_tokens exceed the decoder's
+    max_len (where JAX OOB clamping would serve garbage) refuse loudly."""
+    mgr = _manager(stack, decoder=stack["dec"])
+    with pytest.raises(ServeRefused, match="max_new_tokens"):
+        mgr.submit(0, [1, 2], max_new_tokens=-1)
+    over = stack["dec"].max_len - mgr.seq_len + 1
+    with pytest.raises(ServeRefused, match="decoder budget"):
+        mgr.submit(0, [1, 2], max_new_tokens=over)
+    # the largest in-budget count admits (bench runs exactly at it)
+    mgr.submit(0, [1, 2], max_new_tokens=over - 1)
+    stats = mgr.stats()
+    assert stats["serve/refused"] == 2 and stats["serve/admitted"] == 1
+
+
+def test_close_drains_queued_requests(stack):
+    """Shutdown never wedges a waiter: requests still queued when the
+    batcher exits are completed with a refusal, and post-close submits
+    refuse instead of queueing into the void."""
+    from fedml_tpu.serve.plane import ServeRequest
+
+    mgr = _manager(stack)
+    mgr.start()
+    mgr.close()
+    # a request that slipped into the queue concurrently with shutdown
+    straggler = ServeRequest(0, np.array([1, 2], np.int32), 0, 0.0)
+    mgr._q.put_nowait(straggler)
+    mgr.close()  # idempotent close drains it
+    with pytest.raises(ServeRefused, match="shut down"):
+        straggler.result(5)
+    with pytest.raises(ServeRefused, match="shut down"):
+        mgr.submit(0, [1, 2])
+
+
+def test_shadow_mirror_compiles_one_batch_shape(stack):
+    """The mirror CE runs on the already-padded [max_batch, seq_len]
+    tokens: serving batches of DIFFERENT occupancy while a candidate is
+    staged reuses one compiled program — no fresh XLA compile stalls the
+    serving thread mid-traffic."""
+    mgr = _manager(stack)
+    mgr.set_shadow(1, stack["glob"])
+    shapes = []
+    real_ce = mgr._ce
+
+    def spy(stacked, toks, m):
+        shapes.append(tuple(toks.shape))
+        return real_ce(stacked, toks, m)
+
+    mgr._ce = spy
+    for n in (1, 3, 2):
+        reqs = [mgr.submit(i, [1, 2, 3, 4]) for i in range(n)]
+        mgr.serve_batch([mgr._q.get_nowait() for _ in range(n)])
+        for r in reqs:
+            r.result(5)
+    assert set(shapes) == {(mgr.max_batch, mgr.seq_len)}
+    assert mgr.shadow_scores()["tokens"] == 6 * 3  # pad rows masked out
 
 
 def test_socket_front_end_roundtrip(stack):
