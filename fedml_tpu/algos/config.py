@@ -196,6 +196,21 @@ class FedConfig:
     # server mix and FedBuff's global-arrival-order buffer REFUSE the
     # flag. 0 (default) keeps the single-server ingest path.
     agg_shards: int = 0
+    # Dropout-robust secure aggregation (comm/secagg.py, --secagg at the
+    # CLI; docs/ROBUSTNESS.md "Secure aggregation"): clients add
+    # pairwise seed-expanded masks to their fixed-point int64 uploads so
+    # the server only ever materializes the SUM — masks cancel exactly
+    # in the pooled fold (and across the sharded plane's wire merge),
+    # and a heartbeat eviction triggers a t-of-n Shamir seed reveal that
+    # subtracts the orphaned masks. Sync FedAvg + mean aggregation +
+    # all-arrive rounds only; needs ingest_workers > 0 or agg_shards > 0
+    # (the masks live in the pool's fixed-point domain). The async tiers
+    # and every non-supporting driver refuse the flag loudly.
+    secagg: bool = False
+    # Shamir reveal threshold t: survivors needed to reconstruct an
+    # evicted rank's seeds. 0 (default) resolves to a majority
+    # (n//2 + 1) of the handshake roster.
+    secagg_t: int = 0
     # Federation flight recorder (obs/trace.py, --trace at the CLI;
     # docs/OBSERVABILITY.md): record upload-lifecycle spans (client
     # serialize → wire → codec decode → accumulator fold → round commit,

@@ -190,6 +190,18 @@ class FedAsyncServerManager(ServerManager):
                 "(comm/shardplane.py): the async tiers' sequential mix / "
                 "global-arrival buffer cannot be partitioned across "
                 "aggregator shards — run with agg_shards=0")
+        if getattr(cfg, "secagg", False):
+            # Pairwise masks only cancel inside ONE summed cohort whose
+            # roster is pinned before anyone uploads. The async tiers mix
+            # each arrival into the global immediately (pure async) or
+            # barrier on global arrival ORDER (fedbuff) — there is no
+            # roster-complete sum for the masks to cancel in, so a masked
+            # upload would publish mask-sized garbage into the global.
+            raise ValueError(
+                "secagg is a synchronous-FedAvg capability "
+                "(comm/secagg.py): the async tiers have no "
+                "roster-complete cohort sum for pairwise masks to cancel "
+                "in — run with secagg disabled or the sync tier")
         workers = int(getattr(cfg, "ingest_workers", 0) or 0)
         if workers > 0:
             from fedml_tpu.comm.ingest import IngestPool

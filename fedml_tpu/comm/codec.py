@@ -84,6 +84,16 @@ DELTA_OK_KEY = "delta_frames_ok"
 #: each tier keeps its historical interpretation (sync/async full,
 #: fedbuff delta) for hand-built protocol-test messages.
 DELTA_KEY = "payload_is_delta"
+#: Handshake key: the server runs the secure-aggregation plane
+#: (``comm/secagg.py``) and will fold MASKED int64 fixed-point frames.
+#: Advertised on assignments exactly like :data:`DELTA_OK_KEY` — a
+#: secagg client facing a server that never advertised it must refuse
+#: (:func:`require_secagg_peer`), not upload its update in the clear.
+SECAGG_OK_KEY = "secagg_ok"
+#: Upload message key: True = the payload is a PAIRWISE-MASKED int64
+#: fixed-point contribution (fold with ``PartialAccumulator.add_fixed``,
+#: never decode/clip); absent/False = a normal clear-domain payload.
+SECAGG_MASKED_KEY = "secagg_masked"
 
 #: Stage names this build implements — the negotiation offer.
 SUPPORTED_STAGES = ("bf16", "fp16", "int8", "topk", "randmask")
@@ -550,6 +560,24 @@ def require_delta_peer(offer_flag, *, peer: str = "peer") -> None:
             f"(no {DELTA_OK_KEY!r} in its handshake): it would mis-fold "
             "a delta frame as a full model — upgrade the peer or run a "
             "full-model tier")
+
+
+def require_secagg_peer(offer_flag, *, peer: str = "peer") -> None:
+    """Loud refusal of masked uploads against a secagg-ignorant server:
+    same shape as :func:`require_delta_peer`, stricter stakes. A client
+    configured for secure aggregation that "degrades" to clear uploads
+    has silently dropped the privacy property the run was configured
+    for — and a secagg-ignorant server would decode the masked int64
+    frame as model floats and corrupt the global. There is no fallback:
+    the connection must refuse."""
+    if not offer_flag:
+        raise ValueError(
+            f"secure aggregation required but the {peer} is "
+            f"secagg-ignorant (no {SECAGG_OK_KEY!r} in its handshake): "
+            "it would fold the masked int64 frame as a clear model — "
+            "and uploading in the clear instead would silently drop the "
+            "privacy the run was configured for; upgrade the peer or "
+            "run with secagg off")
 
 
 def stage_names_of(spec: str) -> List[str]:

@@ -130,6 +130,32 @@ def finalize_partial_mean(total: "PartialAccumulator", ref_tree, dtype=None):
     return jax.tree.unflatten(treedef, out), count
 
 
+class FixedContribution:
+    """A contribution ALREADY on the int64 fixed-point grid — the masked
+    secure-aggregation frames (``comm/secagg.py``): the client quantized
+    ``w·x`` with :func:`quantize_contribution` semantics itself, then
+    added pairwise masks that span the FULL int64 range, so the server
+    fold must be a raw modular int64 add — re-quantizing, clipping, or
+    range-checking a pre-cancellation masked frame would break the exact
+    mask cancellation (and leak that a value was large). ``qweight`` is
+    the already-quantized weight (:func:`quantize_weight`), ``count``
+    the membership delta (0 for a server-side mask correction, which
+    adds leaves without representing an upload), ``clipped`` the
+    client-counted envelope saturations to roll into ``saturated`` (the
+    client runs the same quantization clip the server pool would, and
+    ships the count in the clear — it is weight metadata, not update
+    content)."""
+
+    __slots__ = ("leaves", "qweight", "count", "clipped")
+
+    def __init__(self, leaves: List[np.ndarray], qweight: int,
+                 count: int = 1, clipped: int = 0):
+        self.leaves = leaves
+        self.qweight = int(qweight)
+        self.count = int(count)
+        self.clipped = int(clipped)
+
+
 class PartialAccumulator:
     """One worker's running Σ w_i·x_i (int64 leaves) + Σ w_i (int).
     Single-writer (its owning pool worker); merged under the pool lock at
@@ -226,6 +252,62 @@ class PartialAccumulator:
             self.saturated += 1
         self.wsum += quantize_weight(w)
         self.count += 1
+
+    def add_fixed(self, fixed: FixedContribution) -> None:
+        """Fold a :class:`FixedContribution`: raw MODULAR int64 leaf adds
+        (the uint64 bit view — two's-complement wraparound with no numpy
+        warning machinery in the loop), no float path, no clip, no
+        envelope count. Masked secagg frames sit anywhere in the int64
+        range by construction; clamping one would destroy the exact
+        pairwise-mask cancellation the whole protocol rests on. The
+        envelope becomes checkable only AFTER cancellation — see
+        :meth:`envelope_overflow`, run by the finalize sites on the
+        merged total."""
+        leaves = fixed.leaves
+        if leaves is not None:
+            self._ensure(leaves)
+            for i, leaf in enumerate(leaves):
+                acc = self.leaves[i]
+                lf = np.asarray(leaf)
+                if lf.dtype != np.int64:
+                    raise ValueError(
+                        f"fixed contribution leaf {i} has dtype {lf.dtype}, "
+                        "expected int64 — a masked frame that lost its grid "
+                        "dtype on the wire cannot be folded")
+                if lf.shape != acc.shape:
+                    raise ValueError(
+                        f"fixed contribution leaf {i} has shape {lf.shape}, "
+                        f"accumulator holds {acc.shape}")
+                np.add(acc.view(np.uint64),
+                       np.ascontiguousarray(lf).view(np.uint64),
+                       out=acc.view(np.uint64))
+        self.wsum += fixed.qweight
+        self.count += fixed.count
+        self.saturated += fixed.clipped
+
+    def envelope_overflow(self) -> int:
+        """Post-cancellation envelope headroom check for the masked
+        fold: once every pairwise mask has cancelled (or been corrected
+        away), the merged total must satisfy ``|leaf| <= count * 2^50``
+        — each of ``count`` contributions was clamped to ±2^50 at
+        quantization, so a residual beyond that bound means uncancelled
+        mask mass (a protocol bug, a forged frame) or genuine int64
+        wraparound of the sum. COUNTED into ``saturated`` (one bump per
+        check that found any overflow, mirroring the per-contribution
+        convention of :meth:`add`), never clamped: the finalize sites
+        report it through the same ``saturated`` rollup the shardplane
+        wire frame already carries. Returns the number of offending
+        elements."""
+        if self.leaves is None or self.count <= 0:
+            return 0
+        bound = int(self.count) * int(_CLIP)
+        over = 0
+        for acc in self.leaves:
+            over += int(np.count_nonzero(acc > bound)
+                        + np.count_nonzero(acc < -bound))
+        if over:
+            self.saturated += 1
+        return over
 
     def merge_into(self, other: "PartialAccumulator") -> None:
         """Exact merge: int64 leaf adds + scalar sums. The scalar tallies
@@ -328,14 +410,21 @@ class IngestPool:
                                              worker=i, **meta):
                     out = fn()
                     if sink is None:
-                        # (leaves, weight) or (leaves, weight, base) —
-                        # base folds w*(base+leaf) without materializing
-                        # the reconstruction (the sync tier's deltas).
-                        if len(out) == 3:
-                            leaves, w, base = out
+                        if isinstance(out, FixedContribution):
+                            # Already on the int64 grid (masked secagg
+                            # frames / mask corrections): modular add,
+                            # no float path, no clip.
+                            partial.add_fixed(out)
                         else:
-                            (leaves, w), base = out, None
-                        partial.add(leaves, w, base=base)
+                            # (leaves, weight) or (leaves, weight, base)
+                            # — base folds w*(base+leaf) without
+                            # materializing the reconstruction (the sync
+                            # tier's deltas).
+                            if len(out) == 3:
+                                leaves, w, base = out
+                            else:
+                                (leaves, w), base = out, None
+                            partial.add(leaves, w, base=base)
             except BaseException as e:  # noqa: BLE001 — surfaced at drain
                 if sink is not None:
                     sink["err"] = e

@@ -85,9 +85,11 @@ from fedml_tpu.algos.fedavg_distributed import (
 )
 from fedml_tpu.comm import codec as wire_codec
 from fedml_tpu.comm.ingest import (
+    FixedContribution,
     IngestPool,
     PartialAccumulator,
     finalize_partial_mean,
+    quantize_weight,
 )
 from fedml_tpu.comm.managers import ServerManager
 from fedml_tpu.comm.message import Message
@@ -312,6 +314,9 @@ class AggregatorShardManager(ServerManager):
         codec = msg.get("compression")
         wcodec = msg.get(wire_codec.CODEC_KEY)
         is_delta = bool(msg.get(wire_codec.DELTA_KEY))
+        masked = bool(msg.get(wire_codec.SECAGG_MASKED_KEY))
+        clipped = int(msg.get("secagg_clipped") or 0)
+        secagg_on = bool(getattr(self.cfg, "secagg", False))
         weight = float(msg.get(MSG_ARG_KEY_NUM_SAMPLES))
         ck = obs_trace.corr(epoch=self.epoch, round=t, sender=sender)
         self._h_bytes.record(payload_nbytes(payload))
@@ -326,6 +331,19 @@ class AggregatorShardManager(ServerManager):
 
         # fedlint: twin-of(fedml_tpu/algos/fedavg_distributed.py)
         def task():
+            if masked:
+                # A masked upload is ALREADY in the pool's fixed-point
+                # int64 domain: fold it verbatim (any rescale would break
+                # the exact pairwise cancellation at the coordinator's
+                # wire merge). A masked frame on a non-secagg shard is a
+                # refusal — surfaced by the flush drain as a NOTICE, the
+                # coordinator's codec-refusal policy evicts+releases.
+                if not secagg_on:
+                    raise ValueError(
+                        "masked upload on a shard without --secagg")
+                return FixedContribution(
+                    [np.ascontiguousarray(l, np.int64) for l in payload],
+                    quantize_weight(weight), 1, int(clipped))
             if codec:
                 delta = self._decoder_for(codec).decode(payload, spec)
             elif wcodec:
@@ -380,7 +398,18 @@ class ShardedFedAVGServerManager(FedAVGServerManager):
     every live shard); each PARTIAL is collected; when the pending set
     empties, ``_finish_flush`` merges in sorted-rank order, finalizes,
     anchors round r+1 on the shards, THEN assigns the workers — FIFO
-    per channel makes anchor-before-upload exact."""
+    per channel makes anchor-before-upload exact.
+
+    Secure aggregation composes: masked uploads are int64 frames the
+    shards fold verbatim, pairwise masks cancel in the coordinator's
+    wire merge exactly as in the single pool (integer adds are
+    associative), and ``_finish_flush`` holds the commit until every
+    orphaned roster rank's seeds are revealed and its correction folded
+    into the merged total."""
+
+    # The coordinator folds on the shards, not a local pool — tells the
+    # base constructor's secagg guard that ingest_workers=0 is fine here.
+    _secagg_sharded = True
 
     def __init__(self, args, aggregator, cfg, size: int, agg_shards: int,
                  backend: str = "LOOPBACK", aggregate_k: int = 0, *,
@@ -444,6 +473,18 @@ class ShardedFedAVGServerManager(FedAVGServerManager):
         # + ByteLedger rollup in health()).
         self._shard_saturated: Dict[int, int] = {}
         self._shard_bytes: Dict[int, Tuple[int, int]] = {}
+        if getattr(cfg, "secagg", False):
+            if aggregate_k:
+                raise ValueError(
+                    f"secagg with aggregate_k={aggregate_k}: a first-k "
+                    "commit orphans every straggler's masks, so each "
+                    "round would reveal the stragglers' seeds and "
+                    "permanently release them (comm/secagg.py is "
+                    "all-or-reveal)")
+            # The base constructor keyed the secagg coordinator to the
+            # pre-rebase membership (ranks 1..size-1 — which includes
+            # the M shard ranks); re-key it to the true worker ranks.
+            self._secagg_init()
 
     # -- rank plumbing ------------------------------------------------------
     def _worker_slot(self, worker: int) -> int:
@@ -678,6 +719,10 @@ class ShardedFedAVGServerManager(FedAVGServerManager):
             self.heartbeat.beat(worker)
             if self.round_idx >= self.cfg.comm_round:
                 self._send_done(worker)
+            elif (self.secagg is not None
+                    and self.secagg.compromised(worker)):
+                # Revealed seeds: released for the epoch, never re-fed.
+                self._send_done(worker)
             elif r == self.round_idx:
                 # A late same-round upload racing the flush: a fresh
                 # assignment for THIS round would be deduped client-side
@@ -699,12 +744,17 @@ class ShardedFedAVGServerManager(FedAVGServerManager):
 
     def _on_accept(self, shard: int, worker: int, r: int) -> None:
         self.heartbeat.beat(worker)  # an upload is liveness
+        # A compromised rank (seeds revealed/mid-reveal) is never
+        # re-admitted — but its CURRENT upload already folded on the
+        # shard, so the arrival must still count (correction ⟺ not
+        # arrived; the commit tail releases it).
         with self._lock:
-            member = worker in self._members
-            if not member:
+            readmit = worker not in self._members and not (
+                self.secagg is not None
+                and self.secagg.compromised(worker))
+            if readmit:
                 self._members.add(worker)
                 self.readmissions += 1
-        if not member:
             self.flight.record("readmission", sender=worker, round=r,
                                via="upload")
         if r != self.round_idx:
@@ -749,6 +799,14 @@ class ShardedFedAVGServerManager(FedAVGServerManager):
     def _complete_round(self) -> None:
         """k-th accept: start the flush. The commit happens in
         ``_finish_flush`` once every live shard's partial is in."""
+        # Mask-completeness gate BEFORE the flush barrier: an evicted
+        # roster rank's masks sit orphaned inside the shards' partials;
+        # hold the flush until its seeds are revealed (each reveal
+        # re-enters via _secagg_recheck). Orphans appearing mid-flush
+        # (a shard eviction pulling arrivals back) are caught by the
+        # same gate at the top of _finish_flush.
+        if self.secagg is not None and not self._secagg_reveals_ready():
+            return
         with self._lock:
             if self._flushing_round is not None:
                 return
@@ -807,6 +865,24 @@ class ShardedFedAVGServerManager(FedAVGServerManager):
         (int64 adds — order-insensitive, sorted for determinism of the
         merge span), finalize through the ONE division site the
         in-process pool uses, then run the base round-commit tail."""
+        if self.secagg is not None:
+            with self._lock:
+                r0 = self._flushing_round
+                arrived0 = sorted(self._arrived)
+            if r0 is None:
+                return
+            pending = self.secagg.unreconstructed(r0, arrived0)
+            if pending:
+                # A mid-flush shard eviction pulled arrivals back out of
+                # the round: those roster ranks are orphans now, and
+                # their un-cancelled masks are already folded into the
+                # collected partials. Hold the commit for the reveals
+                # (_secagg_recheck re-enters) — and drop them from the
+                # catch-up list: a revealed rank is released, not re-fed.
+                with self._lock:
+                    self._catchup_after_flush -= set(pending)
+                self._secagg_request_reveals(pending)
+                return
         with self._lock:
             r = self._flushing_round
             if r is None:
@@ -828,6 +904,24 @@ class ShardedFedAVGServerManager(FedAVGServerManager):
                 shards=len(partials), arrived=len(arrived)):
             for p in partials:
                 p.merge_into(total)
+            if self.secagg is not None:
+                # Orphaned roster ranks (reveals completed above): fold
+                # each reconstructed-seed correction as a weight-0
+                # count-0 contribution — the same exact int64 adds the
+                # single-pool precommit path uses — then audit the
+                # post-cancellation envelope.
+                orphans = self.secagg.orphans(r, arrived)
+                if orphans:
+                    shapes = [np.shape(np.asarray(l))
+                              for l in jax.tree.leaves(self.aggregator.net)]
+                    for d in orphans:
+                        corr = self.secagg.correction(
+                            d, r, self.epoch, arrived, shapes)
+                        total.add_fixed(FixedContribution(corr, 0, 0))
+                    self.flight.record(
+                        "secagg_correction", round=r,
+                        targets=[int(d) for d in orphans])
+                self._secagg_envelope_check(total)
             mean, count = finalize_partial_mean(total, self.aggregator.net)
         if count != len(arrived):
             raise ValueError(
@@ -851,10 +945,14 @@ class ShardedFedAVGServerManager(FedAVGServerManager):
         if self._ckpt is not None and self.cfg.checkpoint_every and (
                 self.round_idx % self.cfg.checkpoint_every == 0):
             self._save_checkpoint(wait=False)
+        # Secagg membership repair (waitroom admits, compromised purge,
+        # reveal bookkeeping reset) — same tail as the single-pool path.
+        extra = (self._secagg_commit_tail(arrived)
+                 if self.secagg is not None else [])
         if self.round_idx >= self.cfg.comm_round:
             for s in self._shard_ranks():
                 self._send_anchor(s, done=True)
-            for worker in arrived:
+            for worker in list(arrived) + extra:
                 self._send_done(worker)
             for worker in catchup:
                 if worker not in arrived:
@@ -865,11 +963,38 @@ class ShardedFedAVGServerManager(FedAVGServerManager):
         for s in self._live_shards_snapshot():
             self._send_anchor(s)
         client_indexes = self.aggregator.client_sampling(self.round_idx)
-        for worker in arrived:
-            self._send_assignment(worker, client_indexes)
+        compromised = (self.secagg.compromised
+                       if self.secagg is not None else (lambda w: False))
+        for worker in list(arrived) + extra:
+            if compromised(worker):
+                # Its fold counted this round, but its seeds are public
+                # now — release for the epoch instead of re-assigning.
+                self._send_done(worker)
+            else:
+                self._send_assignment(worker, client_indexes)
         for worker in catchup:
             if worker not in arrived:
-                self._send_assignment(worker, client_indexes)
+                if compromised(worker):
+                    self._send_done(worker)
+                else:
+                    self._send_assignment(worker, client_indexes)
+
+    def _secagg_recheck(self) -> None:
+        """A seed reveal just completed. If the flush barrier already
+        emptied (we returned early from ``_finish_flush`` to wait for
+        this reveal), re-enter the commit; if no flush is in flight the
+        base recheck re-drives ``_complete_round`` through its own gate.
+        A flush with partials still pending needs nothing — the gate
+        re-runs when the last partial lands."""
+        if self.round_idx >= self.cfg.comm_round:
+            return
+        with self._lock:
+            flushing = self._flushing_round is not None
+            drained = flushing and not self._flush_pending
+        if drained:
+            self._finish_flush()
+        elif not flushing:
+            super()._secagg_recheck()
 
     # -- observability ------------------------------------------------------
     def health(self) -> Dict[str, int]:

@@ -167,6 +167,20 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "BIT-EQUAL to the single-process pool for any "
                         "M. Sync FedAvg + mean aggregation only; 0 "
                         "(default) keeps the single-server ingest path")
+    p.add_argument("--secagg", action="store_true",
+                   help="dropout-robust secure aggregation "
+                        "(comm/secagg.py): pairwise seed-expanded masks "
+                        "over the fixed-point int64 uploads cancel "
+                        "exactly in the pooled fold, so the server only "
+                        "materializes the sum; an eviction triggers a "
+                        "t-of-n Shamir seed reveal that subtracts the "
+                        "orphaned masks. Sync FedAvg + mean aggregation "
+                        "only; needs --ingest_workers > 0 or "
+                        "--agg_shards > 0")
+    p.add_argument("--secagg_t", type=int, default=0,
+                   help="Shamir reveal threshold: survivors needed to "
+                        "reconstruct an evicted rank's mask seeds "
+                        "(0 = majority of the handshake roster)")
     p.add_argument("--compute_layout", type=str, default="none",
                    help="lane-fill compute layout for the client step: "
                         "none | auto (pad channel dims to MXU lane/"
@@ -417,6 +431,30 @@ def reject_agg_shards_flag(args, algorithm: str) -> None:
             "be silently inert here")
 
 
+def reject_secagg_flags(args, algorithm: str) -> None:
+    """Refuse the secure-aggregation knobs wherever the masked protocol
+    cannot run (the PR 4 flag-rejection convention): secagg needs the
+    synchronous message-passing federation's roster-complete rounds and
+    the fixed-point ingest pool (comm/secagg.py rides comm/ingest.py).
+    A drill whose ``--secagg`` silently does nothing would report a
+    CLEAR-upload run as a privacy experiment — the worst possible
+    silent-inert flag; it must refuse. The async tiers' server managers
+    additionally refuse ``cfg.secagg`` themselves (algos/fedasync.py:
+    no roster-complete cohort sum for the masks to cancel in)."""
+    bad = []
+    if getattr(args, "secagg", False):
+        bad.append("--secagg")
+    if getattr(args, "secagg_t", 0):
+        bad.append(f"--secagg_t {args.secagg_t}")
+    if bad:
+        raise SystemExit(
+            f"{algorithm} does not support {', '.join(bad)}: secure "
+            "aggregation rides the sync cross-silo tier's fixed-point "
+            "ingest pool and roster-complete rounds (comm/secagg.py) — "
+            "a silently-inert privacy flag would report clear uploads "
+            "as a masked run")
+
+
 def trace_dir_from(args) -> "str | None":
     """Resolve ``--trace`` into the runners' ``trace_dir``: the run
     directory when tracing is on (refusing loudly without one — trace
@@ -483,5 +521,7 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
         heartbeat_interval_s=args.heartbeat_interval_s,
         ingest_workers=args.ingest_workers,
         agg_shards=int(getattr(args, "agg_shards", 0) or 0),
+        secagg=bool(getattr(args, "secagg", False)),
+        secagg_t=int(getattr(args, "secagg_t", 0) or 0),
         trace=args.trace,
     )

@@ -32,6 +32,7 @@ def run_centralized(args):
                                     reject_fedavg_family_flags,
                                     reject_ingest_pool_flag,
                                     reject_pod_plane_flags,
+                                    reject_secagg_flags,
                                     reject_serve_flags)
     from fedml_tpu.exp.run import SEQ_DATASETS
 
@@ -53,6 +54,8 @@ def run_centralized(args):
     reject_async_tier_flags(args, "the centralized baseline")
     reject_ingest_pool_flag(args, "the centralized baseline")
     reject_agg_shards_flag(args, "the centralized baseline")
+    # No uploads to mask either: the pooled baseline never federates.
+    reject_secagg_flags(args, "the centralized baseline")
     # ...and no serving plane: serving rides main_extra's FedBuff runner.
     reject_serve_flags(args, "the centralized baseline")
     from fedml_tpu.exp.setup import (

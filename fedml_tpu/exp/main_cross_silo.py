@@ -79,6 +79,12 @@ def main(argv=None):
     # mean-fold off the dispatch thread, bit-equal for any worker count.
     # The read happens through cfg on the rank-0 manager:
     # fedlint: consumes(ingest_workers)
+    # --secagg / --secagg_t (also shared) arm dropout-robust secure
+    # aggregation (comm/secagg.py): pairwise-masked int64 uploads that
+    # cancel exactly in the pool's fixed-point fold, with t-of-n Shamir
+    # seed reveal on eviction. Sync tier only; the rank-0 manager reads
+    # both through cfg (and refuses without an ingest pool):
+    # fedlint: consumes(secagg, secagg_t)
     parser.add_argument("--aggregate_k", type=int, default=0,
                         help="straggler-tolerant first-k rounds: aggregate "
                              "as soon as k fresh uploads arrive (0 = wait "

@@ -24,6 +24,7 @@ from fedml_tpu.exp.args import (add_args, config_from_args,
                                 reject_fedavg_family_flags,
                                 reject_ingest_pool_flag,
                                 reject_pod_plane_flags,
+                                reject_secagg_flags,
                                 reject_serve_flags)
 from fedml_tpu.exp.setup import global_test_batches, load_data
 from fedml_tpu.data.loaders import to_federated_arrays
@@ -367,6 +368,13 @@ def main(argv=None):
     # buffer cannot be partitioned), and every other specialty loop
     # never stands up a message-passing server at all.
     reject_agg_shards_flag(args, args.algorithm)
+    # Secure aggregation is likewise sync-FedAvg-only (comm/secagg.py):
+    # FedAsync/FedBuff refuse cfg.secagg in their server constructors
+    # (no roster-complete cohort sum for the masks to cancel in), and
+    # no other specialty loop stands up the masked upload path — refuse
+    # at the driver so a "privacy" run can never silently ship clear
+    # uploads.
+    reject_secagg_flags(args, args.algorithm)
     # The pod compute plane (bf16 client step, DCN group reduction)
     # rides the FedAvg family's shared rounds; every specialty loop
     # refuses here. FedAsync/FedBuff refuse client_step_dtype /

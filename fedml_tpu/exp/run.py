@@ -139,11 +139,16 @@ def run(args, algorithm: str = "FedAvg"):
                                     reject_agg_shards_flag,
                                     reject_async_tier_flags,
                                     reject_ingest_pool_flag,
+                                    reject_secagg_flags,
                                     reject_serve_flags)
 
     reject_async_tier_flags(args, algorithm)
     reject_ingest_pool_flag(args, algorithm)
     reject_agg_shards_flag(args, algorithm)
+    # Secure aggregation rides the message-passing tier's fixed-point
+    # ingest pool — the jitted simulator round materializes every client
+    # update in the clear by construction, so the flag must refuse.
+    reject_secagg_flags(args, algorithm)
     # No simulator tier serves: the serving plane rides main_extra's
     # FedBuff runner only (fedml_tpu.serve).
     reject_serve_flags(args, algorithm)

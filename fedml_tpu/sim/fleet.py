@@ -230,6 +230,11 @@ class FleetSimulator:
                 "capability (comm/shardplane.py); the async tiers refuse "
                 "it in their server constructors for the same reason — "
                 f"mode {mode!r} has no barrier round to partition")
+        if getattr(cfg, "secagg", False) and mode != "sync":
+            raise ValueError(
+                f"secagg is a synchronous-FedAvg capability "
+                "(comm/secagg.py); pairwise masks only cancel inside a "
+                f"roster-complete cohort sum — mode {mode!r} has none")
         self.mode = mode
         self.agg_shards = int(agg_shards or 0)
         self.trace = trace

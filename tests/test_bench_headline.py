@@ -88,6 +88,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
                  "bench_serving_plane",
                  "bench_ingest_profile",
                  "bench_serving_1m", "bench_agg_shards",
+                 "bench_secagg",
                  "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
                  "bench_serving_10m",
@@ -117,7 +118,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     # Every section that RAN finished inside the budget: elapsed at its
     # start + the full section cap fit under 300s.
     assert len(ran) * 50 + 100 <= 300
-    assert len(ran) + len(skipped) == 24
+    assert len(ran) + len(skipped) == 25
 
 
 def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
@@ -133,6 +134,7 @@ def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
                  "bench_serving_plane",
                  "bench_ingest_profile",
                  "bench_serving_1m", "bench_agg_shards",
+                 "bench_secagg",
                  "bench_fleet_sim",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
                  "bench_serving_10m",
@@ -262,9 +264,11 @@ def test_headline_tolerates_budget_skipped_submetrics():
     assert h["sub"]["bf16_step_speedup"] is None
     assert "bf16_acc_delta" not in h["sub"]
     assert "robust_agg_overhead" not in h["sub"]  # rotated out in r14
-    # The r16 sharded-aggregation-plane scalars ride (None when skipped).
+    # The r16 sharded-aggregation-plane scalar rides (None when skipped).
     assert h["sub"]["agg_shard_speedup_4v1"] is None
-    assert h["sub"]["agg_shard_coord_occupancy"] is None
+    assert "agg_shard_coord_occupancy" not in h["sub"]  # rotated out, r19
+    # The r19 secure-aggregation scalar rides (None when skipped).
+    assert h["sub"]["secagg_overhead"] is None
     assert h["sub"]["serving_10m_uploads_per_sec"] is None
     assert "fleet_buffered_stale_p95_vs_async" not in h["sub"]  # r16
     assert "synthetic_1m_peak_rss_ratio" not in h["sub"]  # r16

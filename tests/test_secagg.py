@@ -1,0 +1,645 @@
+"""Dropout-robust secure aggregation in the integer domain
+(comm/secagg.py, r19).
+
+Fast lane: exact pairwise-mask cancellation across the {1,2,4}-worker x
+{1,2,4}-shard fold matrix under seeded arrival permutations (pure pool
+math + the shardplane wire frame), the dropout seed-reveal correction
+bit-equal to a never-had-that-client fold, Shamir/DH hardening (exactly
+t reconstructs, t-1 must fail, survivor-subset reveals), the masked
+resend/duplicate idempotence pins, the post-cancellation envelope audit
+through the partial wire frame, the CLI / tier refusal sweep, the stale
+epoch reveal fence, and ONE live masked loopback federation under chaos
+duplication whose net is bit-equal to the unmasked twin and whose
+server-side accumulator trajectory never materializes an individual
+update in the clear. Heavier federations (the full loopback matrix)
+ride the slow lane.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algos import FedConfig
+from fedml_tpu.comm.ingest import (
+    FixedContribution,
+    PartialAccumulator,
+    finalize_partial_mean,
+    quantize_weight,
+)
+from fedml_tpu.comm.secagg import (
+    SecAggClient,
+    SecAggServer,
+    expand_masks,
+    mask_seed,
+    resolve_threshold,
+)
+from fedml_tpu.comm.shardplane import decode_partial, encode_partial
+from fedml_tpu.core.mpc import DEFAULT_PRIME, bgw_decode, key_agreement, pk_gen
+from fedml_tpu.data.batching import build_federated_arrays
+from fedml_tpu.data.partition import partition_homo
+from fedml_tpu.data.synthetic import make_classification
+from fedml_tpu.models.lr import LogisticRegression
+
+SHAPES = [(3, 2), (5,)]
+
+
+def _handshake(n, t=0, epoch=0):
+    """The full setup round in miniature: n clients (ranks 1..n) with
+    injected sks, pk exchange, roster broadcast, Shamir share rows."""
+    ranks = list(range(1, n + 1))
+    srv = SecAggServer(ranks, t=t)
+    clients = {r: SecAggClient(r, epoch, sk=1000 + r) for r in ranks}
+    for r, c in clients.items():
+        srv.add_pk(r, c.pk)
+    body = srv.roster_payload(ranks)
+    for r, c in clients.items():
+        srv.add_row(r, c.build_shares(body["pks"], body["t"],
+                                      body["universe"]))
+    assert srv.setup_complete(ranks)
+    return srv, clients
+
+
+def _contributions(n, seed=0):
+    """n quantized fixed-point contributions (the exact client path:
+    PartialAccumulator.add onto the int64 grid)."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        leaves = [rng.randn(*s).astype(np.float32) for s in SHAPES]
+        w = float(rng.randint(1, 50))
+        acc = PartialAccumulator()
+        acc.add(leaves, w)
+        out.append(([l.copy() for l in acc.leaves], w))
+    return out
+
+
+def _fold_fixed(frames):
+    total = PartialAccumulator()
+    for leaves, w in frames:
+        total.add_fixed(FixedContribution(
+            [np.ascontiguousarray(l, np.int64) for l in leaves],
+            quantize_weight(w), 1, 0))
+    return total
+
+
+# --------------------------------------------------------------------------
+# Mask cancellation: the fold matrix (pure pool math + the wire frame)
+
+
+def test_mask_cancellation_across_worker_shard_matrix():
+    """Masked pooled sum bit-equal to the clear sum for every W x M fold
+    topology under seeded arrival permutations — the associativity
+    argument the whole protocol rests on, exercised through the same
+    accumulator/merge/wire-frame plumbing the live planes run."""
+    n = 4
+    srv, clients = _handshake(n)
+    roster = srv.stamp_roster(0, range(1, n + 1))
+    clear = _contributions(n, seed=3)
+    masked = [
+        (clients[r].mask([l.copy() for l in clear[r - 1][0]], 0, roster),
+         clear[r - 1][1])
+        for r in range(1, n + 1)]
+    ref = _fold_fixed(clear)
+    ref_mean, ref_count = finalize_partial_mean(
+        ref, [np.zeros(s, np.float32) for s in SHAPES])
+
+    rng = np.random.RandomState(7)
+    for workers in (1, 2, 4):
+        for m in (1, 2, 4):
+            order = rng.permutation(n)
+            slots = {}
+            for pos, k in enumerate(order):
+                key = (pos % m, (pos // m) % workers)
+                slots.setdefault(key, PartialAccumulator())
+                leaves, w = masked[k]
+                slots[key].add_fixed(FixedContribution(
+                    [np.ascontiguousarray(l, np.int64) for l in leaves],
+                    quantize_weight(w), 1, 0))
+            grand = PartialAccumulator()
+            for shard in range(m):
+                shard_total = PartialAccumulator()
+                for (s, _), acc in slots.items():
+                    if s == shard:
+                        acc.merge_into(shard_total)
+                # every shard→coordinator hop crosses the wire frame
+                decode_partial(encode_partial(shard_total)).merge_into(grand)
+            assert grand.wsum == ref.wsum and grand.count == ref.count
+            for a, b in zip(grand.leaves, ref.leaves):
+                np.testing.assert_array_equal(a, b)
+            assert grand.envelope_overflow() == 0
+            mean, count = finalize_partial_mean(
+                grand, [np.zeros(s, np.float32) for s in SHAPES])
+            assert count == ref_count
+            for a, b in zip(mean, ref_mean):
+                np.testing.assert_array_equal(a, b)
+
+
+def test_masked_frames_hide_the_clear_update_and_resend_bit_identical():
+    """The unit half of the only-the-sum pin: every masked frame differs
+    from every clear contribution; a resend (same round, same roster)
+    regenerates bit-identical masks; a new round gets a fresh stream;
+    the cached share row is duplicate-stable."""
+    n = 3
+    srv, clients = _handshake(n)
+    roster = srv.stamp_roster(0, range(1, n + 1))
+    clear = _contributions(n, seed=11)
+    masked = [clients[r].mask([l.copy() for l in clear[r - 1][0]], 0, roster)
+              for r in range(1, n + 1)]
+    for mk in masked:
+        for cl, _ in clear:
+            assert any(np.any(a != b) for a, b in zip(mk, cl))
+    again = [clients[r].mask([l.copy() for l in clear[r - 1][0]], 0, roster)
+             for r in range(1, n + 1)]
+    for a, b in zip(masked, again):
+        for la, lb in zip(a, b):
+            np.testing.assert_array_equal(la, lb)
+    next_round = clients[1].mask([l.copy() for l in clear[0][0]], 1, roster)
+    assert any(np.any(a != b) for a, b in zip(next_round, masked[0]))
+    # duplicate ROSTER → bit-identical SHARES reply (chaos idempotence)
+    body = srv.roster_payload(range(1, n + 1))
+    row1 = clients[2].build_shares(body["pks"], body["t"], body["universe"])
+    row2 = clients[2].build_shares(body["pks"], body["t"], body["universe"])
+    assert row1 == row2
+
+
+def test_expand_masks_deterministic_and_shaped():
+    a = expand_masks(mask_seed(1234, 0, 5), SHAPES)
+    b = expand_masks(mask_seed(1234, 0, 5), SHAPES)
+    assert [m.shape for m in a] == SHAPES
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    assert all(m.dtype == np.uint64 for m in a)
+    c = expand_masks(mask_seed(1234, 0, 6), SHAPES)
+    assert any(np.any(x != y) for x, y in zip(a, c))
+
+
+# --------------------------------------------------------------------------
+# Dropout recovery: the seed-reveal correction
+
+
+def test_dropout_correction_bit_equal_to_survivor_only_fold():
+    """One roster member drops after masking: >=t survivor shares
+    reconstruct its sk, the orphaned masks are subtracted, and the
+    corrected total is bit-equal to a fold that never had the victim —
+    weights, counts, mean and envelope included."""
+    n, victim = 4, 2
+    srv, clients = _handshake(n)
+    roster = srv.stamp_roster(0, range(1, n + 1))
+    clear = _contributions(n, seed=5)
+    arrived = [r for r in range(1, n + 1) if r != victim]
+    total = PartialAccumulator()
+    for r in arrived:
+        leaves = clients[r].mask([l.copy() for l in clear[r - 1][0]], 0,
+                                 roster)
+        total.add_fixed(FixedContribution(
+            [np.ascontiguousarray(l, np.int64) for l in leaves],
+            quantize_weight(clear[r - 1][1]), 1, 0))
+    assert srv.orphans(0, arrived) == [victim]
+    assert srv.unreconstructed(0, arrived) == [victim]
+    done = False
+    for h in arrived:
+        cipher = srv.reveal_request(victim, h)
+        assert cipher is not None
+        share = clients[h].reveal_share(victim, cipher)
+        done = srv.add_reveal_share(victim, h, share) or done
+        if done:
+            break
+    assert done and srv.revealed[victim] == clients[victim].sk
+    assert srv.unreconstructed(0, arrived) == []
+    corr = srv.correction(victim, 0, 0, arrived,
+                          [l.shape for l in total.leaves])
+    total.add_fixed(FixedContribution(corr, 0, 0))
+    ref = _fold_fixed([clear[r - 1] for r in arrived])
+    assert total.wsum == ref.wsum and total.count == ref.count
+    for a, b in zip(total.leaves, ref.leaves):
+        np.testing.assert_array_equal(a, b)
+    assert total.envelope_overflow() == 0
+    # privacy-over-availability: the revealed rank is out for the epoch
+    assert srv.compromised(victim) and not srv.can_participate(victim)
+    assert victim not in srv.stamp_roster(1, range(1, n + 1))
+
+
+def test_reveal_needs_exactly_t_shares_and_dedupes():
+    """Share accounting at the threshold: t-1 shares never reconstruct,
+    the t-th does, duplicates are idempotent by (target, holder), and a
+    late share for an already-revealed target is a no-op."""
+    n, victim = 5, 3
+    srv, clients = _handshake(n)  # t = n//2 + 1 = 3
+    srv.stamp_roster(0, range(1, n + 1))
+    assert srv.t == 3
+    holders = [r for r in range(1, n + 1) if r != victim]
+    shares = {h: clients[h].reveal_share(victim, srv.reveal_request(victim, h))
+              for h in holders}
+    assert not srv.add_reveal_share(victim, holders[0], shares[holders[0]])
+    # chaos duplicate of the same holder's share: still below threshold
+    assert not srv.add_reveal_share(victim, holders[0], shares[holders[0]])
+    assert srv.shares_held(victim) == 1
+    assert not srv.add_reveal_share(victim, holders[1], shares[holders[1]])
+    assert srv.add_reveal_share(victim, holders[2], shares[holders[2]])
+    assert srv.revealed[victim] == clients[victim].sk
+    assert not srv.add_reveal_share(victim, holders[3], shares[holders[3]])
+
+
+def test_shamir_reconstruction_at_t_and_failure_below_t():
+    """core/mpc hardening: any t-subset of SURVIVOR shares (the evicted
+    rank holds no share of itself in the reveal path) reconstructs the
+    secret exactly; t-1 shares reconstruct the WRONG value."""
+    n, victim = 5, 2
+    srv, clients = _handshake(n)
+    t = srv.t
+    universe = list(srv.universe)
+    slot = {r: s for s, r in enumerate(universe)}
+    holders = [r for r in range(1, n + 1) if r != victim]
+    plain = {h: clients[h].reveal_share(victim, srv.reveal_request(victim, h))
+             for h in holders}
+    sk = clients[victim].sk
+    import itertools
+    for subset in itertools.combinations(holders, t):
+        arr = np.asarray([[[plain[h]]] for h in subset], np.int64)
+        got = int(bgw_decode(arr, [slot[h] for h in subset],
+                             p=DEFAULT_PRIME, T=t - 1)[0, 0])
+        assert got == sk
+    short = holders[:t - 1]
+    arr = np.asarray([[[plain[h]]] for h in short], np.int64)
+    wrong = int(bgw_decode(arr, [slot[h] for h in short],
+                           p=DEFAULT_PRIME, T=t - 2)[0, 0])
+    assert wrong != sk
+
+
+def test_dh_symmetry_and_pair_key_agreement():
+    rng = np.random.RandomState(0)
+    for _ in range(8):
+        a = int(rng.randint(2, 2 ** 31))
+        b = int(rng.randint(2, 2 ** 31))
+        assert key_agreement(a, pk_gen(b)) == key_agreement(b, pk_gen(a))
+    _, clients = _handshake(3)
+    for i in clients:
+        for j in clients:
+            if i != j:
+                assert clients[i].pair_keys[j] == clients[j].pair_keys[i]
+
+
+def test_resolve_threshold_bounds():
+    assert resolve_threshold(4) == 3
+    assert resolve_threshold(5, 2) == 2
+    assert resolve_threshold(1) == 1
+    with pytest.raises(ValueError, match="secagg_t"):
+        resolve_threshold(4, 4)  # t == n can never reveal a dead rank
+    with pytest.raises(ValueError, match="secagg_t"):
+        resolve_threshold(1, 2)
+
+
+# --------------------------------------------------------------------------
+# Envelope headroom: counted, never clamped, carried on the wire
+
+
+def test_envelope_overflow_counted_through_partial_wire_frame():
+    acc = PartialAccumulator()
+    acc.add_fixed(FixedContribution([np.full((4,), 2 ** 55, np.int64)],
+                                    quantize_weight(1.0), 1, 0))
+    assert acc.saturated == 0
+    over = acc.envelope_overflow()
+    assert over == 4 and acc.saturated == 1
+    # leaves are NOT clamped — the audit observes, the values survive
+    np.testing.assert_array_equal(acc.leaves[0], np.full((4,), 2 ** 55))
+    # client-counted mask-domain clips roll into the same tally and ride
+    # the shardplane frame with the leaves
+    acc.add_fixed(FixedContribution([np.ones(4, np.int64)],
+                                    quantize_weight(1.0), 1, 3))
+    assert acc.saturated == 4
+    back = decode_partial(encode_partial(acc))
+    assert back.saturated == 4 and back.wsum == acc.wsum
+    np.testing.assert_array_equal(back.leaves[0], acc.leaves[0])
+
+
+# --------------------------------------------------------------------------
+# Refusals: every non-supporting driver and tier says no, loudly
+
+
+def test_cli_runners_reject_secagg():
+    from fedml_tpu.exp import parse_args, run
+    from fedml_tpu.exp.args import reject_secagg_flags
+    from fedml_tpu.exp.main_centralized import main as centralized_main
+    from fedml_tpu.exp.main_extra import main as extra_main
+
+    args = parse_args([
+        "--model", "lr", "--dataset", "synthetic_1_1",
+        "--client_num_in_total", "4", "--client_num_per_round", "4",
+        "--comm_round", "1", "--secagg"])
+    with pytest.raises(SystemExit, match="secagg"):
+        run(args, algorithm="FedAvg")
+    with pytest.raises(SystemExit, match="secagg"):
+        extra_main(["--algorithm", "VFL", "--secagg", "--comm_round", "1"])
+    with pytest.raises(SystemExit, match="secagg"):
+        centralized_main(["--model", "lr", "--dataset", "synthetic_1_1",
+                          "--comm_round", "1", "--secagg_t", "3"])
+    args.secagg = False
+    reject_secagg_flags(args, "anything")  # cleared flags pass silently
+
+
+def test_async_tiers_and_sim_modes_refuse_secagg():
+    from fedml_tpu.algos.fedasync import FedAsyncServerManager
+    from fedml_tpu.algos.fedbuff import FedBuffServerManager
+    from fedml_tpu.comm.loopback import LoopbackNetwork
+    from fedml_tpu.sim import FleetSimulator, FleetSpec, make_fleet_trace
+
+    class _A:
+        pass
+
+    cfg = FedConfig(client_num_in_total=2, client_num_per_round=2,
+                    comm_round=2, secagg=True)
+    for cls in (FedAsyncServerManager, FedBuffServerManager):
+        args = _A()
+        args.network = LoopbackNetwork(3)
+        with pytest.raises(ValueError, match="secagg"):
+            cls(args, {"w": np.zeros(2, np.float32)}, cfg, 3)
+    x, y = make_classification(64, n_features=4, n_classes=2, seed=0)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 2),
+                                 batch_size=16)
+    with pytest.raises(ValueError, match="secagg"):
+        FleetSimulator(LogisticRegression(num_classes=2), fed, None, cfg,
+                       make_fleet_trace(FleetSpec(n_devices=2, seed=0)),
+                       mode="fedbuff")
+
+
+def test_server_manager_guards_pool_firstk_and_aggregator():
+    from fedml_tpu.algos.fedavg_distributed import (FedAVGAggregator,
+                                                    FedAVGClientManager,
+                                                    FedAVGServerManager)
+    from fedml_tpu.comm.loopback import LoopbackNetwork
+    from fedml_tpu.comm.shardplane import ShardedFedAVGServerManager
+
+    net = {"w": np.zeros(4, np.float32)}
+
+    def mk_args():
+        class _A:
+            pass
+
+        a = _A()
+        a.network = LoopbackNetwork(5)
+        return a
+
+    # no fixed-point ingest path at all
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=2, secagg=True)
+    with pytest.raises(ValueError, match="ingest"):
+        FedAVGServerManager(mk_args(), FedAVGAggregator(net, 4, cfg), cfg, 5)
+    # first-k would orphan every straggler's masks — both planes refuse
+    cfgp = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                     comm_round=2, secagg=True, ingest_workers=1)
+    with pytest.raises(ValueError, match="aggregate_k"):
+        FedAVGServerManager(mk_args(), FedAVGAggregator(net, 4, cfgp), cfgp,
+                            5, aggregate_k=2)
+    with pytest.raises(ValueError, match="aggregate_k"):
+        ShardedFedAVGServerManager(mk_args(),
+                                   FedAVGAggregator(net, 4, cfgp), cfgp, 5,
+                                   1, aggregate_k=2)
+    # non-mean aggregators need the cohort in the clear
+    with pytest.raises(ValueError, match="MEAN"):
+        FedAVGServerManager(
+            mk_args(),
+            FedAVGAggregator(net, 4, cfg, aggregator="coord_median"), cfg, 5)
+    # the legacy float compressors cannot compose with the masked grid
+    x, y = make_classification(64, n_features=4, n_classes=2, seed=0)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 4),
+                                 batch_size=16)
+    with pytest.raises(ValueError, match="secagg"):
+        FedAVGClientManager(mk_args(), 1, 5, fed, lambda *a: None, cfgp,
+                            compress="topk0.25")
+
+
+def test_stale_epoch_seed_share_is_fenced():
+    """A seed share from a dead incarnation must never unlock a live
+    seed: it is counted as an epoch drop, flight-recorded as
+    seed_reveal_stale, and reconstructs nothing."""
+    from fedml_tpu.algos.fedavg_distributed import (
+        MSG_TYPE_C2S_SEED_SHARE, FedAVGAggregator, FedAVGServerManager)
+    from fedml_tpu.comm.loopback import LoopbackNetwork
+    from fedml_tpu.comm.message import Message
+
+    class _A:
+        pass
+
+    a = _A()
+    a.network = LoopbackNetwork(4)
+    cfg = FedConfig(client_num_in_total=3, client_num_per_round=3,
+                    comm_round=2, secagg=True, ingest_workers=1)
+    srv = FedAVGServerManager(
+        a, FedAVGAggregator({"w": np.zeros(4, np.float32)}, 3, cfg), cfg, 4)
+    msg = Message(MSG_TYPE_C2S_SEED_SHARE, 1, 0)
+    msg.add("epoch", srv.epoch + 7)
+    msg.add("round", 0)
+    msg.add("target", 2)
+    msg.add("share", 12345)
+    srv._handle_seed_share(msg)
+    assert srv.epoch_drops == 1 and srv.seed_reveals == 0
+    assert srv.secagg.shares_held(2) == 0
+    assert any(e["kind"] == "seed_reveal_stale"
+               for e in srv.flight.snapshot())
+
+
+# --------------------------------------------------------------------------
+# Live federations: loopback bit-equality under chaos, the reveal drill
+
+
+def _loopback_secagg(masked, chaos=None, trace_dir=None, workers=1):
+    from fedml_tpu.algos.fedavg_distributed import FedML_FedAvg_distributed
+    from fedml_tpu.data.batching import batch_global
+
+    x, y = make_classification(192, n_features=12, n_classes=3, seed=4)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 4),
+                                 batch_size=16)
+    test = batch_global(x[:48], y[:48], 16)
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=2, epochs=1, batch_size=16, lr=0.3,
+                    frequency_of_the_test=10 ** 6, secagg=masked,
+                    ingest_workers=workers)
+    return FedML_FedAvg_distributed(
+        LogisticRegression(num_classes=3), fed, test, cfg,
+        wire_codec="topk0.25+int8", loopback_wire="tensor", chaos=chaos,
+        idle_timeout_s=30.0, trace_dir=trace_dir)
+
+
+def test_masked_loopback_bit_equal_under_chaos_and_only_the_sum(monkeypatch):
+    """The acceptance pin, live: a masked federation under chaos
+    duplication lands the bit-identical net to the unmasked chaos-free
+    twin (duplicates never double-fold, resends are bit-identical by
+    frame_seed), and the server-side accumulator trajectory — every
+    int64 frame folded pre-cancellation — never contains any client's
+    clear fixed-point contribution."""
+    import jax
+    from fedml_tpu.comm.resilience import ChaosSpec
+
+    clear_folds, fixed_frames = [], []
+    orig_add = PartialAccumulator.add
+    orig_add_fixed = PartialAccumulator.add_fixed
+
+    def spy_add(self, leaves, weight, base=None):
+        clear_folds.append(([np.array(l, np.float32, copy=True)
+                             for l in leaves], float(weight),
+                            None if base is None else
+                            [np.array(b, np.float32, copy=True)
+                             for b in base]))
+        return orig_add(self, leaves, weight, base)
+
+    def spy_add_fixed(self, fixed):
+        if fixed.count:  # corrections (count=0) are server-side, not uploads
+            fixed_frames.append([np.array(l, np.int64, copy=True)
+                                 for l in fixed.leaves])
+        return orig_add_fixed(self, fixed)
+
+    monkeypatch.setattr(PartialAccumulator, "add", spy_add)
+    monkeypatch.setattr(PartialAccumulator, "add_fixed", spy_add_fixed)
+
+    plain = _loopback_secagg(False)
+    masked = _loopback_secagg(True, chaos=ChaosSpec(seed=13, dup_p=1.0))
+    for a, b in zip(jax.tree.leaves(plain.net), jax.tree.leaves(masked.net)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    h = masked.final_health
+    assert h.get("seed_reveals", 0) == 0 and h["codec_refusals"] == 0
+    # every upload folded exactly once despite the duplicate storm
+    assert len(fixed_frames) == 2 * 4
+    # no pre-cancellation frame ever equals any clear contribution: the
+    # clear twin's server folds plus the masked clients' own pre-mask
+    # quantization adds (same data, same seed, same codec → the exact
+    # int64 grid values that got masked)
+    assert len(clear_folds) == 2 * (2 * 4)
+    for leaves, w, base in list(clear_folds):
+        ref = PartialAccumulator()
+        orig_add(ref, leaves, w, base)  # spies still armed — go direct
+        for frame in fixed_frames:
+            assert any(np.any(a != b) for a, b in zip(frame, ref.leaves))
+
+
+def test_masked_dropout_reveal_drill(tmp_path):
+    """One roster client goes silent mid-round: the watchdog evicts it,
+    survivors answer the seed-reveal round, the orphaned masks are
+    corrected away and the run commits over survivors — flight-recorded
+    on disk, reveal latency histogrammed."""
+    from fedml_tpu.algos.fedavg_distributed import (FedAVGAggregator,
+                                                    FedAVGClientManager,
+                                                    FedAVGServerManager,
+                                                    build_federation_setup)
+    from fedml_tpu.comm.loopback import run_workers
+    from fedml_tpu.trainer.local import softmax_ce
+
+    x, y = make_classification(192, n_features=12, n_classes=3, seed=4)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 4),
+                                 batch_size=16)
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=2, epochs=1, batch_size=16, lr=0.3,
+                    frequency_of_the_test=10 ** 6, ingest_workers=1,
+                    heartbeat_interval_s=0.05, secagg=True)
+    size, net0, local_train, eval_fn, args = build_federation_setup(
+        LogisticRegression(num_classes=3), fed, None, cfg, "LOOPBACK",
+        softmax_ce)
+    srv = FedAVGServerManager(args, FedAVGAggregator(net0, size - 1, cfg),
+                              cfg, size, round_timeout_s=1.5,
+                              heartbeat_timeout_s=0.4,
+                              flight_dir=str(tmp_path))
+
+    def victim_train(*a, **kw):
+        if srv.round_idx >= 1:
+            time.sleep(3.5)  # outlast the 1.5s round deadline
+        return local_train(*a, **kw)
+
+    clients = [FedAVGClientManager(args, r, size, fed,
+                                   (victim_train if r == 1 else local_train),
+                                   cfg)
+               for r in range(1, size)]
+
+    def killer():
+        deadline = time.monotonic() + 20.0
+        while srv.round_idx < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        clients[0].finish()  # beats stop: the watchdog owns it now
+
+    run_workers([srv.run] + [c.run for c in clients] + [killer])
+    assert not srv.aborted and srv.round_idx == cfg.comm_round
+    assert srv.seed_reveals == 1 and srv.health()["evictions"] >= 1
+    assert srv.health()["seed_reveals"] == 1
+    snap = srv._h_reveal.snapshot()
+    assert snap["count"] == 1 and snap["max"] > 0
+    fr = [json.loads(l)
+          for l in open(os.path.join(str(tmp_path),
+                                     "flight_recorder.jsonl"))]
+    kinds = {e["kind"] for e in fr}
+    assert {"seed_reveal_request", "seed_reveal", "eviction",
+            "secagg_setup"} <= kinds
+    # the victim's seeds are known now: it can never rejoin this epoch
+    assert srv.secagg.compromised(1) and not srv.secagg.can_participate(1)
+
+
+def test_sim_fleet_secagg_bit_equal_and_deterministic():
+    """The seeded fleet drill on the deterministic SIM fabric: a
+    churn-free sync run with masking on is bit-equal to the unmasked
+    twin, and two masked runs replay event-for-event."""
+    import jax
+    from fedml_tpu.sim import FleetSimulator, FleetSpec, make_fleet_trace
+
+    def run(masked):
+        x, y = make_classification(120, n_features=8, n_classes=3, seed=1)
+        fed = build_federated_arrays(x, y, partition_homo(len(x), 3),
+                                     batch_size=16)
+        cfg = FedConfig(client_num_in_total=3, client_num_per_round=3,
+                        comm_round=2, epochs=1, batch_size=16, lr=0.3,
+                        frequency_of_the_test=10 ** 6,
+                        round_timeout_s=10 ** 6, ingest_workers=1,
+                        secagg=masked)
+        spec = FleetSpec(n_devices=3, seed=5, horizon_s=10 ** 7,
+                         mean_online=1.0, arrival_spread_s=0.0,
+                         base_round_s=25.0, slot_s=150.0)
+        sim = FleetSimulator(LogisticRegression(num_classes=3), fed, None,
+                             cfg, make_fleet_trace(spec), mode="sync",
+                             wire_codec="int8")
+        res = sim.run()
+        return res, sim.aggregator.net
+
+    r0, n0 = run(False)
+    r1, n1 = run(True)
+    assert r0.completed and r1.completed
+    for a, b in zip(jax.tree.leaves(n0), jax.tree.leaves(n1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    r2, n2 = run(True)
+    assert r2.virtual_s == r1.virtual_s
+    for a, b in zip(jax.tree.leaves(n1), jax.tree.leaves(n2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_masked_loopback_matrix_workers_and_shards():
+    """The full federation matrix: masked runs at ingest_workers in
+    {1, 2, 4} and agg_shards in {1, 2, 4} all land the bit-identical
+    net to the unmasked workers=1 baseline."""
+    import jax
+    from fedml_tpu.algos.fedavg_distributed import FedML_FedAvg_distributed
+    from fedml_tpu.data.batching import batch_global
+
+    x, y = make_classification(192, n_features=12, n_classes=3, seed=4)
+    fed = build_federated_arrays(x, y, partition_homo(len(x), 4),
+                                 batch_size=16)
+    test = batch_global(x[:48], y[:48], 16)
+
+    def run(masked, workers=1, shards=0):
+        cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                        comm_round=2, epochs=1, batch_size=16, lr=0.3,
+                        frequency_of_the_test=10 ** 6, secagg=masked,
+                        ingest_workers=(0 if shards else workers))
+        return FedML_FedAvg_distributed(
+            LogisticRegression(num_classes=3), fed, test, cfg,
+            wire_codec="topk0.25+int8", loopback_wire="tensor",
+            agg_shards=shards)
+
+    base = run(False)
+    for workers in (1, 2, 4):
+        agg = run(True, workers=workers)
+        for a, b in zip(jax.tree.leaves(base.net), jax.tree.leaves(agg.net)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for m in (1, 2, 4):
+        agg = run(True, shards=m)
+        assert agg.final_health["shards"] == m
+        for a, b in zip(jax.tree.leaves(base.net), jax.tree.leaves(agg.net)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
