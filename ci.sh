@@ -708,9 +708,74 @@ echo "== async FL (no-barrier staleness-weighted) =="
 python -m fedml_tpu.exp.main_extra --algorithm FedAsync \
     --model lr --dataset synthetic_1_1 $common
 
-echo "== buffered semi-sync FL (aggregate every k arrivals) =="
+echo "== buffered semi-sync FL (aggregate every k arrivals, controller on) =="
 python -m fedml_tpu.exp.main_extra --algorithm FedBuff --buffer_k 2 \
-    --model lr --dataset synthetic_1_1 $common
+    --controller adaptive --model lr --dataset synthetic_1_1 $common
+
+echo "== adaptive controller: spiked sim actuates; off-twin digest pinned =="
+python - <<'PYEOF'
+import hashlib, json, os, tempfile
+from fedml_tpu.algos.config import FedConfig
+from fedml_tpu.ctrl import (FederationController, StalenessAdmissionPolicy,
+                            WindowSchedulePolicy)
+from fedml_tpu.data.batching import batch_global, build_federated_arrays
+from fedml_tpu.data.partition import partition_homo
+from fedml_tpu.data.synthetic import make_classification
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.sim import FleetSimulator, FleetSpec, make_fleet_trace
+
+# Controller-off twin: the seeded fedbuff drill stays bit-identical to
+# the pre-controller tree (tests/test_ctrl.py pins all three modes; this
+# digest is the fedbuff one).
+x, y = make_classification(160, n_features=8, n_classes=2, seed=3)
+fed = build_federated_arrays(x, y, partition_homo(len(x), 4), batch_size=16)
+test = batch_global(x[:64], y[:64], 16)
+cfg = FedConfig(client_num_in_total=4, client_num_per_round=4, comm_round=12,
+                epochs=1, batch_size=16, lr=0.3, frequency_of_the_test=4)
+spec = FleetSpec(n_devices=4, seed=5, horizon_s=4000.0, mean_online=0.8,
+                 base_round_s=25.0, slot_s=150.0)
+res = FleetSimulator(LogisticRegression(num_classes=2), fed, test, cfg,
+                     make_fleet_trace(spec), mode="fedbuff",
+                     buffer_k=2).run()
+digest = hashlib.sha256(repr(
+    (res.arrival_log, res.staleness, res.updates, round(res.virtual_s, 3),
+     [round(t, 3) for t in res.completion_times])).encode()).hexdigest()
+GOLDEN = "e2b90d4c28ed5e1e0efd6ccf5c79088535fd77ef6781a46b1bbbdeadd8dd433b"
+assert digest == GOLDEN, f"controller-off drift: {digest}"
+
+# Forced load spike: the guard-band admission policy must actuate
+# through the seam, and the actuation must land in the on-disk flight
+# dump (the postmortem artifact an operator reads after a bad night).
+sx, sy = make_classification(320, n_features=10, n_classes=4, seed=1)
+sfed = build_federated_arrays(sx, sy, partition_homo(len(sx), 8),
+                              batch_size=16)
+stest = batch_global(sx[:96], sy[:96], 16)
+scfg = FedConfig(client_num_in_total=8, client_num_per_round=8,
+                 comm_round=12, epochs=1, batch_size=16, lr=0.3,
+                 frequency_of_the_test=4)
+sspec = FleetSpec(n_devices=8, seed=11, horizon_s=20000.0, mean_online=0.92,
+                  base_round_s=20.0, slot_s=400.0, arrival_spread_s=30.0,
+                  spike_t0=250.0, spike_t1=700.0, spike_factor=6.0)
+ctl = FederationController(
+    [WindowSchedulePolicy(w_min=1, w_max=4),
+     StalenessAdmissionPolicy(band_lo=2.0, band_hi=4.0, k_max=4,
+                              cap_slack=0, cooldown=2)], interval=1)
+with tempfile.TemporaryDirectory() as td:
+    sim = FleetSimulator(LogisticRegression(num_classes=4), sfed, stest,
+                         scfg, make_fleet_trace(sspec), mode="fedbuff",
+                         buffer_k=2, controller=ctl)
+    sim.server.flight.path = os.path.join(td, "flight_recorder.jsonl")
+    sim.run()
+    applied = [e for e in ctl.actuation_log if e["outcome"] == "applied"
+               and e["policy"] == "staleness_admission"]
+    assert applied, ctl.actuation_log
+    snap = sim.server.registry.snapshot()
+    assert snap.get("actuation_applied", 0) >= 1, snap
+    fr = [json.loads(l) for l in open(sim.server.flight.path)]
+    assert any(e["kind"] == "actuation" for e in fr), {e["kind"] for e in fr}
+print(f"controller smoke OK: off-twin digest pinned, spike drew "
+      f"{len(applied)} admission actuation(s), flight-recorded on disk")
+PYEOF
 
 echo "== message-passing framework templates =="
 python -m fedml_tpu.exp.main_extra --algorithm BaseFramework $common

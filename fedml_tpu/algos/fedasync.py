@@ -28,11 +28,12 @@ from the done-wait, and the run always ends.
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Set
+from typing import Deque, Dict, List, Optional, Set
 
 import jax
 import jax.numpy as jnp
@@ -118,6 +119,12 @@ class FedAsyncServerManager(ServerManager):
         self._spec = tree_spec(net)
         self._wire_decoders = wire_codec.CodecCache()  # spec → WireCodec
         self.staleness_history: List[int] = []
+        # Recent OFFERED staleness (admitted or not), bounded: the
+        # windowed guard-band signal for the adaptive controller. The
+        # registry histogram is cumulative — its p95 can neither recover
+        # after a load spike ends nor be read windowed, so a feedback
+        # loop keyed on it would latch its emergency posture forever.
+        self._stale_recent: Deque[int] = collections.deque(maxlen=64)
         # Accepted-upload order, (worker, base_version) per arrival — the
         # aggregation order the trace-determinism tests pin (sim/).
         self.arrival_log: List[tuple] = []
@@ -125,6 +132,13 @@ class FedAsyncServerManager(ServerManager):
         self.evictions = 0
         self.duplicate_drops = 0
         self.reassignments = 0
+        self.admission_drops = 0
+        # Admission cap (fedml_tpu.ctrl): an upload staler than this many
+        # versions is refused at the door (still replied — the worker gets
+        # a fresh assignment, never a silent drop). 0 = unlimited, the
+        # default — bit-equal to the pre-controller tier; the adaptive
+        # controller arms/relaxes it through the actuation seam.
+        self.max_staleness = 0
         # Stamped by the runners after the run (the sync tier's
         # convention): the final health() snapshot.
         self.final_health: Dict[str, int] = {}
@@ -225,6 +239,53 @@ class FedAsyncServerManager(ServerManager):
                 lambda a_, b_: ((1.0 - w) * a_.astype(jnp.float32)
                                 + w * b_.astype(jnp.float32)).astype(a_.dtype),
                 g, c))
+        # Actuation seam (fedml_tpu.ctrl): the validated, boundary-gated
+        # knob surface an attached controller tunes. Building it is inert
+        # — knobs only move when something calls apply(); with no
+        # controller attached the tier is bit-equal to a build without
+        # this subsystem. The mix weight ``w`` is a traced argument of
+        # the jitted _mix, so retuning alpha/staleness_exp costs no
+        # recompile. done_timeout_s is a knob only when the watchdog was
+        # armed at construction — the watchdog thread starts (or not) at
+        # run(), so arming it later would be a silent no-op.
+        from fedml_tpu.ctrl.actuator import ActuationSeam, Knob
+
+        knobs = [
+            Knob("alpha", lambda: self.alpha,
+                 lambda v: setattr(self, "alpha", v), 1e-6, 1.0),
+            Knob("staleness_exp", lambda: self.staleness_exp,
+                 lambda v: setattr(self, "staleness_exp", v), 0.0, 8.0),
+            Knob("max_staleness", lambda: self.max_staleness,
+                 lambda v: setattr(self, "max_staleness", v),
+                 0, 1_000_000, cast=int),
+        ]
+        if self.done_timeout_s and self.done_timeout_s > 0:
+            knobs.append(Knob(
+                "done_timeout_s", lambda: self.done_timeout_s,
+                self._set_done_timeout, 1e-3, 86400.0))
+        if self._pool is not None:
+            knobs.append(Knob(
+                "ingest_workers", lambda: self._pool.workers,
+                lambda v: self._pool.resize(v), 1, 64, cast=int,
+                constraint=lambda v: ("pool_shrink_unsupported"
+                                      if v < self._pool.workers else None)))
+        self.ctrl = ActuationSeam(
+            type(self).__name__, knobs, registry=self.registry,
+            flight=self.flight, busy=self._ctrl_busy,
+            progress=lambda: self.version)
+
+    def _set_done_timeout(self, v: float) -> None:
+        # The watchdog loop reads done_timeout_s live each pass; the
+        # heartbeat monitor's silence threshold must track it or an
+        # extended deadline would still evict on the old one.
+        self.done_timeout_s = v
+        self.heartbeat.timeout_s = v
+
+    def _ctrl_busy(self) -> Optional[str]:
+        """Seam busy probe: the pure-async tier is quiescent between
+        handler invocations, and actuations run on the dispatch thread —
+        never unsafe. The buffered subclass reports ``mid_flush``."""
+        return None
 
     @property
     def done_workers(self) -> int:
@@ -242,6 +303,7 @@ class FedAsyncServerManager(ServerManager):
                 "evictions": self.evictions,
                 "reassignments": self.reassignments,
                 "duplicate_drops": self.duplicate_drops,
+                "admission_drops": self.admission_drops,
                 "codec_refusals": self.codec_refusals,
                 "version": self.version,
                 "done_workers": len(self._done_set),
@@ -607,8 +669,28 @@ class FedAsyncServerManager(ServerManager):
                                     task_seq=task)
                 return
         staleness = self.version - base_ver
-        self.staleness_history.append(staleness)
+        # Offered staleness is recorded for EVERY arrival, admitted or
+        # not — the controller's guard band must see the load the fleet
+        # offers, not the load the cap lets through (a cap-filtered p95
+        # would collapse the moment the cap arms and thrash the loop).
         self._h_stale.record(staleness)
+        self._stale_recent.append(staleness)
+        cap = self.max_staleness
+        if cap and staleness > cap:
+            # Admission control (fedml_tpu.ctrl): staler than the armed
+            # cap — refuse at the door instead of paying decode+fold for
+            # an update whose discounted weight is noise. Reply
+            # discipline still holds: the worker gets a fresh assignment
+            # at the current version, never a silent drop.
+            self.admission_drops += 1
+            self.registry.counter("admission_drops").inc()
+            self.flight.record("admission_drop", sender=worker,
+                               staleness=staleness, cap=cap,
+                               version=self.version)
+            self.flight.dump()
+            self._send_assignment(worker)
+            return
+        self.staleness_history.append(staleness)
         self.arrival_log.append((worker, base_ver))
         v0 = self.version
         t0 = time.perf_counter()
@@ -628,6 +710,12 @@ class FedAsyncServerManager(ServerManager):
             self.test_history.append(
                 {"version": self.version, "staleness": staleness,
                  **{k: float(v) for k, v in m.items()}})
+        if self.version != v0:
+            # Safe actuation boundary: the version just committed (for
+            # the buffered subclass, the flush completed inside _ingest),
+            # telemetry and eval are current, and we are on the dispatch
+            # thread — knob mutations cannot race a fold.
+            self._ctrl_boundary()
         if self.version >= self.cfg.comm_round:
             self._send_done(worker)
             return
@@ -830,6 +918,7 @@ def FedML_FedAsync_distributed(
     metrics=None,
     trace_dir: Optional[str] = None,
     pretrained_params=None,
+    controller=None,
 ):
     """Run the async federation: ``cfg.comm_round`` server model updates
     (arrivals, not barrier rounds) across ``cfg.client_num_per_round``
@@ -850,6 +939,11 @@ def FedML_FedAsync_distributed(
                                    eval_fn=eval_fn, test_data=test_global,
                                    done_timeout_s=done_timeout_s,
                                    metrics=metrics, flight_dir=trace_dir)
+    if controller is not None:
+        # Adaptive control (fedml_tpu.ctrl): the same controller object
+        # that drove the fleet simulator drives this live run — it steps
+        # from the server's safe-boundary hook, owning no thread itself.
+        server.attach_controller(controller)
     clients = [
         FedAsyncClientManager(args, rank, size, train_fed, local_train, cfg,
                               backend=backend, wire_codec_spec=wire_codec,

@@ -444,6 +444,49 @@ class FedAVGServerManager(ServerManager):
         # against it, so reconstruction must use the same anchor.
         self._broadcast_net = aggregator.net
         del compress  # server decodes by each frame's self-described codec
+        # Actuation seam (fedml_tpu.ctrl): validated, boundary-gated knob
+        # setters an attached controller tunes between rounds. Building
+        # it is inert — with no controller and no external apply() the
+        # tier is bit-equal to a build without this subsystem.
+        # aggregate_k is read through _k_effective() at each completion
+        # check, so a between-rounds mutation moves only the NEXT
+        # round's window; the timeout knobs are read live by the
+        # watchdog loop, and are knobs only when the watchdog could be
+        # armed at run() (else retuning them would be a silent no-op).
+        from fedml_tpu.ctrl.actuator import ActuationSeam, Knob
+
+        knobs = [
+            Knob("aggregate_k", lambda: self.aggregate_k,
+                 lambda v: setattr(self, "aggregate_k", v),
+                 1, max(1, size - 1), cast=int),
+        ]
+        if self.round_timeout_s and self.round_timeout_s > 0:
+            knobs.append(Knob(
+                "round_timeout_s", lambda: self.round_timeout_s,
+                self._set_round_timeout, 1e-3, 86400.0))
+        if self.done_timeout_s and self.done_timeout_s > 0:
+            knobs.append(Knob(
+                "done_timeout_s", lambda: self.done_timeout_s,
+                lambda v: setattr(self, "done_timeout_s", v),
+                1e-3, 86400.0))
+        if self._pool is not None:
+            knobs.append(Knob(
+                "ingest_workers", lambda: self._pool.workers,
+                lambda v: self._pool.resize(v), 1, 64, cast=int,
+                constraint=lambda v: ("pool_shrink_unsupported"
+                                      if v < self._pool.workers else None)))
+        self.ctrl = ActuationSeam(
+            type(self).__name__, knobs, registry=self.registry,
+            flight=self.flight, progress=lambda: self.round_idx)
+
+    def _set_round_timeout(self, v: float) -> None:
+        # The watchdog reads round_timeout_s live each pass; the
+        # heartbeat silence threshold tracks it only when it defaulted
+        # to the round deadline at construction — an explicit
+        # heartbeat_timeout_s stays the operator's choice.
+        if self.heartbeat.timeout_s == self.round_timeout_s:
+            self.heartbeat.timeout_s = v
+        self.round_timeout_s = v
 
     # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:
@@ -1442,6 +1485,10 @@ class FedAVGServerManager(ServerManager):
         if self.secagg is not None:
             extra = self._secagg_commit_tail(arrived)
         self._log_round_health(completed, arrived)
+        # Safe actuation boundary: the round just committed and eval/
+        # telemetry are current; knob mutations here shape the NEXT
+        # round's window and deadlines, never a fold in flight.
+        self._ctrl_boundary()
         if self._ckpt is not None and self.cfg.checkpoint_every and (
             self.round_idx % self.cfg.checkpoint_every == 0
         ):
@@ -2004,6 +2051,7 @@ def FedML_FedAvg_distributed(
     pretrained_params=None,
     agg_shards: int = 0,
     directory=None,
+    controller=None,
 ):
     """Build server + ``client_num_per_round`` workers on the chosen backend
     and run the full federation (FedAvgAPI.py:20 analogue). Returns the
@@ -2075,6 +2123,11 @@ def FedML_FedAvg_distributed(
                                      aggregate_k=aggregate_k,
                                      checkpoint_dir=checkpoint_dir,
                                      metrics=metrics, flight_dir=trace_dir)
+    if controller is not None:
+        # Adaptive control (fedml_tpu.ctrl): steps from the server's
+        # between-rounds boundary; the same object may have been tuned
+        # in the fleet simulator first.
+        server.attach_controller(controller)
     clients = [
         FedAVGClientManager(args, rank, size, train_fed, local_train, cfg,
                             backend=backend, compress=compress,

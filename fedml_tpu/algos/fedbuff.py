@@ -71,6 +71,7 @@ from fedml_tpu.comm.loopback import run_workers
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.resilience import ChaosSpec
 from fedml_tpu.core.robust_agg import make_aggregator
+from fedml_tpu.ctrl.actuator import Knob
 from fedml_tpu.core.tree import tree_sub
 from fedml_tpu.data.batching import FederatedArrays
 from fedml_tpu.obs import trace as obs_trace
@@ -140,6 +141,16 @@ class FedBuffServerManager(FedAsyncServerManager):
                 "(comm/ingest.py)")
         self.nan_guard = nan_guard
         self.guard_drops = 0  # non-finite deltas weight-zeroed out
+        # Actuation discipline (fedml_tpu.ctrl): buffer_k is read once
+        # per arrival (_ingest), so mutating it BETWEEN flushes merely
+        # moves the next flush point — exact. Mutating it DURING a flush
+        # could re-enter the barrier; the seam's busy probe refuses any
+        # actuation while this bit is set.
+        self._in_flush = False
+        self.ctrl.add_knob(Knob(
+            "buffer_k", lambda: self.buffer_k,
+            lambda v: setattr(self, "buffer_k", v),
+            1, max(1, size - 1), cast=int))
         # Mean fast path: running discounted sum + weight, O(model).
         self._acc = None
         self._wsum = 0.0
@@ -169,6 +180,13 @@ class FedBuffServerManager(FedAsyncServerManager):
         h["buffer_depth"] = self._count
         h["guard_drops"] = self.guard_drops
         return h
+
+    def _ctrl_busy(self) -> Optional[str]:
+        # Seam busy probe: no knob may move while the flush barrier is
+        # draining/merging — a buffer_k change there could re-enter the
+        # flush, an alpha change would split one commit across two
+        # step sizes.
+        return "mid_flush" if self._in_flush else None
 
     def _defer_decode(self) -> bool:
         # With a pool, the buffered tier moves frame decode AND the
@@ -254,11 +272,15 @@ class FedBuffServerManager(FedAsyncServerManager):
         contract — the version still advances (the k arrivals were
         consumed)."""
         flushed = self._count
-        with obs_trace.active().span(
-                "round.commit", cat="round",
-                corr=obs_trace.corr(round=self.version),
-                buffered=flushed):
-            self._flush_buffer()
+        self._in_flush = True
+        try:
+            with obs_trace.active().span(
+                    "round.commit", cat="round",
+                    corr=obs_trace.corr(round=self.version),
+                    buffered=flushed):
+                self._flush_buffer()
+        finally:
+            self._in_flush = False
         # The ctrl/ row is emitted at the version bump, i.e. right AFTER
         # this flush reset the fill to 0 — report the depth the flush
         # CONSUMED (normally buffer_k), which is the meaningful
@@ -354,6 +376,7 @@ def FedML_FedBuff_distributed(
     metrics=None,
     trace_dir=None,
     pretrained_params=None,
+    controller=None,
 ):
     """Run the buffered federation: ``cfg.comm_round`` server
     AGGREGATIONS (each consuming ``buffer_k`` arrivals) across
@@ -372,6 +395,10 @@ def FedML_FedBuff_distributed(
         aggregator=aggregator, eval_fn=eval_fn, test_data=test_global,
         done_timeout_s=done_timeout_s, metrics=metrics,
         flight_dir=trace_dir)
+    if controller is not None:
+        # Same-object portability: the controller that tuned its policies
+        # in the fleet simulator drives this live run unchanged.
+        server.attach_controller(controller)
     clients = [
         FedBuffClientManager(args, rank, size, train_fed, local_train, cfg,
                              backend=backend, wire_codec_spec=wire_codec,

@@ -395,7 +395,10 @@ class IngestPool:
     def _worker(self, i: int) -> None:
         from fedml_tpu.obs import trace as obs_trace
 
-        partial = self.partials[i]
+        # Under the lock: resize() appends to self.partials concurrently
+        # (worker i's own slot always exists before its thread starts).
+        with self._lock:
+            partial = self.partials[i]
         while True:
             item = self._q.get()
             if item is self._STOP:
@@ -493,6 +496,36 @@ class IngestPool:
 
     def queue_depth(self) -> int:
         return self._q.qsize()
+
+    def resize(self, workers: int) -> None:
+        """Grow the pool to ``workers`` (the autoscaling actuation).
+
+        Growing is exact and safe mid-stream: a new worker gets its own
+        ``PartialAccumulator`` + stats slots and starts pulling from the
+        shared queue, and since the partial folds are associative-exact
+        the merged mean is bit-identical for any worker count. SHRINK is
+        refused — retiring a worker would strand its accumulated partial
+        (or force a mid-round merge off the dispatch thread), so the
+        actuation seam surfaces it as a named refusal instead."""
+        workers = int(workers)
+        if self._closed:
+            raise RuntimeError("ingest pool is closed")
+        if workers < self.workers:
+            raise ValueError(
+                f"ingest pool shrink unsupported ({self.workers} -> {workers}): "
+                "a retiring worker would strand its partial accumulator")
+        with self._lock:
+            start = self.workers
+            for i in range(start, workers):
+                self.partials.append(PartialAccumulator())
+                self._busy_s.append(0.0)
+                self._tasks.append(0)
+            self.workers = workers
+        for i in range(start, workers):
+            t = threading.Thread(target=self._worker, args=(i,), daemon=True,
+                                 name=f"ingest-pool-{i}")
+            self._threads.append(t)
+            t.start()
 
     def reset(self) -> None:
         """Drop all accumulated partials (callers drain first)."""

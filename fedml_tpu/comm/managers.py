@@ -163,3 +163,60 @@ class ServerManager(_Manager):
                 out["uploads"] = max(out["uploads"],
                                      reg.histogram("pool_task_ms").count)
         return out
+
+    # -- adaptive control (fedml_tpu.ctrl) -----------------------------------
+    def attach_controller(self, controller) -> None:
+        """Bind a ``FederationController`` to this manager's actuation
+        seam (``self.ctrl``, built by the subclass constructor). The
+        manager then invokes the controller from ``_ctrl_boundary()`` at
+        its safe boundaries; ``None`` detaches. The same controller
+        object may later be attached to a different manager — ``bind()``
+        resets policy state and the actuation log."""
+        if controller is not None:
+            if getattr(self, "ctrl", None) is None:
+                raise ValueError(
+                    f"{type(self).__name__} exposes no actuation seam; "
+                    "cannot attach a controller")
+            controller.bind()
+        self._controller = controller
+        self._ctrl_errors = 0
+
+    def _ctrl_boundary(self) -> None:
+        """Safe-boundary hook the subclass calls between rounds / after
+        buffer commits (on the dispatch thread, never mid-flush). Drains
+        externally queued actuations, then steps the attached controller.
+
+        Failure containment: a policy exception must not take down the
+        federation it is supposed to protect. Each exception is counted
+        (``actuation_policy_errors``) and flight-recorded; after three
+        consecutive failing steps the controller is detached
+        (``controller_detached`` flight event) and the managers run on
+        with their last-applied knob values — static behavior, not an
+        outage."""
+        seam = getattr(self, "ctrl", None)
+        if seam is not None:
+            seam.apply_pending()
+        controller = getattr(self, "_controller", None)
+        if controller is None:
+            return
+        try:
+            controller.step(self)
+        except Exception as e:  # noqa: BLE001 — containment boundary
+            self._ctrl_errors = getattr(self, "_ctrl_errors", 0) + 1
+            reg = getattr(self, "registry", None)
+            if reg is not None:
+                reg.counter("actuation_policy_errors").inc()
+            flight = getattr(self, "flight", None)
+            if flight is not None:
+                flight.record("policy_error", error=type(e).__name__,
+                              detail=str(e)[:200],
+                              consecutive=self._ctrl_errors)
+                flight.dump()
+            if self._ctrl_errors >= 3:
+                self._controller = None
+                if flight is not None:
+                    flight.record("controller_detached",
+                                  after_errors=self._ctrl_errors)
+                    flight.dump()
+        else:
+            self._ctrl_errors = 0

@@ -222,6 +222,8 @@ class AggregatorShardManager(ServerManager):
 
     # -- coordinator control ------------------------------------------------
     def _handle_anchor(self, msg: Message) -> None:
+        if self._stopped:
+            return  # killed mid-dispatch: the pool is closed; eviction owns us
         ep = msg.get("epoch")
         if ep is not None:
             ep = int(ep)
@@ -248,6 +250,8 @@ class AggregatorShardManager(ServerManager):
         self._anchor = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
 
     def _handle_flush(self, msg: Message) -> None:
+        if self._stopped:
+            return  # a FLUSH racing finish(): drain would park on dead workers
         ep = msg.get("epoch")
         if ep is not None and int(ep) != self.epoch:
             return
@@ -283,6 +287,9 @@ class AggregatorShardManager(ServerManager):
 
     # -- the partition's uploads --------------------------------------------
     def _handle_upload(self, msg: Message) -> None:
+        if self._stopped:
+            # fedlint: disable=P2(dead shard: finish() already ran, the heartbeat lapse evicts this rank and the coordinator re-routes the partition — no sender is waiting on a reply from a corpse, and a NOTICE here would race the closing com manager)
+            return
         sender = msg.get_sender_id()
         ep = msg.get("epoch")
         if ep is not None and int(ep) != self.epoch:
@@ -302,14 +309,21 @@ class AggregatorShardManager(ServerManager):
             # orphan the contribution. The coordinator owns catch-up.
             self._notify("stale", sender, t)
             return
-        self._submit_upload(sender, t, msg)
+        if not self._submit_upload(sender, t, msg):
+            return  # finish() closed the pool under us — see _submit_upload
         self.accepted += 1
         self._notify("accept", sender, t)
 
-    def _submit_upload(self, sender: int, t: int, msg: Message) -> None:
+    def _submit_upload(self, sender: int, t: int, msg: Message) -> bool:
         """Decode + fold on the shard's pool — the same task shape as the
         single server's ``_submit_ingest`` (closure snapshots the round's
-        anchor so a late task cannot reconstruct against the next one)."""
+        anchor so a late task cannot reconstruct against the next one).
+
+        Returns False when the shard was killed while this upload was in
+        flight: ``finish()`` (another thread — the coordinator's kill or a
+        drill's killer) closes the pool between the handler's ``_stopped``
+        check and the submit. The upload is dropped, not an error — the
+        coordinator's heartbeat eviction re-routes the partition."""
         payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         codec = msg.get("compression")
         wcodec = msg.get(wire_codec.CODEC_KEY)
@@ -359,7 +373,13 @@ class AggregatorShardManager(ServerManager):
                     weight,
                     [np.asarray(a) for a in jax.tree.leaves(anchor)])
 
-        self._pool.submit(task, **ck)
+        try:
+            self._pool.submit(task, **ck)
+        except RuntimeError:
+            if self._stopped:
+                return False
+            raise
+        return True
 
     def _decoder_for(self, codec: str):
         """Get-or-create the per-codec decoder under the lock. The

@@ -1,0 +1,194 @@
+"""Actuation seam: validated, boundary-gated knob setters on the server
+managers.
+
+Every robustness lever the control plane exposes — ``aggregate_k``,
+``buffer_k``, ``round_timeout_s``, the staleness discount, the admission
+cap, the ingest-pool width — is an instance attribute some hot path
+reads live (``_k_effective()`` per round, ``self.buffer_k`` per arrival,
+the watchdog per poll). A controller may therefore retune them at
+runtime, but only under two disciplines this module enforces:
+
+- **Range validation.** Each knob carries structural bounds (the same
+  ones the constructors enforce); an out-of-range request REFUSES with a
+  named reason instead of clamping silently — a policy that asks for
+  ``buffer_k=0`` is a buggy policy, and clamping would hide it.
+- **Safe boundaries.** Mutations land only where the protocol is
+  quiescent for that knob: between barrier rounds (sync), at a buffer
+  flush (fedbuff), never mid-flush — the manager passes a ``busy``
+  probe, and an unsafe-time :meth:`ActuationSeam.apply` refuses (the
+  caller can :meth:`ActuationSeam.request` instead, which queues the
+  mutation for the manager's next ``apply_pending`` at a boundary).
+
+Every outcome is observable post-mortem: applied mutations flight-record
+an ``actuation`` event and bump the ``actuation_applied`` counter on the
+manager's registry (the per-round ``ctrl/`` metrics stream); refusals
+record ``actuation_refused`` with the named reason. A misbehaving policy
+is therefore diagnosable from the same flight-recorder ring evictions
+already land in (docs/ROBUSTNESS.md "Adaptive control").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class ActuationRefused(ValueError):
+    """A knob mutation was refused; ``reason`` is the machine-readable
+    refusal class (``unknown_knob`` / ``out_of_range`` / ``unsafe_now`` /
+    a knob-specific constraint name)."""
+
+    def __init__(self, knob: str, value, reason: str, detail: str = ""):
+        self.knob = knob
+        self.value = value
+        self.reason = reason
+        super().__init__(
+            f"actuation refused: {knob}={value!r} ({reason})"
+            + (f": {detail}" if detail else ""))
+
+
+class Knob:
+    """One tunable: structural bounds + live get/set closures.
+
+    ``lo``/``hi`` are inclusive; ``cast`` coerces the requested value
+    (``int`` for count knobs — a fractional ``buffer_k`` refuses via the
+    cast mismatch check, not a silent truncation). ``constraint`` may
+    veto values the static range admits (e.g. pool shrink): it returns a
+    named reason string to refuse, or ``None`` to allow."""
+
+    def __init__(self, name: str, get: Callable[[], float],
+                 set_: Callable[[float], None], lo: float, hi: float,
+                 cast=float,
+                 constraint: Optional[Callable[[float], Optional[str]]] = None):
+        self.name = name
+        self.get = get
+        self.set = set_
+        self.lo = lo
+        self.hi = hi
+        self.cast = cast
+        self.constraint = constraint
+
+    def validate(self, value) -> Tuple[Optional[float], str]:
+        """``(coerced_value, "")`` when admissible, ``(None, reason)``
+        when not."""
+        try:
+            v = self.cast(value)
+        except (TypeError, ValueError):
+            return None, "uncastable"
+        if self.cast is int and float(v) != float(value):
+            return None, "not_integral"
+        if not self.lo <= v <= self.hi:
+            return None, f"out_of_range[{self.lo},{self.hi}]"
+        if self.constraint is not None:
+            veto = self.constraint(v)
+            if veto:
+                return None, veto
+        return v, ""
+
+
+class ActuationSeam:
+    """The per-manager knob surface a controller actuates through.
+
+    Built by the server manager's constructor with its own registry,
+    flight recorder, and ``busy`` probe; the manager calls
+    :meth:`apply_pending` at each safe boundary. ``request`` is
+    thread-safe (any thread may queue); ``apply`` executes on the
+    caller's thread and refuses when the ``busy`` probe names a reason
+    (e.g. ``mid_flush``) — boundary callers (the controller step, which
+    runs inside the manager's own boundary hook) apply directly."""
+
+    def __init__(self, owner: str, knobs: List[Knob], *, registry,
+                 flight=None, busy: Optional[Callable[[], Optional[str]]] = None,
+                 progress: Optional[Callable[[], int]] = None):
+        self.owner = owner
+        self._knobs: Dict[str, Knob] = {k.name: k for k in knobs}
+        self._registry = registry
+        self._flight = flight
+        self._busy = busy
+        self._progress = progress or (lambda: -1)
+        self._lock = threading.Lock()
+        self._pending: Dict[str, Tuple[float, str]] = {}
+        self._c_applied = registry.counter("actuation_applied")
+        self._c_refused = registry.counter("actuation_refused")
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def names(self):
+        return tuple(sorted(self._knobs))
+
+    def get(self, knob: str) -> float:
+        k = self._knobs.get(knob)
+        if k is None:
+            raise KeyError(f"{self.owner} has no knob {knob!r}; "
+                           f"known: {self.names}")
+        return k.get()
+
+    def values(self) -> Dict[str, float]:
+        return {name: k.get() for name, k in sorted(self._knobs.items())}
+
+    def add_knob(self, knob: Knob) -> None:
+        """Subclass constructors extend the parent's seam (fedbuff adds
+        ``buffer_k`` to the async tier's knob set)."""
+        self._knobs[knob.name] = knob
+
+    # -- mutation ------------------------------------------------------------
+    def _refuse(self, knob: str, value, reason: str) -> ActuationRefused:
+        self._c_refused.inc()
+        if self._flight is not None:
+            self._flight.record("actuation_refused", knob=knob,
+                                value=value, reason=reason,
+                                progress=self._progress())
+            self._flight.dump()
+        return ActuationRefused(knob, value, reason)
+
+    def apply(self, knob: str, value, *, reason: str = "manual") -> float:
+        """Validate and set ``knob`` now. Returns the applied value;
+        raises :class:`ActuationRefused` (after counting and
+        flight-recording the refusal) on an unknown knob, an out-of-range
+        or vetoed value, or an unsafe call time."""
+        k = self._knobs.get(knob)
+        if k is None:
+            raise self._refuse(knob, value, "unknown_knob")
+        busy = self._busy() if self._busy is not None else None
+        if busy:
+            raise self._refuse(knob, value, busy)
+        v, veto = k.validate(value)
+        if v is None:
+            raise self._refuse(knob, value, veto)
+        old = k.get()
+        if v == old:
+            return old  # no-op: nothing recorded, nothing counted
+        k.set(v)
+        self._c_applied.inc()
+        if self._flight is not None:
+            self._flight.record("actuation", knob=knob, old=old, new=v,
+                                reason=reason, progress=self._progress())
+            self._flight.dump()
+        return v
+
+    def request(self, knob: str, value, *, reason: str = "manual") -> None:
+        """Queue a mutation for the manager's next safe boundary
+        (``apply_pending``). Unknown knobs refuse immediately — the
+        caller's mistake should not surface rounds later; range and veto
+        checks run at apply time against then-current state."""
+        if knob not in self._knobs:
+            raise self._refuse(knob, value, "unknown_knob")
+        with self._lock:
+            self._pending[knob] = (value, reason)
+
+    def apply_pending(self) -> int:
+        """Drain the request queue at a safe boundary (called by the
+        manager). Refusals are counted and recorded but do not raise —
+        one bad queued request must not unwind the manager's round
+        commit. Returns the number of applied mutations."""
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        applied = 0
+        for knob in sorted(pending):
+            value, reason = pending[knob]
+            try:
+                self.apply(knob, value, reason=reason)
+                applied += 1
+            except ActuationRefused:
+                pass  # counted + flight-recorded by apply()
+        return applied

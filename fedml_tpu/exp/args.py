@@ -281,6 +281,28 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="smoke traffic: issue this many in-process serve "
                         "requests DURING training and report latency "
                         "percentiles in the output (0 = none)")
+    # Adaptive federation control (fedml_tpu.ctrl; docs/ROBUSTNESS.md
+    # "Adaptive control"). Only main_extra's FedAsync/FedBuff runners
+    # attach a controller — every other driver refuses these loudly
+    # (reject_controller_flags).
+    p.add_argument("--controller", type=str, default="none",
+                   choices=["none", "adaptive"],
+                   help="telemetry-driven federation controller: retunes "
+                        "the server's knobs (buffer_k, admission cap, "
+                        "timeouts) at safe boundaries from live staleness/"
+                        "eviction/accuracy telemetry; 'none' leaves every "
+                        "knob static")
+    p.add_argument("--controller_interval", type=int, default=1,
+                   help="control-step cadence in protocol progress units "
+                        "(model versions / rounds) between controller "
+                        "steps")
+    p.add_argument("--controller_band_lo", type=float, default=2.0,
+                   help="staleness-p95 guard band floor: below it the "
+                        "admission policy relaxes back toward baseline")
+    p.add_argument("--controller_band_hi", type=float, default=6.0,
+                   help="staleness-p95 guard band ceiling: above it the "
+                        "admission policy backs buffer_k off and arms the "
+                        "staleness admission cap")
     return p
 
 
@@ -396,6 +418,31 @@ def reject_serve_flags(args, algorithm: str) -> None:
             "multi-tenant adapter serving plane rides main_extra's "
             "FedBuff runner only (fedml_tpu.serve) — the flag would be "
             "silently inert here")
+
+
+def reject_controller_flags(args, algorithm: str) -> None:
+    """Refuse the adaptive-controller knobs for drivers with no actuation
+    seam to attach a controller to (the PR 4 flag-rejection convention):
+    only main_extra's FedAsync/FedBuff runners wire
+    ``controller_from_args`` through to the server manager. A churn run
+    whose ``--controller adaptive`` silently did nothing would report
+    static behavior as the self-tuning arm — the flag must refuse, not
+    no-op."""
+    bad = []
+    if getattr(args, "controller", "none") != "none":
+        bad.append(f"--controller {args.controller}")
+    if getattr(args, "controller_interval", 1) != 1:
+        bad.append(f"--controller_interval {args.controller_interval}")
+    if getattr(args, "controller_band_lo", 2.0) != 2.0:
+        bad.append(f"--controller_band_lo {args.controller_band_lo}")
+    if getattr(args, "controller_band_hi", 6.0) != 6.0:
+        bad.append(f"--controller_band_hi {args.controller_band_hi}")
+    if bad:
+        raise SystemExit(
+            f"{algorithm} does not support {', '.join(bad)}: the adaptive "
+            "federation controller (fedml_tpu.ctrl) attaches to the "
+            "FedAsync/FedBuff server managers in main_extra only — the "
+            "flag would be silently inert here")
 
 
 def reject_ingest_pool_flag(args, algorithm: str) -> None:

@@ -29,6 +29,7 @@ def run_centralized(args):
                                     reject_adapter_flags,
                                     reject_agg_shards_flag,
                                     reject_async_tier_flags,
+                                    reject_controller_flags,
                                     reject_fedavg_family_flags,
                                     reject_ingest_pool_flag,
                                     reject_pod_plane_flags,
@@ -58,6 +59,9 @@ def run_centralized(args):
     reject_secagg_flags(args, "the centralized baseline")
     # ...and no serving plane: serving rides main_extra's FedBuff runner.
     reject_serve_flags(args, "the centralized baseline")
+    # ...and no server manager for a controller to actuate: the pooled
+    # loop has no knobs, no telemetry stream, no safe boundaries.
+    reject_controller_flags(args, "the centralized baseline")
     from fedml_tpu.exp.setup import (
         build_mesh,
         create_model_for,

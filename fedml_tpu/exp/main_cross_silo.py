@@ -110,6 +110,7 @@ def main(argv=None):
     from fedml_tpu.exp.args import (reject_adapter_flags,
                                     reject_agg_shards_flag,
                                     reject_async_tier_flags,
+                                    reject_controller_flags,
                                     reject_fedavg_family_flags,
                                     reject_pod_plane_flags,
                                     reject_serve_flags)
@@ -137,6 +138,11 @@ def main(argv=None):
     # No serving plane on the rank-per-process CLI either — serving
     # rides main_extra's FedBuff runner (fedml_tpu.serve).
     reject_serve_flags(args, "the cross-silo pipeline")
+    # The adaptive controller is wired through main_extra's
+    # FedAsync/FedBuff runners only; until a cross-silo deployment
+    # threads controller_from_args through to its rank-0 manager the
+    # flag would be silently inert here (fedml_tpu.ctrl).
+    reject_controller_flags(args, "the cross-silo pipeline")
 
     logging.basicConfig(
         level=logging.INFO,

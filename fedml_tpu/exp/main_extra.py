@@ -21,6 +21,7 @@ from fedml_tpu.exp.args import (add_args, config_from_args,
                                 reject_adapter_flags,
                                 reject_agg_shards_flag,
                                 reject_async_tier_flags,
+                                reject_controller_flags,
                                 reject_fedavg_family_flags,
                                 reject_ingest_pool_flag,
                                 reject_pod_plane_flags,
@@ -177,11 +178,14 @@ def run_fedasync(args):
     fed, arrays, test, cfg = _setup(args)
     model = create_model_for(args, fed)
     obs_kw, metrics = _async_obs_kwargs(args)
+    from fedml_tpu.ctrl import controller_from_args
+
     try:
         srv = FedML_FedAsync_distributed(
             model, arrays, test, cfg,
             alpha=(0.6 if args.fedasync_alpha < 0 else args.fedasync_alpha),
             staleness_exp=args.staleness_exp, wire_codec=args.wire_codec,
+            controller=controller_from_args(args),
             **_async_loss_kwargs(args), **obs_kw)
     finally:
         if metrics is not None:
@@ -209,6 +213,8 @@ def run_fedbuff(args):
                                     seed=cfg.seed)
         corrupt_ranks = tuple(range(1, 1 + args.attack_num_adversaries))
     obs_kw, metrics = _async_obs_kwargs(args)
+    from fedml_tpu.ctrl import controller_from_args
+
     try:
         srv = FedML_FedBuff_distributed(
             model, arrays, test, cfg,
@@ -216,6 +222,7 @@ def run_fedbuff(args):
             staleness_exp=args.staleness_exp, buffer_k=args.buffer_k,
             aggregator=args.aggregator, wire_codec=args.wire_codec,
             corrupt_ranks=corrupt_ranks, corruptor=corruptor,
+            controller=controller_from_args(args),
             **_async_loss_kwargs(args), **obs_kw)
     finally:
         if metrics is not None:
@@ -362,6 +369,11 @@ def main(argv=None):
         # The parallel ingest pool likewise rides only the message-
         # passing server tiers (FedAsync/FedBuff here; cross-silo CLI).
         reject_ingest_pool_flag(args, args.algorithm)
+        # ...as does the adaptive controller (fedml_tpu.ctrl): only the
+        # FedAsync/FedBuff runners thread controller_from_args through
+        # to the server's actuation seam — anywhere else the flags
+        # would label a static run self-tuning.
+        reject_controller_flags(args, args.algorithm)
     # The sharded aggregation plane is a synchronous-FedAvg capability
     # (comm/shardplane.py): FedAsync/FedBuff refuse cfg.agg_shards in
     # their server constructors (the sequential mix / global-arrival

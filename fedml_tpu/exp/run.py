@@ -138,6 +138,7 @@ def run(args, algorithm: str = "FedAvg"):
     from fedml_tpu.exp.args import (reject_adapter_flags,
                                     reject_agg_shards_flag,
                                     reject_async_tier_flags,
+                                    reject_controller_flags,
                                     reject_ingest_pool_flag,
                                     reject_secagg_flags,
                                     reject_serve_flags)
@@ -145,6 +146,10 @@ def run(args, algorithm: str = "FedAvg"):
     reject_async_tier_flags(args, algorithm)
     reject_ingest_pool_flag(args, algorithm)
     reject_agg_shards_flag(args, algorithm)
+    # The adaptive controller actuates a message-passing server manager's
+    # knob seam between rounds — the jitted simulator round has no
+    # manager, no seam, and no safe boundary to step from.
+    reject_controller_flags(args, algorithm)
     # Secure aggregation rides the message-passing tier's fixed-point
     # ingest pool — the jitted simulator round materializes every client
     # update in the clear by construction, so the flag must refuse.

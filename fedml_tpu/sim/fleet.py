@@ -221,7 +221,7 @@ class FleetSimulator:
                  staleness_exp: float = 0.5, buffer_k: int = 2,
                  aggregator="mean", corrupt_ranks=(), corruptor=None,
                  wire_codec: str = "none", sim_wire: str = "none",
-                 directory=None, agg_shards: int = 0):
+                 directory=None, agg_shards: int = 0, controller=None):
         if mode not in MODES:
             raise ValueError(f"unknown sim mode {mode!r}; known {MODES}")
         if agg_shards and mode != "sync":
@@ -279,6 +279,11 @@ class FleetSimulator:
                 self._task_idx[rank] += 1
                 dt = self.trace.compute_time(self._dev(rank),
                                              self._task_idx[rank])
+                # Load spike (FleetSpec.spike_*): rounds starting inside
+                # the spike window run spike_factor x slower. The
+                # default factor is exactly 1.0, a bit-exact float
+                # multiply — spike-free traces are unchanged.
+                dt *= self.trace.load_factor(self.clock.now)
                 cm = self._client_by_rank.get(rank)
                 task = getattr(cm, "_last_task", -1) if cm is not None else -1
                 # Charge the compute at TRAINING time as a completion
@@ -360,6 +365,12 @@ class FleetSimulator:
                                      corruptor=(corruptor if r in corrupt
                                                 else None))
                 for r in range(1, size)]
+        if controller is not None:
+            # Adaptive control (fedml_tpu.ctrl): the server is a REAL
+            # manager over the SIM backend, so the identical controller
+            # object steps from the identical safe-boundary hook it uses
+            # in a live run — offline policy development is the point.
+            self.server.attach_controller(controller)
         self._client_by_rank = {c.rank: c for c in self.clients}
         self._watch_round = -1
         self._watch_t0 = 0.0

@@ -66,6 +66,15 @@ class FleetSpec:
     max_speed_mult: float = 20.0     # clamp the Pareto tail
     compute_jitter: float = 0.1      # lognormal sigma per (device, task)
     wire_latency_s: float = 0.5      # one-way control/model hop
+    # Load spike: every local round whose training STARTS inside
+    # [spike_t0, spike_t1) takes spike_factor x as long — a fleet-wide
+    # thermal/contention event, the staleness-cliff stimulus the
+    # adaptive controller (fedml_tpu.ctrl) is drilled against. The
+    # defaults are exact no-ops (x1.0 is bit-exact in float), so every
+    # pre-spike trace digest is unchanged.
+    spike_t0: float = -1.0
+    spike_t1: float = -1.0
+    spike_factor: float = 1.0
 
 
 class FleetTrace:
@@ -146,6 +155,15 @@ class FleetTrace:
                 _rng(spec.seed, _S_COMPUTE, rank, task_idx).randn()
                 * spec.compute_jitter))
         return spec.base_round_s * self.speeds[rank] * jitter
+
+    def load_factor(self, t: float) -> float:
+        """Compute-time multiplier at virtual time ``t`` (the load-spike
+        window, 1.0 outside it). Deterministic in (spec, t) — part of
+        the trace identity, like every other schedule here."""
+        spec = self.spec
+        if spec.spike_t0 <= t < spec.spike_t1:
+            return spec.spike_factor
+        return 1.0
 
     def online_fraction(self, rank: int) -> float:
         total = sum(e - s for s, e in self.windows.get(rank, ()))

@@ -89,7 +89,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
                  "bench_ingest_profile",
                  "bench_serving_1m", "bench_agg_shards",
                  "bench_secagg",
-                 "bench_fleet_sim",
+                 "bench_fleet_sim", "bench_adaptive_control",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
                  "bench_serving_10m",
                  "bench_vit",
@@ -118,7 +118,7 @@ def test_main_budget_refit_headline_always_prints(monkeypatch, tmp_path,
     # Every section that RAN finished inside the budget: elapsed at its
     # start + the full section cap fit under 300s.
     assert len(ran) * 50 + 100 <= 300
-    assert len(ran) + len(skipped) == 25
+    assert len(ran) + len(skipped) == 26
 
 
 def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
@@ -135,7 +135,7 @@ def test_main_primary_timeout_is_an_honest_hole(monkeypatch, tmp_path,
                  "bench_ingest_profile",
                  "bench_serving_1m", "bench_agg_shards",
                  "bench_secagg",
-                 "bench_fleet_sim",
+                 "bench_fleet_sim", "bench_adaptive_control",
                  "bench_stackoverflow_342k", "bench_synthetic_1m",
                  "bench_serving_10m",
                  "bench_vit",
@@ -233,6 +233,24 @@ def test_bench_pod_reduce_machinery_toy_scale():
     assert out["grouped_vs_flat_rps"] > 0
 
 
+@pytest.mark.slow  # two spiked fleet-drill arms on the 2-core box (~10s)
+def test_bench_adaptive_control_machinery_toy_scale():
+    """The r20 adaptive-control section's machinery at toy scale: one
+    static arm + the controller arm on the seeded spike trace, gain and
+    staleness-ratio scalars populated, the decision trail in the blob —
+    the real section runs the comm_round=24 two-static default (whose
+    gain > 1 claim tests/test_ctrl.py pins on the full drill)."""
+    out = bench.bench_adaptive_control(comm_round=12, static_ks=(2,))
+    assert out["spike"]["factor"] == 6.0
+    assert out["static_k2"]["acc_per_vmin"] > 0
+    assert out["controller"]["acc_per_vmin"] > 0
+    assert out["controller"]["actuations_applied"] >= 1
+    assert out["controller"]["actuation_log"]  # the reproducibility trail
+    assert out["controller"]["final_knobs"]["buffer_k"] >= 1
+    assert out["adaptive_ctrl_gain"] is not None
+    assert out["ctrl_vs_best_static_stale_p95"] is not None
+
+
 def test_headline_tolerates_budget_skipped_submetrics():
     """Sections the wall-clock budget skips land as {"skipped": ...} in
     the blob; the headline must still build, carry None scalars for
@@ -258,11 +276,15 @@ def test_headline_tolerates_budget_skipped_submetrics():
     # story; the full blob keeps both).
     assert "fedopt_windowed_rps" not in h["sub"]
     assert "fedopt_windowed_speedup" not in h["sub"]
-    # The r14 pod-plane scalars ride (None when skipped); bf16_acc_delta
-    # rotated out in r16 to fund the sharded-plane scalars.
-    assert h["sub"]["pod_dcn_bytes_ratio"] is None
+    # The r14 pod-plane scalars: pod_dcn_bytes_ratio rotated out in r20
+    # (structural 4.0 since r14; the blob keeps it) to fund
+    # adaptive_ctrl_gain; bf16_acc_delta rotated out in r16 to fund the
+    # sharded-plane scalars.
+    assert "pod_dcn_bytes_ratio" not in h["sub"]
     assert h["sub"]["bf16_step_speedup"] is None
     assert "bf16_acc_delta" not in h["sub"]
+    # The r20 adaptive-control scalar rides (None when skipped).
+    assert h["sub"]["adaptive_ctrl_gain"] is None
     assert "robust_agg_overhead" not in h["sub"]  # rotated out in r14
     # The r16 sharded-aggregation-plane scalar rides (None when skipped).
     assert h["sub"]["agg_shard_speedup_4v1"] is None

@@ -359,6 +359,7 @@ def test_group_reduce_refuses_non_composable_loudly():
                                group_reduce=True)
 
 
+@pytest.mark.slow  # >5.4 s drill; tier-1 re-fit to the 870 s budget on the 2-core box (r20 audit)
 def test_cfg_group_reduce_wiring_and_guards():
     x, y, parts = _equal_counts(n_clients=16, per=32)
     fed = build_federated_arrays(x, y, parts, batch_size=16)

@@ -469,3 +469,117 @@ def test_fleet_churn_serving_drill():
     assert bp < ap
     # Accuracy: buffered lands in the clean ballpark.
     assert buffered.final_accuracy >= clean.final_accuracy - 0.1
+
+
+# --------------------------------------------------------------------------
+# Watchdog-twin lockstep (the sim/fleet.py drift risk called out in
+# _schedule_watchdog's CAUTION note): the event-driven twin's eviction
+# decision must match what the REAL detector code would decide on the
+# same server state at the same virtual instant — same round, same rank
+# set. The twin re-states the thread loops' predicates rather than
+# sharing code with them; these tests are the tripwire a policy change
+# in either copy hits.
+
+
+def _lockstep_sim(mode, **kw):
+    fed, test = _tiny_problem()
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=3, epochs=1, batch_size=16, lr=0.3)
+    spec = FleetSpec(n_devices=4, seed=5, horizon_s=4000.0, mean_online=0.8,
+                     base_round_s=25.0, slot_s=150.0)
+    from fedml_tpu.models.lr import LogisticRegression as LR
+
+    sim = FleetSimulator(LR(num_classes=4), fed, test, cfg,
+                         make_fleet_trace(spec), mode=mode, **kw)
+    posts = []
+    if mode == "sync":
+        sim.server._post_tick = (
+            lambda r, failed: posts.append((r, tuple(failed))))
+    else:
+        sim.server._post_tick = lambda failed: posts.append(tuple(failed))
+    return sim, posts
+
+
+def test_watchdog_twin_sync_heartbeat_expiry_lockstep():
+    """Rank 4 stops beating mid-round: the twin's `_sync_watch` and the
+    real detector path (`wait_all_or_failed` over the same monitor, the
+    decision `_watchdog_loop` posts from) must evict the same rank set
+    at the same virtual deadline."""
+    sim, posts = _lockstep_sim("sync")
+    srv = sim.server
+    for r in (1, 2, 3, 4):
+        srv.heartbeat.beat(r)
+    with srv._lock:
+        srv._arrived.update({1, 2, 3})
+    sim._sync_watch()
+    assert posts == []  # nothing expired yet
+    sim.clock.advance_to(srv.heartbeat.timeout_s + 1.0)
+    for r in (1, 2, 3):
+        srv.heartbeat.beat(r)  # rank 4 stays silent past the deadline
+    sim._sync_watch()
+    real = tuple(srv.heartbeat.wait_all_or_failed(
+        [1, 2, 3, 4], have=srv._arrived_snapshot, poll_s=0.001,
+        deadline_s=srv.round_timeout_s))
+    assert posts == [(0, (4,))]
+    assert real == posts[-1][1]
+
+
+def test_watchdog_twin_sync_round_deadline_lockstep():
+    """The missing-but-beating branch: rank 4's heartbeat stays alive
+    but its upload never lands. Past round_timeout_s both the twin and
+    the real detector must declare it failed (the deadline clause, not
+    the liveness clause)."""
+    import threading
+
+    sim, posts = _lockstep_sim("sync")
+    srv = sim.server
+    srv.heartbeat.timeout_s = 1e9  # operator heartbeat: everyone "alive"
+    for r in (1, 2, 3, 4):
+        srv.heartbeat.beat(r)
+    with srv._lock:
+        srv._arrived.update({1, 2, 3})
+    sim._sync_watch()  # latches the twin's round-deadline epoch at t=0
+    assert posts == []
+    real = []
+    th = threading.Thread(target=lambda: real.append(tuple(
+        srv.heartbeat.wait_all_or_failed(
+            [1, 2, 3, 4], have=srv._arrived_snapshot, poll_s=0.002,
+            deadline_s=srv.round_timeout_s))))
+    th.start()
+    sim.clock.advance_to(srv.round_timeout_s + 1.0)
+    sim._sync_watch()
+    th.join(timeout=10.0)
+    assert not th.is_alive() and real
+    assert posts == [(0, (4,))]
+    assert real[0] == posts[-1][1]
+
+
+def test_watchdog_twin_async_done_deadline_lockstep():
+    """The buffered tier's terminal handshake: version has reached
+    comm_round, rank 4 never reports done. Twin `_async_watch` and the
+    real detector must both declare it failed once done_timeout_s
+    elapses — and not a poll earlier."""
+    import threading
+
+    sim, posts = _lockstep_sim("fedbuff", buffer_k=2)
+    srv = sim.server
+    srv.heartbeat.timeout_s = 1e9
+    for r in (1, 2, 3, 4):
+        srv.heartbeat.beat(r)
+    with srv._lock:
+        srv.version = sim.cfg.comm_round  # terminal
+        srv._done_set.update({1, 2, 3})
+    sim._async_watch()  # latches _term_t0 at t=0
+    assert posts == []
+    real = []
+    th = threading.Thread(target=lambda: real.append(tuple(
+        srv.heartbeat.wait_all_or_failed(
+            [1, 2, 3, 4], have=srv._done_snapshot, poll_s=0.002,
+            deadline_s=srv.done_timeout_s))))
+    th.start()
+    sim.clock.advance_to(srv.done_timeout_s + 1.0)
+    sim._async_watch()
+    th.join(timeout=10.0)
+    assert not th.is_alive() and real
+    assert posts == [(4,)]
+    assert real[0] == posts[-1]

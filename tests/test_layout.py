@@ -291,6 +291,7 @@ def test_layout_checkpoint_and_wire_stay_logical(tmp_path):
     assert tree_shapes(got) == tree_shapes(api.net.params)
 
 
+@pytest.mark.slow  # >7 s drill; tier-1 re-fit to the 870 s budget on the 2-core box (r20 audit)
 def test_layout_refused_for_custom_trainers_and_bad_values():
     from fedml_tpu.algos.fedprox import FedProxAPI
 
@@ -326,6 +327,7 @@ def _lr_setup(**cfg_kw):
                      fed, None, cfg), fed
 
 
+@pytest.mark.slow  # >5.8 s drill; tier-1 re-fit to the 870 s budget on the 2-core box (r20 audit)
 def test_fused_step_matches_separate_procedure():
     """train_one_round (fused: one donated dispatch) is bit-equal to the
     pre-r9 run_round + _server_update procedure — FedAvg and FedOpt
@@ -392,21 +394,21 @@ def test_fused_step_donates_and_never_retraces():
 
 def test_separate_procedure_holds_two_copies():
     """Negative control for the audit: the undonated run_round path has
-    the old net AND the round average live at the sample point."""
+    the old net AND the round average live at the sample point — the
+    audit must SEE >= 2 copies where the donated fused loop holds flat
+    (test_fused_step_donates_and_never_retraces). Pinned on the
+    sample-point count alone: what drops after the server update is a
+    dispatch-cache detail (the round executable retains its most recent
+    call's arguments, so a del+gc freed-copies delta reads 0 on a cold
+    cache and made this control order-dependent in the suite)."""
     from fedml_tpu.obs.sanitizer import donation_audit
-
-    import gc
 
     api, _ = _lr_setup()
     avg, loss = api.run_round(0)
     float(loss)  # force the dispatch to completion
     with donation_audit(api.net) as audit:
         with_avg = audit.sample()          # old net + round average live
-        api.net = api._server_update(api.net, avg)
-        del avg, loss                      # undonated intermediates freed
-        gc.collect()
-        after = audit.copies()
-    assert with_avg >= after + 0.75, (with_avg, after)
+    assert with_avg >= 1.75, with_avg
 
 
 def test_fused_step_skipped_for_custom_rounds():
@@ -439,6 +441,7 @@ def test_fused_step_skipped_for_custom_rounds():
 
 # ---------------- s2d promotion ---------------------------------------
 
+@pytest.mark.slow  # >5.4 s drill; tier-1 re-fit to the 870 s budget on the 2-core box (r20 audit)
 def test_s2d_first_class_in_registry():
     from fedml_tpu.models import create_model
 

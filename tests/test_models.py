@@ -14,7 +14,9 @@ from fedml_tpu.trainer.local import model_fns
         ("cnn", dict(num_classes=62, dropout=True), (2, 28, 28, 1), 62),
         ("cnn", dict(num_classes=62, dropout=False), (2, 28, 28, 1), 62),
         ("resnet20", dict(num_classes=10), (2, 32, 32, 3), 10),
-        ("resnet18_gn", dict(num_classes=100), (2, 32, 32, 3), 100),
+        pytest.param("resnet18_gn", dict(num_classes=100), (2, 32, 32, 3),
+                     100,
+                     marks=pytest.mark.slow),  # ~7 s compile; tier-1 re-fit (r20 audit)
         ("vgg11", dict(num_classes=10, classifier_width=64), (2, 32, 32, 3), 10),
         ("vgg11_gn", dict(num_classes=10, classifier_width=64), (2, 32, 32, 3), 10),
         pytest.param("mobilenet_v3", dict(num_classes=10, model_mode="SMALL"),

@@ -121,6 +121,7 @@ def _tiny_darts(num_classes=4):
                         num_classes=num_classes)
 
 
+@pytest.mark.slow  # >7 s drill; tier-1 re-fit to the 870 s budget on the 2-core box (r20 audit)
 def test_darts_forward_and_alphas():
     model = _tiny_darts()
     fns = model_fns(model)

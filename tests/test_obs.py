@@ -182,6 +182,7 @@ def test_model_cost_analysis():
     assert "M params" in s
 
 
+@pytest.mark.slow  # >5.4 s drill; tier-1 re-fit to the 870 s budget on the 2-core box (r20 audit)
 def test_model_cost_pins_the_mfu_denominator():
     """The r9 MFU headline scalars divide by model_cost's FLOP estimate
     — audit that denominator two ways, on a conv model AND the

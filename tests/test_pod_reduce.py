@@ -302,6 +302,7 @@ def test_windowed_rides_dcn_mesh_bit_equal_host_loop():
 
 # ---------------- the O(G)-traffic observable -------------------------
 
+@pytest.mark.slow  # >5.4 s drill; tier-1 re-fit to the 870 s budget on the 2-core box (r20 audit)
 def test_reduce_obs_gauges_scale_with_hosts_not_cohort():
     x, y, parts = _equal_counts(n_clients=16, per=32)
     fed = build_federated_arrays(x, y, parts, batch_size=16)
@@ -476,6 +477,7 @@ def test_im2col_refusals():
         im2col_layout(CifarResNet(layers=(1, 1, 1), num_classes=10), x)
 
 
+@pytest.mark.slow  # >5.8 s drill; tier-1 re-fit to the 870 s budget on the 2-core box (r20 audit)
 def test_cfg_compute_layout_im2col_end_to_end():
     """cfg.compute_layout="im2col" trains with logical shapes at every
     boundary above the step — and the wrapped step tracks the plain run

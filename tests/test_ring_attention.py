@@ -191,6 +191,7 @@ def test_ring_flash_non_divisor_shard_length():
                                rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow  # >5.8 s drill; tier-1 re-fit to the 870 s budget on the 2-core box (r20 audit)
 def test_ring_flash_grads_match_dense():
     """The backward ring pass (rotating dk/dv accumulators through the
     block FlashAttention-2 kernels, custom_vjp) must equal dense grads."""

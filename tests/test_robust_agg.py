@@ -199,6 +199,7 @@ def _assert_nets_bit_equal(a, b):
         np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
 
 
+@pytest.mark.slow  # >7 s drill; tier-1 re-fit to the 870 s budget on the 2-core box (r20 audit)
 def test_mean_aggregator_bit_equal_host_pipelined_windowed():
     """cfg.aggregator="mean" resolves to the builders' existing
     weighted-mean fast path — bit-equal to a default-config run on the
@@ -467,6 +468,7 @@ def test_nan_attack_mean_poisoned_robust_with_guard_survives(clean_acc):
         assert acc > clean_acc - 0.12, (agg, acc, clean_acc)
 
 
+@pytest.mark.slow  # >5.8 s drill; tier-1 re-fit to the 870 s budget on the 2-core box (r20 audit)
 def test_drill_windowed_bit_equal_host_loop():
     """The device-side corruptor inside the scan produces EXACTLY the
     host loop's trajectory — corruption, defense, and noise all ride

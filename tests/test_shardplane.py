@@ -570,6 +570,41 @@ def test_loopback_kill_one_shard_drill():
     assert abs(drill_acc - clean_acc) < 0.15
 
 
+def test_killed_shard_drops_inflight_traffic_instead_of_crashing():
+    """Regression pin for the kill/dispatch race: ``finish()`` runs on
+    another thread (a killer, or the coordinator's done-anchor) while the
+    dispatch thread is mid-handler. A dead shard must DROP late traffic —
+    upload, anchor, flush — never crash its receive loop on the closed
+    pool (the coordinator's heartbeat eviction owns the partition)."""
+    from fedml_tpu.algos.fedavg_distributed import (
+        MSG_ARG_KEY_MODEL_PARAMS,
+        MSG_ARG_KEY_NUM_SAMPLES,
+    )
+    from fedml_tpu.comm.shardplane import (
+        MSG_TYPE_COORD2SHARD_ANCHOR,
+        MSG_TYPE_COORD2SHARD_FLUSH,
+    )
+
+    srv, shards, agg, network, mgrs = _fabric(m=1, workers=2)
+    shard = shards[1]
+    shard.finish()
+
+    up = Message(MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, 2, 1)
+    up.add(MSG_ARG_KEY_MODEL_PARAMS, {"w": np.ones(2, np.float32)})
+    up.add(MSG_ARG_KEY_NUM_SAMPLES, 4.0)
+    up.add("round", 0)
+    up.add("epoch", 0)
+    # Handler-level guard: the dead shard ignores all three message kinds.
+    for m_ in (up, Message(MSG_TYPE_COORD2SHARD_ANCHOR, 0, 1),
+               Message(MSG_TYPE_COORD2SHARD_FLUSH, 0, 1)):
+        shard.receive_message(m_.get_type(), m_)
+    assert shard.accepted == 0
+
+    # The narrow race: finish() lands AFTER the handler's guard but
+    # before the pool submit — the submit must report a drop, not raise.
+    assert shard._submit_upload(2, 0, up) is False
+
+
 def _sim_sharded(m, seed=5):
     from fedml_tpu.sim import FleetSimulator, FleetSpec, make_fleet_trace
 

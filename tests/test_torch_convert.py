@@ -271,7 +271,9 @@ def test_architecture_mismatch_raises():
 
 @pytest.mark.parametrize("kwargs", [
     dict(stem="s2d"),                               # registry s2d variant
-    dict(widths=(24, 48, 96), stem_width=24),       # lane-padded-style widths
+    pytest.param(dict(widths=(24, 48, 96), stem_width=24),
+                 marks=pytest.mark.slow,  # >7 s arm; tier-1 re-fit (r20 audit)
+                 id="kwargs1"),          # lane-padded-style widths
 ])
 def test_non_reference_geometry_refused_loudly(kwargs):
     """The r9 guard: an s2d-stem or width-overridden net has no
